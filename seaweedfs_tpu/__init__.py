@@ -1,0 +1,16 @@
+"""seaweedfs_tpu — a TPU-native distributed object store.
+
+A ground-up rebuild of the capabilities of SeaweedFS (reference:
+/root/reference, pure Go) designed TPU-first:
+
+- the Reed-Solomon GF(2^8) erasure-coding hot path is a bit-sliced matmul on
+  the TPU MXU (``ops/``: numpy oracle, XLA coder, Pallas kernel);
+- multi-volume encode/rebuild scales over a ``jax.sharding.Mesh`` with XLA
+  collectives (``parallel/``);
+- the storage/cluster framework (needle formats, volume engine, topology,
+  master/volume servers, filer, gateways) keeps the reference's on-disk and
+  wire shapes so existing tools and operators carry over (``core/``,
+  ``storage/``, ``ec/``, ``topology/``, ``cluster/``, ``shell/``).
+"""
+
+__version__ = "0.1.0"

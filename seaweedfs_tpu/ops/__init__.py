@@ -1,0 +1,1 @@
+"""Erasure-coding compute kernels: GF(2^8) math lowered to TPU matmuls."""

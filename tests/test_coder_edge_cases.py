@@ -1,0 +1,35 @@
+"""Edge cases surfaced in code review: empty wanted, invalid shard ids."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops.coder_numpy import NumpyCoder
+
+
+def test_reconstruct_empty_wanted_returns_empty():
+    c = NumpyCoder(10, 4)
+    # Even with too few survivors, nothing wanted -> nothing to do.
+    have = {i: np.zeros(10, np.uint8) for i in range(5)}
+    assert c.reconstruct(have, wanted=[]) == {}
+
+
+def test_reconstruct_out_of_range_wanted_raises_valueerror():
+    c = NumpyCoder(10, 4)
+    data = np.random.default_rng(0).integers(0, 256, (10, 20), dtype=np.uint8)
+    shards = c.encode_all(data)
+    have = {i: shards[i] for i in range(10)}
+    with pytest.raises(ValueError, match="out of range"):
+        c.reconstruct(have, wanted=[14])
+    with pytest.raises(ValueError, match="out of range"):
+        c.reconstruct(have, wanted=[-1])
+
+
+def test_parity_only_reconstruction_skips_data_solve():
+    c = NumpyCoder(10, 4)
+    data = np.random.default_rng(1).integers(0, 256, (10, 64), dtype=np.uint8)
+    shards = c.encode_all(data)
+    have = {i: shards[i] for i in range(10)}  # all data, no parity
+    rec = c.reconstruct(have)
+    assert set(rec) == {10, 11, 12, 13}
+    for i in rec:
+        assert np.array_equal(rec[i], shards[i])
