@@ -1,0 +1,1 @@
+"""On-disk formats: needle records, indexes, superblocks, CRC, TTL."""

@@ -1,0 +1,127 @@
+"""XLA Reed-Solomon coder: bit-sliced GF(2) matmul, jittable on TPU/CPU.
+
+The RS byte-mix (klauspost/reedsolomon's galois kernels in the reference,
+used from `weed/storage/erasure_coding/ec_encoder.go`) becomes, per
+`rs_bitmatrix.py`,
+
+    out_bits = (B @ in_bits) mod 2
+
+This module keeps the whole computation in traced JAX so it runs under jit
+on any backend; the Pallas variant (`coder_pallas.py`) fuses unpack/matmul/
+pack into VMEM for peak MXU throughput.
+
+Bit layout is *plane-major* to stay 2D on TPU: row `s*k + j` holds bit `s`
+of shard `j`.  Sums over the contracting dim are <= 8k <= 2048 so bf16
+inputs with f32 accumulation are exact.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import rs_bitmatrix
+
+
+def plane_major(bmat: np.ndarray, rows: int, cols: int) -> np.ndarray:
+    """Permute an interleaved (8r x 8k) bit matrix into plane-major order.
+
+    Interleaved index 8*s + b (bit b of shard s)  ->  plane-major b*n + s.
+    """
+    r8, k8 = bmat.shape
+    assert r8 == 8 * rows and k8 == 8 * cols
+    row_perm = [8 * (q % rows) + (q // rows) for q in range(8 * rows)]
+    col_perm = [8 * (q % cols) + (q // cols) for q in range(8 * cols)]
+    return bmat[np.ix_(row_perm, col_perm)]
+
+
+@functools.partial(jax.jit, static_argnames=("out_rows",))
+def apply_bitmatrix(bmat_pm: jax.Array, shards: jax.Array,
+                    out_rows: int) -> jax.Array:
+    """out = GF-matrix-mix of byte shards, via one GF(2) matmul.
+
+    bmat_pm: (8*out_rows, 8*k) plane-major 0/1, any int/float dtype.
+    shards:  (k, n) uint8.
+    Returns (out_rows, n) uint8.
+    """
+    x = shards.astype(jnp.int32)
+    # Unpack: plane-major bit rows, still 2D. (8k, n)
+    bits = jnp.concatenate([(x >> s) & 1 for s in range(8)], axis=0)
+    # GF(2) matmul on the MXU: bf16 x bf16 -> f32 is exact for sums <= 8k.
+    acc = jnp.dot(bmat_pm.astype(jnp.bfloat16), bits.astype(jnp.bfloat16),
+                  preferred_element_type=jnp.float32)
+    parity_bits = acc.astype(jnp.int32) & 1  # (8*out_rows, n)
+    # Pack plane-major rows back into bytes.
+    out = parity_bits[0:out_rows]
+    for s in range(1, 8):
+        out = out | (parity_bits[s * out_rows:(s + 1) * out_rows] << s)
+    return out.astype(jnp.uint8)
+
+
+class JaxCoder:
+    """Drop-in analog of NumpyCoder running under jit (XLA path)."""
+
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4,
+                 matrix_kind: str = "vandermonde"):
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix_kind = matrix_kind
+        pb = rs_bitmatrix.parity_bitmatrix(
+            data_shards, self.total_shards, matrix_kind)
+        self._parity_pm = jnp.asarray(
+            plane_major(pb, parity_shards, data_shards), jnp.bfloat16)
+
+    # -- primitives --------------------------------------------------------
+
+    def encode(self, data) -> jax.Array:
+        """(data_shards, n) uint8 -> (parity_shards, n) uint8."""
+        data = jnp.asarray(data, jnp.uint8)
+        if data.shape[0] != self.data_shards:
+            raise ValueError(
+                f"expected {self.data_shards} data shards, got {data.shape[0]}")
+        return apply_bitmatrix(self._parity_pm, data, self.parity_shards)
+
+    def encode_all(self, data) -> jax.Array:
+        data = jnp.asarray(data, jnp.uint8)
+        return jnp.concatenate([data, self.encode(data)], axis=0)
+
+    @functools.lru_cache(maxsize=256)
+    def _decode_mat_pm(self, present: tuple[int, ...],
+                       wanted: tuple[int, ...]) -> tuple[jax.Array, tuple[int, ...]]:
+        bmat, used = rs_bitmatrix.decode_bitmatrix(
+            self.data_shards, self.total_shards, present, wanted,
+            self.matrix_kind)
+        pm = plane_major(np.asarray(bmat), len(wanted), self.data_shards)
+        return jnp.asarray(pm, jnp.bfloat16), used
+
+    def reconstruct(self, shards: dict[int, jax.Array],
+                    wanted: list[int] | None = None) -> dict[int, jax.Array]:
+        """Recover missing shards from >= data_shards survivors (one matmul).
+
+        Unlike the reference's two-step Reconstruct (solve data, then
+        re-encode parity — `klauspost.Reconstruct`), the decode matrix here
+        composes both steps, so any mix of lost data/parity shards is one
+        fused GF(2) matmul.
+        """
+        present = tuple(sorted(shards))
+        if wanted is None:
+            wanted = [s for s in range(self.total_shards) if s not in shards]
+        bad = [w for w in wanted if not 0 <= w < self.total_shards]
+        if bad:
+            raise ValueError(
+                f"shard ids {bad} out of range [0, {self.total_shards})")
+        if not wanted:
+            return {}
+        mat_pm, used = self._decode_mat_pm(present, tuple(wanted))
+        stacked = jnp.stack([jnp.asarray(shards[s], jnp.uint8) for s in used])
+        rec = apply_bitmatrix(mat_pm, stacked, len(wanted))
+        return {w: rec[i] for i, w in enumerate(wanted)}
+
+    def verify(self, shards) -> bool:
+        shards = jnp.asarray(shards, jnp.uint8)
+        parity = self.encode(shards[: self.data_shards])
+        return bool(jnp.array_equal(parity, shards[self.data_shards:]))

@@ -71,46 +71,40 @@ class NumpyCoder:
         `Reconstruct` (all shards) / `ReconstructData` (wanted=[0..k)).
         """
         present = sorted(shards)
+        bad = [s for s in present if not 0 <= s < self.total_shards]
+        if bad:
+            raise ValueError(
+                f"survivor shard ids {bad} out of range [0, {self.total_shards})")
         if wanted is None:
             wanted = [s for s in range(self.total_shards) if s not in shards]
         bad = [w for w in wanted if not 0 <= w < self.total_shards]
         if bad:
             raise ValueError(
                 f"shard ids {bad} out of range [0, {self.total_shards})")
-        missing_data = [w for w in wanted if w < self.data_shards]
+        if not wanted:
+            return {}
         missing_parity = [w for w in wanted if w >= self.data_shards]
+        # One decode solve covers wanted data shards plus any data shards
+        # needed to re-encode wanted parity.
+        solve_data = sorted({w for w in wanted if w < self.data_shards} |
+                            ({d for d in range(self.data_shards)
+                              if d not in shards} if missing_parity else set()))
 
         out: dict[int, np.ndarray] = {}
-        if missing_data:
+        solved: dict[int, np.ndarray] = {}
+        if solve_data:
             mat, used = gf256.decode_matrix(
                 self.data_shards, self.total_shards, present,
-                wanted=missing_data, kind=self.matrix_kind)
+                wanted=solve_data, kind=self.matrix_kind)
             stacked = np.stack([np.asarray(shards[s], np.uint8) for s in used])
             rec = self._apply(mat, stacked)
-            for i, w in enumerate(missing_data):
-                out[w] = rec[i]
+            solved = {d: rec[i] for i, d in enumerate(solve_data)}
+            out.update({d: solved[d] for d in solve_data if d in wanted})
 
         if missing_parity:
-            # Need full data rows to re-encode parity.
-            data_rows = []
-            for d in range(self.data_shards):
-                if d in shards:
-                    data_rows.append(np.asarray(shards[d], np.uint8))
-                else:
-                    data_rows.append(out[d] if d in out else None)
-            if any(r is None for r in data_rows):
-                # Data shard neither present nor wanted: reconstruct it too.
-                extra = [d for d in range(self.data_shards)
-                         if data_rows[d] is None]
-                mat2, used2 = gf256.decode_matrix(
-                    self.data_shards, self.total_shards, present,
-                    wanted=extra, kind=self.matrix_kind)
-                stacked2 = np.stack(
-                    [np.asarray(shards[s], np.uint8) for s in used2])
-                rec2 = self._apply(mat2, stacked2)
-                for i, d in enumerate(extra):
-                    data_rows[d] = rec2[i]
-            data = np.stack(data_rows)
+            data = np.stack([
+                np.asarray(shards[d], np.uint8) if d in shards else solved[d]
+                for d in range(self.data_shards)])
             parity = self.encode(data)
             for w in missing_parity:
                 out[w] = parity[w - self.data_shards]

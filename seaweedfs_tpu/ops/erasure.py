@@ -1,0 +1,68 @@
+"""ErasureCoder backend selection.
+
+The reference hides klauspost/reedsolomon behind direct calls in
+`ec_encoder.go`; BASELINE.json's design point is an `ErasureCoder`
+interface seam that picks a backend at startup.  Backends:
+
+- "numpy":  table-lookup oracle (always available, slow)
+- "jax":    XLA bit-sliced matmul (any jax backend)
+- "pallas": fused MXU kernel (TPU; interpreter mode elsewhere)
+
+Selection: SEAWEEDFS_TPU_CODER env var, else pallas on TPU, else jax.
+All backends share the same API: encode / encode_all / reconstruct / verify,
+operating on (shards, n) uint8 arrays; results are byte-identical.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol
+
+import numpy as np
+
+
+class ErasureCoder(Protocol):
+    data_shards: int
+    parity_shards: int
+    total_shards: int
+
+    def encode(self, data) -> np.ndarray: ...
+    def encode_all(self, data) -> np.ndarray: ...
+    def reconstruct(self, shards: dict[int, np.ndarray],
+                    wanted: list[int] | None = None) -> dict[int, np.ndarray]: ...
+    def verify(self, shards) -> bool: ...
+
+
+_BACKENDS = ("numpy", "jax", "pallas")
+
+
+def default_backend() -> str:
+    env = os.environ.get("SEAWEEDFS_TPU_CODER")
+    if env:
+        if env not in _BACKENDS:
+            raise ValueError(
+                f"SEAWEEDFS_TPU_CODER={env!r}; expected one of {_BACKENDS}")
+        return env
+    try:
+        import jax
+        if jax.devices()[0].platform == "tpu":
+            return "pallas"
+        return "jax"
+    except Exception:
+        return "numpy"
+
+
+def new_coder(data_shards: int = 10, parity_shards: int = 4,
+              matrix_kind: str = "vandermonde",
+              backend: str | None = None) -> ErasureCoder:
+    backend = backend or default_backend()
+    if backend == "numpy":
+        from .coder_numpy import NumpyCoder
+        return NumpyCoder(data_shards, parity_shards, matrix_kind)
+    if backend == "jax":
+        from .coder_jax import JaxCoder
+        return JaxCoder(data_shards, parity_shards, matrix_kind)
+    if backend == "pallas":
+        from .coder_pallas import PallasCoder
+        return PallasCoder(data_shards, parity_shards, matrix_kind)
+    raise ValueError(f"unknown erasure backend {backend!r}")

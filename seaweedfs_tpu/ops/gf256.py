@@ -229,6 +229,12 @@ def decode_matrix(data_shards: int, total_shards: int,
         raise ValueError(f"unknown matrix kind {kind!r}")
 
     present = sorted(present)
+    bad = [s for s in present if not 0 <= s < total_shards]
+    if bad:
+        raise ValueError(
+            f"survivor shard ids {bad} out of range [0, {total_shards})")
+    if len(set(present)) != len(present):
+        raise ValueError(f"duplicate survivor shard ids in {present}")
     if len(present) < data_shards:
         raise ValueError(
             f"too few shards: have {len(present)}, need {data_shards}")
@@ -238,9 +244,6 @@ def decode_matrix(data_shards: int, total_shards: int,
 
     if wanted is None:
         wanted = [s for s in range(total_shards) if s not in set(present)]
-    rows = []
-    for w in wanted:
-        # shard w = full[w] @ data = full[w] @ sub_inv @ used_shards
-        rows.append(mat_mul(full[w:w + 1], sub_inv)[0])
-    mat = np.stack(rows, axis=0) if rows else np.zeros((0, data_shards), np.uint8)
+    # shard w = full[w] @ data = full[w] @ sub_inv @ used_shards
+    mat = mat_mul(full[list(wanted)], sub_inv)
     return mat, used

@@ -65,7 +65,7 @@ def parity_bitmatrix(data_shards: int, total_shards: int,
 @functools.lru_cache(maxsize=256)
 def decode_bitmatrix(data_shards: int, total_shards: int,
                      present: tuple[int, ...], wanted: tuple[int, ...] | None = None,
-                     kind: str = "vandermonde") -> tuple[np.ndarray, list[int]]:
+                     kind: str = "vandermonde") -> tuple[np.ndarray, tuple[int, ...]]:
     """Bit-lowered reconstruction matrix for a given survivor set.
 
     Returns (B, used): B is (8*len(wanted), 8*data_shards) and maps the bits

@@ -1,0 +1,1 @@
+"""Shared utilities: native-library loading, misc helpers."""

@@ -1,0 +1,62 @@
+"""ctypes loader for the optional C++ host-side library (native/).
+
+The native library accelerates host-path hot spots the way the reference
+leans on Go-assembly SIMD (klauspost/crc32, klauspost/reedsolomon):
+CRC32-C, GF(2^8) encode for the CPU fallback path, and needle scanning.
+Pure-Python fallbacks exist for every entry point; everything degrades
+gracefully when the library hasn't been built.
+
+Build: `make -C native` (produces native/libseaweed_native.so).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+
+_LIB_NAMES = ("libseaweed_native.so",)
+
+
+@functools.lru_cache(maxsize=1)
+def load() -> ctypes.CDLL | None:
+    override = os.environ.get("SEAWEEDFS_TPU_NATIVE_LIB")
+    candidates = [override] if override else []
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for name in _LIB_NAMES:
+        candidates.append(os.path.join(here, "native", name))
+    for path in candidates:
+        if path and os.path.exists(path):
+            try:
+                return ctypes.CDLL(path)
+            except OSError:
+                continue
+    return None
+
+
+def crc32c_fn(lib: ctypes.CDLL):
+    """Wrap uint32 sw_crc32c(uint32 crc, const uint8* buf, size_t len)."""
+    fn = lib.sw_crc32c
+    fn.restype = ctypes.c_uint32
+    fn.argtypes = [ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+
+    def crc32c(data: bytes, crc: int = 0) -> int:
+        return fn(crc, bytes(data), len(data))
+
+    return crc32c
+
+
+def gf_encode_fn(lib: ctypes.CDLL):
+    """Wrap the C++ GF(2^8) row-mix (CPU fallback coder).
+
+    void sw_gf_mix(const uint8* mat, int rows, int cols,
+                   const uint8* const* shards_in, uint8** shards_out,
+                   size_t n)
+    """
+    fn = lib.sw_gf_mix
+    fn.restype = None
+    fn.argtypes = [ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+                   ctypes.POINTER(ctypes.c_void_p),
+                   ctypes.POINTER(ctypes.c_void_p), ctypes.c_size_t]
+    return fn

@@ -1,15 +1,33 @@
-"""Test configuration: force an 8-device virtual CPU mesh.
+"""Test configuration: hermetic 8-device virtual CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; sharding/collective logic is
 exercised on a virtual CPU mesh exactly as the driver's `dryrun_multichip`
-does.  Must run before jax initializes its backends, hence the env mutation
-at import time.
+does.  Two things make the suite hermetic:
+
+1. JAX_PLATFORMS / XLA_FLAGS are forced (not defaulted — the environment
+   ships JAX_PLATFORMS=axon for the real chip) before jax initializes.
+2. The `axon` PJRT plugin (registered by sitecustomize at interpreter
+   startup) is dropped from jax's backend-factory registry; otherwise
+   jax.devices() would dial the TPU tunnel from every test process, which
+   both serializes on the single chip grant and hangs when the tunnel is
+   busy.  Tests must never depend on the real chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    # sitecustomize imported jax before this conftest ran, so the
+    # jax_platforms config already latched "axon"; point it back at cpu.
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,224 @@
+"""L0 format tests: scalar codecs, CRC, TTL, needle round-trips, superblock.
+
+The golden test at the bottom cross-validates against the reference's
+committed binary fixture (weed/storage/erasure_coding/1.dat + 1.idx): every
+needle is parsed and re-serialized and must be byte-identical — this pins
+header layout, optional sections, checksum masking, AND the padding quirk.
+"""
+
+import os
+import zlib
+
+import pytest
+
+from seaweedfs_tpu.core import crc, idx, types as t
+from seaweedfs_tpu.core.needle import (CURRENT_VERSION, VERSION1, VERSION2,
+                                       VERSION3, Needle, get_actual_size,
+                                       padding_length)
+from seaweedfs_tpu.core.replica_placement import ReplicaPlacement
+from seaweedfs_tpu.core.super_block import SuperBlock
+from seaweedfs_tpu.core.ttl import TTL
+
+REF_FIXTURE = "/root/reference/weed/storage/erasure_coding"
+
+
+def test_scalar_codecs_big_endian():
+    assert t.put_uint32(0x01020304) == b"\x01\x02\x03\x04"
+    assert t.get_uint32(b"\x01\x02\x03\x04") == 0x01020304
+    assert t.put_uint64(1) == b"\x00" * 7 + b"\x01"
+    assert t.put_uint16(0xBEEF) == b"\xbe\xef"
+    assert t.size_from_bytes(t.size_to_bytes(-1)) == -1
+
+
+def test_offset_units_of_8():
+    assert t.offset_to_bytes(800) == t.put_uint32(100)
+    assert t.offset_from_bytes(t.offset_to_bytes(12345678 * 8)) == 12345678 * 8
+
+
+def test_needle_map_entry_roundtrip():
+    e = t.NeedleMapEntry(key=0xDEADBEEF01, offset=4096, size=1234)
+    b = e.to_bytes()
+    assert len(b) == 16
+    assert t.NeedleMapEntry.from_bytes(b) == e
+
+
+def test_tombstone():
+    e = t.NeedleMapEntry(key=1, offset=0, size=t.TOMBSTONE_FILE_SIZE)
+    assert t.size_is_deleted(t.NeedleMapEntry.from_bytes(e.to_bytes()).size)
+
+
+def test_file_id_format_parse():
+    fid = t.format_file_id(3, 0x01637037, 0xD6000000)
+    vid, key, cookie = t.parse_file_id(fid)
+    assert (vid, key, cookie) == (3, 0x01637037, 0xD6000000)
+    assert t.format_file_id(1, 0, 0x12345678) == "1,012345678"
+    with pytest.raises(ValueError):
+        t.parse_file_id("nocomma")
+
+
+def test_crc32c_against_zlib_crc32_distinct():
+    # CRC32-C != zlib CRC32 (different polynomial); sanity that we're not
+    # accidentally using the stdlib one.
+    data = b"hello seaweedfs"
+    assert crc.crc32c(data) != zlib.crc32(data)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / Castagnoli test vectors.
+    assert crc.crc32c(b"") == 0
+    assert crc.crc32c(b"123456789") == 0xE3069283
+    assert crc.crc32c(bytes(32)) == 0x8A9136AA
+    assert crc.crc32c(bytes([0xFF] * 32)) == 0x62A8AB43
+
+
+def test_crc32c_incremental_update():
+    data = os.urandom(1000)
+    whole = crc.crc32c(data)
+    part = crc.crc32c(data[373:], crc.crc32c(data[:373]))
+    assert whole == part
+
+
+def test_masked_value():
+    # Value() = rot17(c) + 0xa282ead8 mod 2^32
+    assert crc.masked_value(0) == 0xA282EAD8
+    c = 0x12345678
+    rot = ((c >> 15) | (c << 17)) & 0xFFFFFFFF
+    assert crc.masked_value(c) == (rot + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def test_ttl_roundtrip():
+    for s, mins in (("3m", 3), ("4h", 240), ("5d", 7200), ("6w", 60480),
+                    ("100", 100)):
+        ttl = TTL.parse(s)
+        assert ttl.minutes() == mins
+        assert TTL.from_bytes(ttl.to_bytes()) == ttl
+        assert TTL.from_uint32(ttl.to_uint32()) == ttl
+    assert str(TTL.parse("7M")) == "7M"
+    assert str(TTL.parse("")) == ""
+    assert TTL.parse("").to_uint32() == 0
+
+
+def test_replica_placement():
+    rp = ReplicaPlacement.parse("012")
+    assert rp.diff_data_center_count == 0
+    assert rp.diff_rack_count == 1
+    assert rp.same_rack_count == 2
+    assert rp.copy_count() == 4
+    assert ReplicaPlacement.from_byte(rp.to_byte()) == rp
+    assert str(rp) == "012"
+    with pytest.raises(ValueError):
+        ReplicaPlacement.parse("900")
+
+
+def test_padding_always_1_to_8():
+    for version in (VERSION1, VERSION2, VERSION3):
+        for size in range(0, 64):
+            pad = padding_length(size, version)
+            assert 1 <= pad <= 8
+            total = get_actual_size(size, version)
+            assert total % 8 == 0
+
+
+def test_needle_roundtrip_minimal():
+    for version in (VERSION1, VERSION2, VERSION3):
+        n = Needle(cookie=0x11223344, id=42, data=b"hello world")
+        blob = n.to_bytes(version)
+        assert len(blob) == n.disk_size(version)
+        assert len(blob) % 8 == 0
+        m = Needle.from_bytes(blob, version)
+        assert m.id == 42 and m.cookie == 0x11223344
+        assert m.data == b"hello world"
+
+
+def test_needle_roundtrip_all_options():
+    n = Needle(cookie=7, id=0xABCDEF, data=b"payload-bytes")
+    n.set_name(b"file.txt")
+    n.set_mime(b"text/plain")
+    n.set_last_modified(1_600_000_000)
+    n.set_ttl(TTL.parse("3d"))
+    n.set_pairs(b'{"k":"v"}')
+    n.append_at_ns = 123456789012345678
+    blob = n.to_bytes(VERSION3)
+    m = Needle.from_bytes(blob, VERSION3)
+    assert m.data == n.data
+    assert m.name == b"file.txt"
+    assert m.mime == b"text/plain"
+    assert m.last_modified == 1_600_000_000
+    assert str(m.ttl) == "3d"
+    assert m.pairs == b'{"k":"v"}'
+    assert m.append_at_ns == 123456789012345678
+
+
+def test_needle_empty_data():
+    n = Needle(cookie=1, id=2, data=b"")
+    blob = n.to_bytes(VERSION3)
+    assert n.size == 0
+    m = Needle.from_bytes(blob, VERSION3)
+    assert m.data == b""
+
+
+def test_needle_crc_corruption_detected():
+    n = Needle(cookie=1, id=2, data=b"some data here")
+    blob = bytearray(n.to_bytes(VERSION3))
+    blob[t.NEEDLE_HEADER_SIZE + 5] ^= 0xFF  # flip a data byte
+    with pytest.raises(ValueError, match="CRC"):
+        Needle.from_bytes(bytes(blob), VERSION3)
+
+
+def test_superblock_roundtrip():
+    sb = SuperBlock(version=VERSION3,
+                    replica_placement=ReplicaPlacement.parse("001"),
+                    ttl=TTL.parse("3w"), compaction_revision=7)
+    b = sb.to_bytes()
+    assert len(b) == 8
+    got = SuperBlock.from_bytes(b)
+    assert got.version == 3
+    assert str(got.replica_placement) == "001"
+    assert str(got.ttl) == "3w"
+    assert got.compaction_revision == 7
+    sb.extra = b"\x01\x02\x03"
+    got2 = SuperBlock.from_bytes(sb.to_bytes())
+    assert got2.extra == b"\x01\x02\x03"
+
+
+def test_idx_walk_and_append(tmp_path):
+    p = tmp_path / "v.idx"
+    with open(p, "wb") as f:
+        for i in range(2500):  # > ROWS_TO_READ to hit the chunking path
+            idx.append_entry(f, key=i, actual_offset=i * 8, size=i % 100)
+    with open(p, "rb") as f:
+        entries = list(idx.iter_index(f))
+    assert len(entries) == 2500
+    assert entries[7] == t.NeedleMapEntry(7, 56, 7)
+
+
+# ---------------------------------------------------------------------------
+# Golden cross-validation vs the reference's committed binary fixture
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(REF_FIXTURE, "1.dat")),
+                    reason="reference fixture not available")
+def test_reference_fixture_byte_identical_reserialization():
+    """Parse every needle in the reference 1.dat and re-serialize: bytes
+    must match exactly (validates layout, checksum, and padding quirks)."""
+    with open(os.path.join(REF_FIXTURE, "1.dat"), "rb") as f:
+        dat = f.read()
+    with open(os.path.join(REF_FIXTURE, "1.idx"), "rb") as f:
+        entries = list(idx.iter_index(f))
+    sb = SuperBlock.from_bytes(dat[:8])
+    version = sb.version
+    assert entries, "fixture idx is empty?"
+    checked = 0
+    for e in entries:
+        if not t.size_is_valid(e.size):
+            continue
+        total = get_actual_size(e.size, version)
+        blob = dat[e.offset:e.offset + total]
+        n = Needle.from_bytes(blob, version)
+        assert n.id == e.key
+        re_blob = n.to_bytes(version)
+        assert re_blob == blob, (
+            f"re-serialization mismatch for needle {e.key:x} at {e.offset}")
+        checked += 1
+    assert checked > 0
