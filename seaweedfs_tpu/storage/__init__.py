@@ -1,0 +1,1 @@
+"""Volume engine: append-only blob storage with O(1) reads."""

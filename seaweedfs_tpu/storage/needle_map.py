@@ -1,0 +1,156 @@
+"""In-memory needle maps: needle id -> (offset, size) with live counters.
+
+The reference offers several NeedleMapper implementations (CompactMap,
+LevelDB, sorted-file, btree MemDb — weed/storage/needle_map.go:12-36).  In
+Python a dict already gives the CompactMap's O(1) behavior without its
+section machinery, so `MemoryNeedleMap` is the default store-side mapper
+(write-through to the `.idx` file like the reference's baseNeedleMapper),
+and `MemDb` is the sorted variant used to build `.ecx` files
+(weed/storage/needle_map/memdb.go).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from dataclasses import dataclass, field
+
+from ..core import idx as idx_mod
+from ..core import types as t
+
+
+@dataclass
+class MapMetrics:
+    file_count: int = 0
+    deletion_count: int = 0
+    file_byte_count: int = 0
+    deletion_byte_count: int = 0
+    maximum_file_key: int = 0
+
+
+class MemoryNeedleMap:
+    """NeedleMapper: dict index + write-through append to the .idx file."""
+
+    def __init__(self, idx_file=None):
+        self._m: dict[int, tuple[int, int]] = {}
+        self.metrics = MapMetrics()
+        self._idx_file = idx_file
+
+    @classmethod
+    def load(cls, idx_path: str) -> "MemoryNeedleMap":
+        """Rebuild the map from an existing .idx (LoadNewNeedleMap)."""
+        f = open(idx_path, "a+b")
+        f.seek(0)
+        nm = cls(idx_file=f)
+        for e in idx_mod.iter_index(f):
+            nm.metrics.maximum_file_key = max(nm.metrics.maximum_file_key,
+                                              e.key)
+            if e.offset > 0 and t.size_is_valid(e.size):
+                prev = nm._m.get(e.key)
+                if prev is not None:
+                    nm.metrics.deletion_count += 1
+                    nm.metrics.deletion_byte_count += prev[1]
+                else:
+                    nm.metrics.file_count += 1
+                nm.metrics.file_byte_count += e.size
+                nm._m[e.key] = (e.offset, e.size)
+            else:
+                prev = nm._m.pop(e.key, None)
+                if prev is not None:
+                    nm.metrics.deletion_count += 1
+                    nm.metrics.deletion_byte_count += prev[1]
+        f.seek(0, os.SEEK_END)
+        return nm
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        prev = self._m.get(key)
+        if prev is not None:
+            self.metrics.deletion_count += 1
+            self.metrics.deletion_byte_count += prev[1]
+        else:
+            self.metrics.file_count += 1
+        self.metrics.file_byte_count += size
+        self.metrics.maximum_file_key = max(self.metrics.maximum_file_key, key)
+        self._m[key] = (offset, size)
+        if self._idx_file is not None:
+            idx_mod.append_entry(self._idx_file, key, offset, size)
+
+    def get(self, key: int) -> tuple[int, int] | None:
+        return self._m.get(key)
+
+    def delete(self, key: int) -> int:
+        """Returns freed bytes; writes a tombstone idx entry."""
+        prev = self._m.pop(key, None)
+        if prev is None:
+            return 0
+        self.metrics.deletion_count += 1
+        self.metrics.deletion_byte_count += prev[1]
+        if self._idx_file is not None:
+            idx_mod.append_entry(self._idx_file, key, 0,
+                                 t.TOMBSTONE_FILE_SIZE)
+        return prev[1]
+
+    def ascending_visit(self, fn) -> None:
+        for key in sorted(self._m):
+            off, size = self._m[key]
+            fn(t.NeedleMapEntry(key, off, size))
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    def __contains__(self, key: int) -> bool:
+        return key in self._m
+
+    def content_size(self) -> int:
+        return self.metrics.file_byte_count
+
+    def deleted_size(self) -> int:
+        return self.metrics.deletion_byte_count
+
+    def flush(self) -> None:
+        if self._idx_file is not None:
+            self._idx_file.flush()
+
+    def close(self) -> None:
+        if self._idx_file is not None:
+            self._idx_file.flush()
+            self._idx_file.close()
+            self._idx_file = None
+
+
+class MemDb:
+    """Sorted key -> entry map used for .ecx generation and offline tools."""
+
+    def __init__(self):
+        self._m: dict[int, tuple[int, int]] = {}
+
+    def set(self, key: int, offset: int, size: int) -> None:
+        self._m[key] = (offset, size)
+
+    def delete(self, key: int) -> None:
+        self._m.pop(key, None)
+
+    def get(self, key: int) -> tuple[int, int] | None:
+        return self._m.get(key)
+
+    def ascending_visit(self, fn) -> None:
+        for key in sorted(self._m):
+            off, size = self._m[key]
+            fn(t.NeedleMapEntry(key, off, size))
+
+    @classmethod
+    def from_idx(cls, readable) -> "MemDb":
+        """Load .idx applying deletions (readNeedleMap, ec_encoder.go:289)."""
+        db = cls()
+        for e in idx_mod.iter_index(readable):
+            if e.offset > 0 and e.size != t.TOMBSTONE_FILE_SIZE:
+                db.set(e.key, e.offset, e.size)
+            else:
+                db.delete(e.key)
+        return db
+
+    def to_sorted_bytes(self) -> bytes:
+        """Serialize ascending — the exact .ecx payload."""
+        out = io.BytesIO()
+        self.ascending_visit(lambda e: out.write(e.to_bytes()))
+        return out.getvalue()

@@ -1,0 +1,222 @@
+"""Store: all volumes on one volume server, across disk locations.
+
+Reference: weed/storage/store.go (Store), disk_location.go (DiskLocation).
+The store discovers existing volumes at startup, routes needle CRUD by
+volume id, assembles heartbeat summaries for the master, and emits delta
+events (new/deleted volumes, EC shard mounts) that the cluster layer
+streams to the master (store.go:198-268).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+from dataclasses import dataclass, field
+
+from ..core.needle import Needle
+from ..core.replica_placement import ReplicaPlacement
+from ..core.ttl import TTL
+from .volume import NotFoundError, Volume, VolumeError
+
+_VOLUME_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.dat$")
+
+
+@dataclass
+class VolumeInfo:
+    """Heartbeat summary of one volume (master_pb VolumeInformationMessage)."""
+    id: int
+    collection: str
+    size: int
+    file_count: int
+    delete_count: int
+    deleted_byte_count: int
+    read_only: bool
+    replica_placement: int
+    ttl: int
+    compact_revision: int
+    max_file_key: int = 0
+    version: int = 3
+
+
+class DiskLocation:
+    """One data directory holding volumes (and EC shards)."""
+
+    def __init__(self, directory: str, max_volume_count: int = 7):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_volume_count = max_volume_count
+        self.volumes: dict[int, Volume] = {}
+        self._lock = threading.RLock()
+
+    def load_existing_volumes(self) -> int:
+        count = 0
+        with self._lock:
+            for path in sorted(glob.glob(os.path.join(self.directory,
+                                                      "*.dat"))):
+                m = _VOLUME_RE.match(os.path.basename(path))
+                if not m:
+                    continue
+                vid = int(m.group("vid"))
+                if vid in self.volumes:
+                    continue
+                collection = m.group("collection") or ""
+                try:
+                    self.volumes[vid] = Volume(
+                        self.directory, collection, vid, create=False)
+                    count += 1
+                except Exception:  # noqa: BLE001 — one corrupt volume file
+                    # (e.g. 0-byte .dat from a crashed create) must not
+                    # prevent the rest of the store from loading.
+                    continue
+        return count
+
+    def close(self) -> None:
+        with self._lock:
+            for v in self.volumes.values():
+                v.close()
+            self.volumes.clear()
+
+
+class Store:
+    """Routes needle operations to volumes; the volume server's core."""
+
+    def __init__(self, directories: list[str],
+                 max_volume_counts: list[int] | None = None,
+                 ip: str = "localhost", port: int = 8080,
+                 public_url: str = ""):
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        counts = max_volume_counts or [7] * len(directories)
+        self.locations = [DiskLocation(d, c)
+                          for d, c in zip(directories, counts)]
+        for loc in self.locations:
+            loc.load_existing_volumes()
+        self._lock = threading.RLock()
+        # Delta events for the heartbeat stream (master sync).
+        self.new_volumes: list[VolumeInfo] = []
+        self.deleted_volumes: list[VolumeInfo] = []
+
+    # -- volume management --------------------------------------------------
+
+    def find_volume(self, vid: int) -> Volume | None:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    def _find_free_location(self) -> DiskLocation | None:
+        best, best_free = None, 0
+        for loc in self.locations:
+            free = loc.max_volume_count - len(loc.volumes)
+            if free > best_free:
+                best, best_free = loc, free
+        return best
+
+    def add_volume(self, vid: int, collection: str = "",
+                   replica_placement: str = "000", ttl: str = "",
+                   version: int = 3) -> Volume:
+        with self._lock:
+            if self.has_volume(vid):
+                raise VolumeError(f"volume {vid} already exists")
+            loc = self._find_free_location()
+            if loc is None:
+                raise VolumeError("no free disk location")
+            v = Volume(loc.directory, collection, vid,
+                       replica_placement=ReplicaPlacement.parse(
+                           replica_placement),
+                       ttl=TTL.parse(ttl), version=version)
+            loc.volumes[vid] = v
+            self.new_volumes.append(self._volume_info(v))
+            return v
+
+    def delete_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    info = self._volume_info(v)
+                    v.close()
+                    base = v.file_name()
+                    for ext in (".dat", ".idx"):
+                        try:
+                            os.remove(base + ext)
+                        except FileNotFoundError:
+                            pass
+                    self.deleted_volumes.append(info)
+                    return
+            raise VolumeError(f"volume {vid} not found")
+
+    def mark_volume_readonly(self, vid: int, ro: bool = True) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        v.set_readonly(ro)
+
+    # -- needle CRUD ---------------------------------------------------------
+
+    def write_needle(self, vid: int, n: Needle) -> tuple[int, int]:
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        return v.write_needle(n)
+
+    def read_needle(self, vid: int, needle_id: int,
+                    cookie: int | None = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return v.read_needle(needle_id, cookie)
+
+    def delete_needle(self, vid: int, needle_id: int) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        return v.delete_needle(needle_id)
+
+    # -- heartbeat -----------------------------------------------------------
+
+    def _volume_info(self, v: Volume) -> VolumeInfo:
+        return VolumeInfo(
+            id=v.vid, collection=v.collection, size=v.dat_size(),
+            file_count=v.file_count(), delete_count=v.nm.metrics.deletion_count,
+            deleted_byte_count=v.deleted_size(), read_only=v.readonly,
+            replica_placement=v.super_block.replica_placement.to_byte(),
+            ttl=v.super_block.ttl.to_uint32(),
+            compact_revision=v.super_block.compaction_revision,
+            max_file_key=v.max_file_key(), version=v.version)
+
+    def collect_heartbeat(self) -> dict:
+        """Full heartbeat payload (CollectHeartbeat, store.go:198)."""
+        volumes = []
+        max_file_key = 0
+        with self._lock:
+            for loc in self.locations:
+                for v in loc.volumes.values():
+                    volumes.append(self._volume_info(v))
+                    max_file_key = max(max_file_key, v.max_file_key())
+        return {
+            "ip": self.ip,
+            "port": self.port,
+            "public_url": self.public_url,
+            "max_volume_count": sum(l.max_volume_count
+                                    for l in self.locations),
+            "max_file_key": max_file_key,
+            "volumes": volumes,
+        }
+
+    def drain_deltas(self) -> tuple[list[VolumeInfo], list[VolumeInfo]]:
+        with self._lock:
+            new, deleted = self.new_volumes, self.deleted_volumes
+            self.new_volumes, self.deleted_volumes = [], []
+            return new, deleted
+
+    def close(self) -> None:
+        for loc in self.locations:
+            loc.close()

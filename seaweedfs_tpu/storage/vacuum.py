@@ -1,0 +1,95 @@
+"""Vacuum (compaction): reclaim deleted space by copying live needles.
+
+Reference flow (weed/storage/volume_vacuum.go): Compact writes live records
+into `.cpd`/`.cpx` staging files; CommitCompact replays any records appended
+after the snapshot (makeupDiff), then atomically renames staging over the
+live files and reloads.  The superblock compaction revision increments so
+replicas can detect divergence.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..core import idx as idx_mod
+from ..core import types as t
+from ..core.needle import Needle
+from ..core.super_block import SuperBlock
+from .volume import Volume
+from .volume_scanner import scan_volume_file
+
+
+def compact(volume: Volume) -> int:
+    """Phase 1: copy live needles to .cpd/.cpx. Returns snapshot dat size.
+
+    The volume stays writable; records appended after the returned offset
+    are replayed by commit_compact.
+    """
+    base = volume.file_name()
+    volume.sync()
+    snapshot_size = volume.dat_size()
+
+    sb = SuperBlock(
+        version=volume.super_block.version,
+        replica_placement=volume.super_block.replica_placement,
+        ttl=volume.super_block.ttl,
+        compaction_revision=volume.super_block.compaction_revision + 1,
+        extra=volume.super_block.extra)
+
+    with open(base + ".cpd", "wb") as cpd, open(base + ".cpx", "wb") as cpx:
+        cpd.write(sb.to_bytes())
+        new_offset = cpd.tell()
+        for needle, offset, total in scan_volume_file(base + ".dat"):
+            if offset >= snapshot_size:
+                break
+            if needle.size <= 0:
+                continue
+            live = volume.nm.get(needle.id)
+            if live is None or live[0] != offset:
+                continue  # deleted or superseded
+            blob = needle.to_bytes(volume.version)
+            cpd.write(blob)
+            idx_mod.append_entry(cpx, needle.id, new_offset, needle.size)
+            new_offset += len(blob)
+    return snapshot_size
+
+
+def commit_compact(volume: Volume, snapshot_size: int) -> None:
+    """Phase 2: replay post-snapshot appends, swap files, reload the map."""
+    base = volume.file_name()
+    with volume._lock:
+        volume.sync()
+        # makeupDiff: replay records appended after the snapshot.
+        with open(base + ".cpd", "r+b") as cpd, \
+                open(base + ".cpx", "ab") as cpx:
+            cpd.seek(0, os.SEEK_END)
+            new_offset = cpd.tell()
+            for needle, _off, _total in scan_volume_file(
+                    base + ".dat", start_offset=snapshot_size):
+                if needle.size > 0:
+                    blob = needle.to_bytes(volume.version)
+                    cpd.write(blob)
+                    idx_mod.append_entry(cpx, needle.id, new_offset,
+                                         needle.size)
+                    new_offset += len(blob)
+                else:  # tombstone marker: propagate the delete
+                    idx_mod.append_entry(cpx, needle.id, 0,
+                                         t.TOMBSTONE_FILE_SIZE)
+        # Swap.
+        volume._dat.close()
+        volume.nm.close()
+        os.replace(base + ".cpd", base + ".dat")
+        os.replace(base + ".cpx", base + ".idx")
+        # Reload in place.
+        from .needle_map import MemoryNeedleMap
+        volume._dat = open(base + ".dat", "r+b")
+        volume.super_block = SuperBlock.from_bytes(volume._dat.read(64 * 1024))
+        volume.nm = MemoryNeedleMap.load(base + ".idx")
+        volume._dat.seek(0, os.SEEK_END)
+        volume._append_at = volume._dat.tell()
+
+
+def vacuum(volume: Volume) -> None:
+    """Compact + commit in one step (single-process convenience)."""
+    snapshot = compact(volume)
+    commit_compact(volume, snapshot)

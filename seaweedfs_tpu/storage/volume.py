@@ -1,0 +1,308 @@
+"""Volume engine: one append-only `.dat` + `.idx` pair.
+
+Mirrors the reference's Volume behavior (weed/storage/volume.go,
+volume_read_write.go) with its key design points kept:
+
+- append-only writes, 8-byte aligned records, offsets stored /8;
+- an async batched write worker: requests queue up and are written +
+  fsynced as one group (reference batches <=128 requests / 4MB then one
+  sync — volume_read_write.go:297-370);
+- O(1) reads: one map lookup then one pread;
+- deletes append a tombstone needle and a tombstone idx entry;
+- vacuum (volume_vacuum.py) copies live needles to `.cpd/.cpx` then
+  atomically swaps, bumping the superblock compaction revision.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass
+
+from ..core import types as t
+from ..core.needle import (CURRENT_VERSION, Needle, get_actual_size)
+from ..core.replica_placement import ReplicaPlacement
+from ..core.super_block import SUPER_BLOCK_SIZE, SuperBlock
+from ..core.ttl import TTL
+from .needle_map import MemoryNeedleMap
+
+MAX_BATCH_REQUESTS = 128
+MAX_BATCH_BYTES = 4 * 1024 * 1024
+
+
+class VolumeError(Exception):
+    pass
+
+
+class NotFoundError(VolumeError):
+    pass
+
+
+@dataclass
+class _WriteReq:
+    needle: Needle
+    done: threading.Event
+    offset: int = 0
+    size: int = 0
+    error: Exception | None = None
+
+
+class Volume:
+    """A single volume. Thread-safe; writes go through the batch worker."""
+
+    def __init__(self, dir_: str, collection: str, vid: int,
+                 replica_placement: ReplicaPlacement | None = None,
+                 ttl: TTL | None = None, create: bool = True,
+                 version: int = CURRENT_VERSION, use_worker: bool = True):
+        self.dir = dir_
+        self.collection = collection
+        self.vid = vid
+        self.readonly = False
+        self._lock = threading.RLock()
+        base = self.file_name()
+        exists = os.path.exists(base + ".dat")
+        if not exists and not create:
+            raise VolumeError(f"volume file {base}.dat not found")
+        if exists:
+            self._dat = open(base + ".dat", "r+b")
+            self.super_block = SuperBlock.from_bytes(
+                self._dat.read(SUPER_BLOCK_SIZE + 64 * 1024))
+        else:
+            self._dat = open(base + ".dat", "w+b")
+            self.super_block = SuperBlock(
+                version=version,
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl or TTL())
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+        self.nm = MemoryNeedleMap.load(base + ".idx")
+        self._dat.seek(0, os.SEEK_END)
+        self._append_at = self._dat.tell()
+        self.last_modified = time.time()
+
+        self._closed = False
+        self._use_worker = use_worker
+        self._queue: queue.Queue[_WriteReq | None] = queue.Queue(maxsize=1024)
+        self._worker = None
+        if use_worker:
+            self._worker = threading.Thread(
+                target=self._worker_loop, name=f"vol{vid}-writer", daemon=True)
+            self._worker.start()
+
+    # -- naming ------------------------------------------------------------
+
+    def file_name(self) -> str:
+        name = f"{self.collection}_{self.vid}" if self.collection else \
+            str(self.vid)
+        return os.path.join(self.dir, name)
+
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    # -- write path --------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        """Batch pending requests, write them, fsync once per batch."""
+        while True:
+            req = self._queue.get()
+            if req is None:
+                return
+            batch = [req]
+            bytes_est = len(req.needle.data)
+            while (len(batch) < MAX_BATCH_REQUESTS and
+                   bytes_est < MAX_BATCH_BYTES):
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._drain_batch(batch)
+                    return
+                batch.append(nxt)
+                bytes_est += len(nxt.needle.data)
+            self._drain_batch(batch)
+
+    def _drain_batch(self, batch: list[_WriteReq]) -> None:
+        """Write all records, fsync once, then publish map entries.
+
+        Publication order matters: needle-map entries become visible only
+        after the data is durable on the .dat fd, so a concurrent
+        read_needle (lock-free os.pread) can never observe a mapped offset
+        whose bytes haven't reached the OS yet.
+        """
+        with self._lock:
+            written: list[_WriteReq] = []
+            for req in batch:
+                try:
+                    off, size = self._write_record_locked(req.needle)
+                    req.offset, req.size = off, size
+                    written.append(req)
+                except Exception as e:  # noqa: BLE001 — propagate to waiter
+                    req.error = e
+            try:
+                self._dat.flush()
+                os.fsync(self._dat.fileno())
+            except Exception as e:  # noqa: BLE001
+                for req in batch:
+                    req.error = req.error or e
+                written = []
+            for req in written:
+                self.nm.put(req.needle.id, req.offset, req.needle.size)
+            self.nm.flush()
+            self.last_modified = time.time()
+        for req in batch:
+            req.done.set()
+
+    def _write_record_locked(self, n: Needle) -> tuple[int, int]:
+        """Append the record bytes (no map publication, no sync)."""
+        if self.readonly:
+            raise VolumeError(f"volume {self.vid} is read only")
+        offset = self._append_at
+        if offset % t.NEEDLE_PADDING_SIZE != 0:
+            # Self-heal like the reference: realign to the padding grid.
+            offset += t.NEEDLE_PADDING_SIZE - (offset % t.NEEDLE_PADDING_SIZE)
+            self._dat.truncate(offset)
+        if offset >= t.MAX_POSSIBLE_VOLUME_SIZE:
+            raise VolumeError(f"volume {self.vid} exceeds max size")
+        if n.append_at_ns == 0:
+            n.append_at_ns = time.time_ns()
+        blob = n.to_bytes(self.version)
+        self._dat.seek(offset)
+        self._dat.write(blob)
+        self._append_at = offset + len(blob)
+        return offset, n.size
+
+    def write_needle(self, n: Needle) -> tuple[int, int]:
+        """Append an object. Returns (offset, stored size). Blocks until the
+        record (and its batch) is fsynced."""
+        if self._closed:
+            raise VolumeError(f"volume {self.vid} is closed")
+        if not self._use_worker:
+            with self._lock:
+                off, size = self._write_record_locked(n)
+                self._dat.flush()
+                os.fsync(self._dat.fileno())
+                self.nm.put(n.id, off, n.size)
+                self.nm.flush()
+                self.last_modified = time.time()
+                return off, size
+        req = _WriteReq(needle=n, done=threading.Event())
+        self._queue.put(req)
+        if self._closed:
+            # close() may already have drained the queue; fail fast instead
+            # of waiting on a worker that will never run.
+            req.error = req.error or VolumeError(
+                f"volume {self.vid} is closed")
+            req.done.set()
+        req.done.wait()
+        if req.error:
+            raise req.error
+        return req.offset, req.size
+
+    def delete_needle(self, needle_id: int) -> int:
+        """Tombstone an object. Returns bytes freed (0 if absent).
+
+        Appends a zero-data needle (so the .dat replays the delete) and a
+        tombstone idx entry, mirroring doDeleteRequest
+        (volume_read_write.go).
+        """
+        with self._lock:
+            if self.readonly:
+                raise VolumeError(f"volume {self.vid} is read only")
+            entry = self.nm.get(needle_id)
+            if entry is None:
+                return 0
+            marker = Needle(cookie=0, id=needle_id, data=b"")
+            marker.append_at_ns = time.time_ns()
+            offset = self._append_at
+            blob = marker.to_bytes(self.version)
+            self._dat.seek(offset)
+            self._dat.write(blob)
+            self._append_at = offset + len(blob)
+            self._dat.flush()
+            # Publish the tombstone only after the marker bytes are flushed.
+            freed = self.nm.delete(needle_id)
+            self.nm.flush()
+            self.last_modified = time.time()
+            return freed
+
+    # -- read path ---------------------------------------------------------
+
+    def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
+        """One map lookup + one pread (the O(1) design point)."""
+        entry = self.nm.get(needle_id)
+        if entry is None:
+            raise NotFoundError(f"needle {needle_id:x} not found")
+        offset, size = entry
+        if not t.size_is_valid(size):
+            raise NotFoundError(f"needle {needle_id:x} deleted")
+        total = get_actual_size(size, self.version)
+        blob = os.pread(self._dat.fileno(), total, offset)
+        n = Needle.from_bytes(blob, self.version)
+        if cookie is not None and n.cookie != cookie:
+            raise VolumeError(
+                f"cookie mismatch for needle {needle_id:x}")
+        if n.has_ttl() and n.ttl.minutes() > 0 and n.has_last_modified_date():
+            if time.time() > n.last_modified + n.ttl.minutes() * 60:
+                raise NotFoundError(f"needle {needle_id:x} expired")
+        return n
+
+    # -- stats / lifecycle --------------------------------------------------
+
+    def content_size(self) -> int:
+        return self.nm.content_size()
+
+    def deleted_size(self) -> int:
+        return self.nm.deleted_size()
+
+    def file_count(self) -> int:
+        return len(self.nm)
+
+    def dat_size(self) -> int:
+        with self._lock:
+            return self._append_at
+
+    def garbage_ratio(self) -> float:
+        total = self.dat_size()
+        if total <= SUPER_BLOCK_SIZE:
+            return 0.0
+        return self.nm.deleted_size() / total
+
+    def max_file_key(self) -> int:
+        return self.nm.metrics.maximum_file_key
+
+    def set_readonly(self, ro: bool = True) -> None:
+        with self._lock:
+            self.readonly = ro
+
+    def sync(self) -> None:
+        with self._lock:
+            self._dat.flush()
+            os.fsync(self._dat.fileno())
+            self.nm.flush()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._worker is not None and self._worker.is_alive():
+            self._queue.put(None)
+            self._worker.join(timeout=5)
+            self._worker = None
+        # Fail any request that raced past the shutdown sentinel.
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.error = VolumeError(f"volume {self.vid} is closed")
+                req.done.set()
+        with self._lock:
+            try:
+                self._dat.flush()
+                self._dat.close()
+            except ValueError:
+                pass
+            self.nm.close()

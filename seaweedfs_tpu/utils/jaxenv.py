@@ -1,0 +1,40 @@
+"""JAX environment helpers.
+
+`force_cpu()` pins this process to the virtual-CPU backend and, crucially,
+unregisters the `axon` TPU PJRT plugin that the environment's sitecustomize
+installs at interpreter startup.  Without this, *any* jax API call dials
+the TPU tunnel — which serializes every process on the single chip grant
+(and hangs outright while another process holds it).  Tools, tests, and
+CLI paths that don't need the chip must call this before first jax use.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(device_count: int = 8) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{device_count}").strip()
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+        # sitecustomize may have imported jax already, latching the
+        # platform config; point it back at cpu.
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def on_tpu() -> bool:
+    try:
+        import jax
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False

@@ -2,32 +2,17 @@
 
 Multi-chip TPU hardware is not available in CI; sharding/collective logic is
 exercised on a virtual CPU mesh exactly as the driver's `dryrun_multichip`
-does.  Two things make the suite hermetic:
-
-1. JAX_PLATFORMS / XLA_FLAGS are forced (not defaulted — the environment
-   ships JAX_PLATFORMS=axon for the real chip) before jax initializes.
-2. The `axon` PJRT plugin (registered by sitecustomize at interpreter
-   startup) is dropped from jax's backend-factory registry; otherwise
-   jax.devices() would dial the TPU tunnel from every test process, which
-   both serializes on the single chip grant and hangs when the tunnel is
-   busy.  Tests must never depend on the real chip.
+does.  force_cpu also unregisters the axon TPU plugin that sitecustomize
+installs, so pytest never dials the TPU tunnel (which would serialize on
+the single chip grant and hang while it's held).  Tests must never depend
+on the real chip.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-try:
-    import jax
-    import jax._src.xla_bridge as _xb
+from seaweedfs_tpu.utils.jaxenv import force_cpu  # noqa: E402
 
-    _xb._backend_factories.pop("axon", None)
-    # sitecustomize imported jax before this conftest ran, so the
-    # jax_platforms config already latched "axon"; point it back at cpu.
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+force_cpu(device_count=8)
