@@ -66,14 +66,26 @@ class EcVolume:
         self._ecx = open(base_file_name + ".ecx", "r+b")
         self.ecx_size = os.path.getsize(base_file_name + ".ecx")
         self._ecj_lock = threading.Lock()
-        if version is None:
-            from .decoder import read_ec_volume_version
-            try:
-                version = read_ec_volume_version(base_file_name)
-            except FileNotFoundError:
-                version = 3
-        self.version = version
         self.load_local_shards()
+        if version is None:
+            version = self._detect_version()
+        self.version = version
+
+    def _detect_version(self) -> int:
+        """Volume version from the superblock (head of shard 0).
+
+        When .ec00 is missing locally (the degraded case this class
+        exists for), reconstruct shard 0's first bytes from survivors
+        rather than guessing — a wrong version mis-sizes every record.
+        """
+        from ..core.super_block import SuperBlock
+        from .decoder import read_ec_volume_version
+        try:
+            return read_ec_volume_version(self.base_file_name)
+        except FileNotFoundError:
+            pass
+        head = self._reconstruct_interval(0, 0, 64)
+        return SuperBlock.from_bytes(head).version
 
     # -- shard registry ----------------------------------------------------
 

@@ -35,14 +35,16 @@ from jax.experimental.pallas import tpu as pltpu
 BLOCK_N = 4096
 
 
-def _rs_kernel(b_ref, d_ref, o_ref, *, out_rows: int, in_rows: int):
+def _rs_kernel(b_ref, d_ref, o_ref, *, out_rows: int, in_rows: int,
+               mm_dtype):
     """One tile: bytes (in_rows, BN) -> bytes (out_rows, BN)."""
     x = d_ref[:].astype(jnp.int32)
     # Plane-major unpack: row s*k + j is bit s of shard j. Stays 2D.
     bits = jnp.concatenate(
-        [(x >> s) & 1 for s in range(8)], axis=0).astype(jnp.bfloat16)
-    acc = jnp.dot(b_ref[:], bits, preferred_element_type=jnp.float32)
-    pbits = acc.astype(jnp.int32) & 1  # sums <= 8k < 2^24: f32 exact
+        [(x >> s) & 1 for s in range(8)], axis=0).astype(mm_dtype)
+    acc_t = jnp.float32 if mm_dtype == jnp.bfloat16 else jnp.int32
+    acc = jnp.dot(b_ref[:], bits, preferred_element_type=acc_t)
+    pbits = acc.astype(jnp.int32) & 1  # sums <= 8k < 2^24: exact either way
     out = pbits[0:out_rows]
     for s in range(1, 8):
         out = out | (pbits[s * out_rows:(s + 1) * out_rows] << s)
@@ -50,28 +52,33 @@ def _rs_kernel(b_ref, d_ref, o_ref, *, out_rows: int, in_rows: int):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("out_rows", "in_rows", "interpret"))
+                   static_argnames=("out_rows", "in_rows", "interpret",
+                                    "block_n", "mm"))
 def apply_bitmatrix_pallas(bmat_pm: jax.Array, shards: jax.Array,
                            out_rows: int, in_rows: int,
-                           interpret: bool = False) -> jax.Array:
+                           interpret: bool = False,
+                           block_n: int = BLOCK_N,
+                           mm: str = "bf16") -> jax.Array:
     """(8*out_rows, 8*in_rows) plane-major bit matrix x (in_rows, n) bytes.
 
-    n must be a multiple of BLOCK_N (the file pipeline's buffers are);
+    n must be a multiple of block_n (the file pipeline's buffers are);
     `pad_to_block` below handles ragged tails.
     """
     n = shards.shape[1]
-    grid = (n // BLOCK_N,)
-    kernel = functools.partial(_rs_kernel, out_rows=out_rows, in_rows=in_rows)
+    grid = (n // block_n,)
+    mm_dtype = jnp.bfloat16 if mm == "bf16" else jnp.int8
+    kernel = functools.partial(_rs_kernel, out_rows=out_rows,
+                               in_rows=in_rows, mm_dtype=mm_dtype)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((8 * out_rows, 8 * in_rows), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((in_rows, BLOCK_N), lambda i: (0, i),
+            pl.BlockSpec((in_rows, block_n), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((out_rows, BLOCK_N), lambda i: (0, i),
+        out_specs=pl.BlockSpec((out_rows, block_n), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((out_rows, n), jnp.uint8),
         cost_estimate=pl.CostEstimate(
@@ -80,11 +87,11 @@ def apply_bitmatrix_pallas(bmat_pm: jax.Array, shards: jax.Array,
             transcendentals=0,
         ),
         interpret=interpret,
-    )(bmat_pm.astype(jnp.bfloat16), shards)
+    )(bmat_pm.astype(mm_dtype), shards)
 
 
-def pad_to_block(n: int) -> int:
-    return -(-n // BLOCK_N) * BLOCK_N
+def pad_to_block(n: int, block_n: int = BLOCK_N) -> int:
+    return -(-n // block_n) * block_n
 
 
 def _on_tpu() -> bool:
@@ -103,10 +110,16 @@ class PallasCoder:
 
     def __init__(self, data_shards: int = 10, parity_shards: int = 4,
                  matrix_kind: str = "vandermonde",
-                 interpret: bool | None = None):
+                 interpret: bool | None = None,
+                 block_n: int | None = None, mm: str | None = None):
+        import os
+
         from . import rs_bitmatrix
         from .coder_jax import plane_major
 
+        self.block_n = block_n or int(
+            os.environ.get("SEAWEEDFS_TPU_BLOCK_N", BLOCK_N))
+        self.mm = mm or os.environ.get("SEAWEEDFS_TPU_MM", "bf16")
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
@@ -122,12 +135,13 @@ class PallasCoder:
     def _apply(self, mat_pm: jax.Array, shards: jax.Array,
                out_rows: int) -> jax.Array:
         n = shards.shape[1]
-        padded = pad_to_block(n)
+        padded = pad_to_block(n, self.block_n)
         if padded != n:
             shards = jnp.pad(shards, ((0, 0), (0, padded - n)))
         out = apply_bitmatrix_pallas(mat_pm, shards, out_rows,
                                      self.data_shards,
-                                     interpret=self.interpret)
+                                     interpret=self.interpret,
+                                     block_n=self.block_n, mm=self.mm)
         return out[:, :n]
 
     def encode(self, data) -> jax.Array:

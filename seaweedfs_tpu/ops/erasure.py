@@ -5,10 +5,13 @@ The reference hides klauspost/reedsolomon behind direct calls in
 interface seam that picks a backend at startup.  Backends:
 
 - "numpy":  table-lookup oracle (always available, slow)
+- "native": C++ AVX2 PSHUFB kernels (klauspost-class CPU path; needs
+            `make -C native`)
 - "jax":    XLA bit-sliced matmul (any jax backend)
 - "pallas": fused MXU kernel (TPU; interpreter mode elsewhere)
 
-Selection: SEAWEEDFS_TPU_CODER env var, else pallas on TPU, else jax.
+Selection: SEAWEEDFS_TPU_CODER env var, else pallas on TPU, else native
+if built, else jax.
 All backends share the same API: encode / encode_all / reconstruct / verify,
 operating on (shards, n) uint8 arrays; results are byte-identical.
 """
@@ -33,7 +36,12 @@ class ErasureCoder(Protocol):
     def verify(self, shards) -> bool: ...
 
 
-_BACKENDS = ("numpy", "jax", "pallas")
+_BACKENDS = ("numpy", "native", "jax", "pallas")
+
+
+def _native_available() -> bool:
+    from ..utils import native as native_mod
+    return native_mod.load() is not None
 
 
 def default_backend() -> str:
@@ -47,9 +55,11 @@ def default_backend() -> str:
         import jax
         if jax.devices()[0].platform == "tpu":
             return "pallas"
+        if _native_available():
+            return "native"
         return "jax"
     except Exception:
-        return "numpy"
+        return "native" if _native_available() else "numpy"
 
 
 def new_coder(data_shards: int = 10, parity_shards: int = 4,
@@ -59,6 +69,9 @@ def new_coder(data_shards: int = 10, parity_shards: int = 4,
     if backend == "numpy":
         from .coder_numpy import NumpyCoder
         return NumpyCoder(data_shards, parity_shards, matrix_kind)
+    if backend == "native":
+        from .coder_native import NativeCoder
+        return NativeCoder(data_shards, parity_shards, matrix_kind)
     if backend == "jax":
         from .coder_jax import JaxCoder
         return JaxCoder(data_shards, parity_shards, matrix_kind)

@@ -1,0 +1,11 @@
+"""Multi-chip scaling: mesh-sharded erasure coding with XLA collectives.
+
+Maps the reference's distributed mechanisms onto a TPU pod mesh
+(SURVEY.md §2.3 table):
+
+- replica/shard spread across volume servers  -> mesh axes over chips
+- parallel remote-shard fetch for reconstruction (store_ec.go:322)
+  -> `lax.all_to_all` resharding of survivor rows over ICI
+- batched multi-volume rebuild (shell ec.rebuild over many volumes)
+  -> one pjit'd batched GF(2) matmul, volumes data-parallel over the mesh
+"""

@@ -1,0 +1,38 @@
+"""Mesh construction helpers.
+
+Axes:
+- "vol":  data-parallel over volumes (batched encode/rebuild)
+- "col":  byte-column parallelism within a volume (the long-context analog:
+  one huge byte-stream split across chips, like sequence/context
+  parallelism splits a long sequence)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None, vol_axis: int | None = None
+              ) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if vol_axis is None:
+        # Favor volume-parallelism; fall back to column splits.
+        vol_axis = n
+    col_axis = n // vol_axis
+    grid = np.array(devices).reshape(vol_axis, col_axis)
+    return Mesh(grid, axis_names=("vol", "col"))
+
+
+def volume_sharding(mesh: Mesh) -> NamedSharding:
+    """(V, k, N) batched volumes: V over "vol", N over "col"."""
+    return NamedSharding(mesh, P("vol", None, "col"))
+
+
+def shard_row_sharding(mesh: Mesh) -> NamedSharding:
+    """(V, S, N) survivor stacks with shard rows S over "col" — the layout
+    where each chip holds whole shards (as hosts do in the cluster)."""
+    return NamedSharding(mesh, P("vol", "col", None))

@@ -1,0 +1,153 @@
+"""Mesh-sharded batched RS coding — the pod-scale EC engine.
+
+Three entry points, all jittable over a `jax.sharding.Mesh`:
+
+- `batched_encode`:     (V, k, N) -> (V, p, N) parity for V volumes at once.
+  Volumes shard over "vol", byte columns over "col"; zero collectives.
+
+- `batched_reconstruct`: (V, S, N) survivor stacks -> (V, W, N) rebuilt
+  shards, same sharding story (the driver for `ec.rebuild` of many volumes
+  — BASELINE config #3: 256 volumes on a v5e-8).
+
+- `all_to_all_reconstruct`: survivors laid out shard-major (each chip holds
+  whole shard rows, as hosts do in a cluster), internally resharded to
+  column-major over ICI with `lax.all_to_all` — the SPMD equivalent of the
+  reference's parallel remote-shard fetch (store_ec.go:322-376) — then
+  decoded locally.  This is the design that scales to pod slices: the
+  gather rides ICI, the matmul rides the MXU.
+
+All paths share the plane-major GF(2) bit-matmul from ops/coder_jax.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import rs_bitmatrix
+from ..ops.coder_jax import apply_bitmatrix, plane_major
+
+
+def _parity_pm(data_shards: int, parity_shards: int,
+               kind: str = "vandermonde") -> np.ndarray:
+    pb = rs_bitmatrix.parity_bitmatrix(
+        data_shards, data_shards + parity_shards, kind)
+    return plane_major(pb, parity_shards, data_shards)
+
+
+@functools.partial(jax.jit, static_argnames=("parity_shards",))
+def _encode_batch(bmat_pm, data, parity_shards: int):
+    return jax.vmap(lambda d: apply_bitmatrix(bmat_pm, d, parity_shards))(data)
+
+
+def batched_encode(data, mesh: Mesh | None = None,
+                   data_shards: int = 10, parity_shards: int = 4,
+                   matrix_kind: str = "vandermonde"):
+    """(V, data_shards, N) uint8 -> (V, parity_shards, N) parity.
+
+    With a mesh, inputs are placed (vol, None, col)-sharded so each chip
+    encodes its own volume/column block — no cross-chip traffic.
+    """
+    bmat = jnp.asarray(_parity_pm(data_shards, parity_shards, matrix_kind),
+                       jnp.bfloat16)
+    data = jnp.asarray(data, jnp.uint8)
+    if mesh is not None:
+        data = jax.device_put(
+            data, NamedSharding(mesh, P("vol", None, "col")))
+    return _encode_batch(bmat, data, parity_shards)
+
+
+@functools.partial(jax.jit, static_argnames=("wanted_count",))
+def _reconstruct_batch(bmat_pm, stacked, wanted_count: int):
+    return jax.vmap(
+        lambda s: apply_bitmatrix(bmat_pm, s, wanted_count))(stacked)
+
+
+def batched_reconstruct(stacked, present: tuple[int, ...],
+                        wanted: tuple[int, ...],
+                        mesh: Mesh | None = None,
+                        data_shards: int = 10, parity_shards: int = 4,
+                        matrix_kind: str = "vandermonde"):
+    """Rebuild `wanted` shards for V volumes that all lost the same shards.
+
+    stacked: (V, data_shards, N) — the first `data_shards` surviving shards
+    (sorted by id) for each volume, i.e. `decode_matrix`'s `used` rows.
+    Returns (V, len(wanted), N).
+    """
+    total = data_shards + parity_shards
+    bmat, used = rs_bitmatrix.decode_bitmatrix(
+        data_shards, total, tuple(present), tuple(wanted), matrix_kind)
+    pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted), data_shards),
+                     jnp.bfloat16)
+    stacked = jnp.asarray(stacked, jnp.uint8)
+    if stacked.shape[1] != data_shards:
+        raise ValueError(
+            f"stacked must carry the {data_shards} used survivor rows "
+            f"({[int(u) for u in used]}), got {stacked.shape[1]}")
+    if mesh is not None:
+        stacked = jax.device_put(
+            stacked, NamedSharding(mesh, P("vol", None, "col")))
+    return _reconstruct_batch(pm, stacked, len(wanted))
+
+
+def all_to_all_reconstruct(stacked, present: tuple[int, ...],
+                           wanted: tuple[int, ...], mesh: Mesh,
+                           data_shards: int = 10, parity_shards: int = 4,
+                           matrix_kind: str = "vandermonde"):
+    """Reconstruction when survivors live shard-major on the mesh.
+
+    stacked: (V, data_shards, N) placed with the *shard* axis sharded over
+    the mesh's "col" axis — each chip holds complete rows (= whole shards),
+    the cluster-natural layout after DMAing shards from their home hosts.
+    Internally `lax.all_to_all` swaps shard-axis for column-axis over ICI
+    (every chip sends each other chip its rows' slice of their columns),
+    then each chip solves its column block locally and the output comes
+    back column-sharded.
+    """
+    total = data_shards + parity_shards
+    bmat, _used = rs_bitmatrix.decode_bitmatrix(
+        data_shards, total, tuple(present), tuple(wanted), matrix_kind)
+    pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted), data_shards),
+                     jnp.bfloat16)
+
+    n_shard_chips = mesh.shape["col"]
+    if data_shards % n_shard_chips != 0:
+        raise ValueError(
+            f"data_shards {data_shards} must divide over mesh col axis "
+            f"{n_shard_chips}")
+
+    stacked = jnp.asarray(stacked, jnp.uint8)
+    v, s, n = stacked.shape
+    if s != data_shards:
+        raise ValueError(
+            f"stacked must carry the {data_shards} used survivor rows, "
+            f"got {s}")
+    if n % n_shard_chips != 0:
+        raise ValueError(f"byte length {n} must divide over {n_shard_chips}")
+    stacked = jax.device_put(
+        stacked, NamedSharding(mesh, P("vol", "col", None)))
+
+    wanted_count = len(wanted)
+
+    def local(block):  # block: (v_loc, s/D, N) on each chip
+        # Reshard: split columns D-ways, trade shard rows for column blocks.
+        v_loc, s_loc, n_full = block.shape
+        chunk = n_full // n_shard_chips
+        parts = block.reshape(v_loc, s_loc, n_shard_chips, chunk)
+        # all_to_all: concat shard axis, split column axis. -> (v, s, chunk)
+        gathered = jax.lax.all_to_all(
+            parts, "col", split_axis=2, concat_axis=1, tiled=False)
+        gathered = gathered.reshape(v_loc, s, chunk)
+        out = jax.vmap(
+            lambda x: apply_bitmatrix(pm, x, wanted_count))(gathered)
+        return out  # (v_loc, wanted, chunk) — column-sharded result
+
+    fn = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=P("vol", "col", None),
+        out_specs=P("vol", None, "col")))
+    return fn(stacked)

@@ -55,9 +55,13 @@ def compact(volume: Volume) -> int:
 
 
 def commit_compact(volume: Volume, snapshot_size: int) -> None:
-    """Phase 2: replay post-snapshot appends, swap files, reload the map."""
+    """Phase 2: replay post-snapshot appends, swap files, reload the map.
+
+    Holds the volume's file lock in write mode for the whole swap so
+    lock-free readers can never pread a closed fd or stale offsets.
+    """
     base = volume.file_name()
-    with volume._lock:
+    with volume._file_lock.write(), volume._lock:
         volume.sync()
         # makeupDiff: replay records appended after the snapshot.
         with open(base + ".cpd", "r+b") as cpd, \

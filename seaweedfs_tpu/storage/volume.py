@@ -26,6 +26,7 @@ from ..core.needle import (CURRENT_VERSION, Needle, get_actual_size)
 from ..core.replica_placement import ReplicaPlacement
 from ..core.super_block import SUPER_BLOCK_SIZE, SuperBlock
 from ..core.ttl import TTL
+from ..utils.rwlock import RWLock
 from .needle_map import MemoryNeedleMap
 
 MAX_BATCH_REQUESTS = 128
@@ -61,6 +62,10 @@ class Volume:
         self.vid = vid
         self.readonly = False
         self._lock = threading.RLock()
+        # Readers-writer discipline like the reference's dataFileAccessLock:
+        # concurrent preads; exclusive for write batches and the vacuum
+        # file swap.
+        self._file_lock = RWLock()
         base = self.file_name()
         exists = os.path.exists(base + ".dat")
         if not exists and not create:
@@ -133,28 +138,33 @@ class Volume:
         read_needle (lock-free os.pread) can never observe a mapped offset
         whose bytes haven't reached the OS yet.
         """
-        with self._lock:
-            written: list[_WriteReq] = []
-            for req in batch:
-                try:
-                    off, size = self._write_record_locked(req.needle)
-                    req.offset, req.size = off, size
-                    written.append(req)
-                except Exception as e:  # noqa: BLE001 — propagate to waiter
-                    req.error = e
-            try:
-                self._dat.flush()
-                os.fsync(self._dat.fileno())
-            except Exception as e:  # noqa: BLE001
+        try:
+            with self._file_lock.write(), self._lock:
+                written: list[_WriteReq] = []
                 for req in batch:
-                    req.error = req.error or e
-                written = []
-            for req in written:
-                self.nm.put(req.needle.id, req.offset, req.needle.size)
-            self.nm.flush()
-            self.last_modified = time.time()
-        for req in batch:
-            req.done.set()
+                    try:
+                        off, size = self._write_record_locked(req.needle)
+                        req.offset, req.size = off, size
+                        written.append(req)
+                    except Exception as e:  # noqa: BLE001 — to the waiter
+                        req.error = e
+                try:
+                    self._dat.flush()
+                    os.fsync(self._dat.fileno())
+                    for req in written:
+                        self.nm.put(req.needle.id, req.offset,
+                                    req.needle.size)
+                    self.nm.flush()
+                except Exception as e:  # noqa: BLE001
+                    for req in batch:
+                        req.error = req.error or e
+                self.last_modified = time.time()
+        except Exception as e:  # noqa: BLE001 — never strand the waiters
+            for req in batch:
+                req.error = req.error or e
+        finally:
+            for req in batch:
+                req.done.set()
 
     def _write_record_locked(self, n: Needle) -> tuple[int, int]:
         """Append the record bytes (no map publication, no sync)."""
@@ -209,7 +219,7 @@ class Volume:
         tombstone idx entry, mirroring doDeleteRequest
         (volume_read_write.go).
         """
-        with self._lock:
+        with self._file_lock.write(), self._lock:
             if self.readonly:
                 raise VolumeError(f"volume {self.vid} is read only")
             entry = self.nm.get(needle_id)
@@ -232,15 +242,20 @@ class Volume:
     # -- read path ---------------------------------------------------------
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
-        """One map lookup + one pread (the O(1) design point)."""
-        entry = self.nm.get(needle_id)
-        if entry is None:
-            raise NotFoundError(f"needle {needle_id:x} not found")
-        offset, size = entry
-        if not t.size_is_valid(size):
-            raise NotFoundError(f"needle {needle_id:x} deleted")
-        total = get_actual_size(size, self.version)
-        blob = os.pread(self._dat.fileno(), total, offset)
+        """One map lookup + one pread (the O(1) design point).
+
+        Takes the file lock in read mode so vacuum's fd swap can't close
+        the fd mid-pread; readers run concurrently with each other.
+        """
+        with self._file_lock.read():
+            entry = self.nm.get(needle_id)
+            if entry is None:
+                raise NotFoundError(f"needle {needle_id:x} not found")
+            offset, size = entry
+            if not t.size_is_valid(size):
+                raise NotFoundError(f"needle {needle_id:x} deleted")
+            total = get_actual_size(size, self.version)
+            blob = os.pread(self._dat.fileno(), total, offset)
         n = Needle.from_bytes(blob, self.version)
         if cookie is not None and n.cookie != cookie:
             raise VolumeError(
