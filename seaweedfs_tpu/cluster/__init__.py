@@ -1,0 +1,2 @@
+"""Cluster roles: master, volume servers, clients — the reference's
+server/gateway layers over an HTTP/JSON control plane."""

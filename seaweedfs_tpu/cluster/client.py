@@ -1,0 +1,133 @@
+"""Client operations: assign / upload / download / delete, with a
+volume-location cache — the wdclient + operation packages of the reference
+(weed/wdclient/masterclient.go vidMap, weed/operation/).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..core import types as t
+from . import rpc
+
+
+class VidCache:
+    """vid -> locations with TTL + round-robin reads (wdclient/vid_map.go)."""
+
+    def __init__(self, ttl_seconds: float = 60.0):
+        self.ttl = ttl_seconds
+        self._m: dict[int, tuple[float, list[dict]]] = {}
+        self._rr: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def get(self, vid: int) -> list[dict] | None:
+        with self._lock:
+            hit = self._m.get(vid)
+            if hit is None or time.time() - hit[0] > self.ttl:
+                return None
+            return hit[1]
+
+    def put(self, vid: int, locations: list[dict]) -> None:
+        with self._lock:
+            self._m[vid] = (time.time(), locations)
+
+    def forget(self, vid: int) -> None:
+        with self._lock:
+            self._m.pop(vid, None)
+
+    def pick(self, vid: int) -> dict | None:
+        locs = self.get(vid)
+        if not locs:
+            return None
+        with self._lock:
+            i = self._rr.get(vid, 0)
+            self._rr[vid] = i + 1
+        return locs[i % len(locs)]
+
+
+class WeedClient:
+    def __init__(self, master_url: str):
+        self.master_url = master_url.rstrip("/")
+        self.cache = VidCache()
+
+    # -- master ops ----------------------------------------------------------
+
+    def assign(self, count: int = 1, collection: str = "",
+               replication: str | None = None, ttl: str = "",
+               data_center: str = "") -> dict:
+        q = [f"count={count}"]
+        if collection:
+            q.append(f"collection={collection}")
+        if replication is not None:
+            q.append(f"replication={replication}")
+        if ttl:
+            q.append(f"ttl={ttl}")
+        if data_center:
+            q.append(f"dataCenter={data_center}")
+        return rpc.call(f"{self.master_url}/dir/assign?" + "&".join(q))
+
+    def lookup(self, vid: int) -> list[dict]:
+        cached = self.cache.get(vid)
+        if cached is not None:
+            return cached
+        resp = rpc.call(f"{self.master_url}/dir/lookup?volumeId={vid}")
+        locs = resp.get("locations", [])
+        if locs:
+            self.cache.put(vid, locs)
+        return locs
+
+    # -- object ops ----------------------------------------------------------
+
+    def upload_data(self, data: bytes, collection: str = "",
+                    replication: str | None = None, ttl: str = "",
+                    name: str = "") -> str:
+        """Assign + PUT. Returns the fid."""
+        a = self.assign(collection=collection, replication=replication,
+                        ttl=ttl)
+        fid = a["fid"]
+        url = f"http://{a['url']}/{fid}"
+        if name:
+            url += f"?name={name}"
+        rpc.call(url, "POST", data)
+        return fid
+
+    def download(self, fid: str) -> bytes:
+        vid, _key, _cookie = t.parse_file_id(fid)
+        locs = self.lookup(vid)
+        if not locs:
+            raise rpc.RpcError(404, f"volume {vid} has no locations")
+        last_err: Exception | None = None
+        # Round-robin across replicas (vid_map.go's read balancing).
+        with self.cache._lock:
+            start = self.cache._rr.get(vid, 0)
+            self.cache._rr[vid] = start + 1
+        for i in range(len(locs)):
+            loc = locs[(start + i) % len(locs)]
+            try:
+                out = rpc.call(f"http://{loc['url']}/{fid}")
+                assert isinstance(out, (bytes, bytearray))
+                return bytes(out)
+            except rpc.RpcError as e:
+                last_err = e
+                if e.status == 404 and "volume" in e.message:
+                    self.cache.forget(vid)
+            except OSError as e:  # dead server: fail over to next replica
+                last_err = e
+                self.cache.forget(vid)
+        raise last_err or rpc.RpcError(404, "not found")
+
+    def delete(self, fid: str) -> None:
+        vid, _key, _cookie = t.parse_file_id(fid)
+        locs = self.lookup(vid)
+        if not locs:
+            raise rpc.RpcError(404, f"volume {vid} has no locations")
+        rpc.call(f"http://{locs[0]['url']}/{fid}", "DELETE")
+
+    def submit(self, data: bytes, **kw) -> dict:
+        """upload + return {fid, size, url} (operation/submit.go)."""
+        fid = self.upload_data(data, **kw)
+        vid, _, _ = t.parse_file_id(fid)
+        locs = self.lookup(vid)
+        return {"fid": fid, "size": len(data),
+                "url": locs[0]["url"] if locs else ""}

@@ -1,0 +1,285 @@
+"""Master server: topology keeper, id assigner, growth/vacuum orchestrator.
+
+HTTP surface mirrors the reference master's API
+(weed/server/master_server.go, master_grpc_server_volume.go):
+
+  POST /heartbeat            volume-server full/delta state (SendHeartbeat)
+  GET  /dir/assign           Assign: grow-on-demand then PickForWrite
+  GET  /dir/lookup?volumeId= locations for a volume (or EC shards)
+  GET  /dir/status           topology snapshot
+  POST /vol/grow             explicit growth
+  POST /vol/vacuum           force a vacuum scan
+  GET  /col/list, POST /col/delete
+  GET  /cluster/status
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..core.replica_placement import ReplicaPlacement
+from ..core.ttl import TTL
+from ..storage.store import VolumeInfo
+from ..topology.topology import Topology, VolumeGrowOption
+from ..topology.volume_growth import VolumeGrowth
+from . import rpc
+
+
+def _vinfo_from_dict(d: dict) -> VolumeInfo:
+    return VolumeInfo(
+        id=d["id"], collection=d.get("collection", ""),
+        size=d.get("size", 0), file_count=d.get("file_count", 0),
+        delete_count=d.get("delete_count", 0),
+        deleted_byte_count=d.get("deleted_byte_count", 0),
+        read_only=d.get("read_only", False),
+        replica_placement=d.get("replica_placement", 0),
+        ttl=d.get("ttl", 0), compact_revision=d.get("compact_revision", 0),
+        max_file_key=d.get("max_file_key", 0),
+        version=d.get("version", 3))
+
+
+def vinfo_to_dict(v: VolumeInfo) -> dict:
+    return {
+        "id": v.id, "collection": v.collection, "size": v.size,
+        "file_count": v.file_count, "delete_count": v.delete_count,
+        "deleted_byte_count": v.deleted_byte_count,
+        "read_only": v.read_only,
+        "replica_placement": v.replica_placement, "ttl": v.ttl,
+        "compact_revision": v.compact_revision,
+        "max_file_key": v.max_file_key, "version": v.version,
+    }
+
+
+class MasterServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 volume_size_limit_mb: int = 30 * 1024,
+                 default_replication: str = "000",
+                 pulse_seconds: int = 5,
+                 garbage_threshold: float = 0.3,
+                 meta_dir: str | None = None):
+        seq_path = f"{meta_dir}/seq.dat" if meta_dir else None
+        from ..topology.sequence import MemorySequencer
+        self.topo = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            sequencer=MemorySequencer(seq_path),
+            pulse_seconds=pulse_seconds)
+        self.vg = VolumeGrowth()
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.server = rpc.JsonHttpServer(host, port)
+        s = self.server
+        s.route("POST", "/heartbeat", self._heartbeat)
+        s.route("GET", "/dir/assign", self._assign)
+        s.route("POST", "/dir/assign", self._assign)
+        s.route("GET", "/dir/lookup", self._lookup)
+        s.route("GET", "/dir/status", self._status)
+        s.route("POST", "/vol/grow", self._grow)
+        s.route("POST", "/vol/vacuum", self._vacuum)
+        s.route("GET", "/col/list", self._col_list)
+        s.route("POST", "/col/delete", self._col_delete)
+        s.route("GET", "/cluster/status", self._cluster_status)
+        self._grow_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sweeper = threading.Thread(target=self._sweep_loop,
+                                         daemon=True, name="master-sweep")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.start()
+        self._sweeper.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.server.stop()
+
+    def url(self) -> str:
+        return self.server.url()
+
+    # -- handlers -----------------------------------------------------------
+
+    def _heartbeat(self, query: dict, body: bytes) -> dict:
+        import json
+        hb = json.loads(body)
+        dn = self.topo.register_data_node(
+            hb.get("data_center", "DefaultDataCenter"),
+            hb.get("rack", "DefaultRack"),
+            hb["ip"], hb["port"], hb.get("public_url", ""),
+            hb.get("max_volume_count", 7))
+        if "volumes" in hb:  # full sync
+            volumes = [_vinfo_from_dict(v) for v in hb["volumes"]]
+            self.topo.sync_data_node_registration(volumes, dn)
+        else:  # delta
+            self.topo.incremental_sync(
+                [_vinfo_from_dict(v) for v in hb.get("new_volumes", [])],
+                [_vinfo_from_dict(v) for v in hb.get("deleted_volumes", [])],
+                dn)
+        if "ec_shards" in hb:
+            self.topo.sync_data_node_ec_shards(
+                [(e["id"], e.get("collection", ""), e["shard_bits"])
+                 for e in hb["ec_shards"]], dn)
+        return {"volume_size_limit": self.topo.volume_size_limit}
+
+    def _option_from_query(self, query: dict) -> VolumeGrowOption:
+        return VolumeGrowOption(
+            collection=query.get("collection", ""),
+            replica_placement=query.get("replication",
+                                        self.default_replication),
+            ttl=query.get("ttl", ""),
+            data_center=query.get("dataCenter", ""),
+            rack=query.get("rack", ""),
+            data_node=query.get("dataNode", ""))
+
+    def _assign(self, query: dict, body: bytes) -> dict:
+        option = self._option_from_query(query)
+        count = int(query.get("count", 1))
+        if not self.topo.has_writable_volume(option):
+            with self._grow_lock:
+                if not self.topo.has_writable_volume(option):
+                    grown = self.vg.grow_by_type(self.topo, option,
+                                                 self._allocate_volume)
+                    if grown == 0:
+                        raise rpc.RpcError(
+                            406, "no free volumes and cannot grow")
+        fid, count, locs = self.topo.pick_for_write(count, option)
+        dn = locs[0]
+        return {"fid": fid, "count": count,
+                "url": dn.url(), "publicUrl": dn.public_url,
+                "replicas": [{"url": n.url(), "publicUrl": n.public_url}
+                             for n in locs[1:]]}
+
+    def _allocate_volume(self, vid: int, option: VolumeGrowOption,
+                         server) -> None:
+        rpc.call_json(
+            f"http://{server.url()}/admin/assign_volume",
+            payload={"volume": vid, "collection": option.collection,
+                     "replication": option.replica_placement,
+                     "ttl": option.ttl})
+        # Optimistic registration; the next heartbeat confirms.
+        self.topo.register_volume(VolumeInfo(
+            id=vid, collection=option.collection, size=0, file_count=0,
+            delete_count=0, deleted_byte_count=0, read_only=False,
+            replica_placement=ReplicaPlacement.parse(
+                option.replica_placement).to_byte(),
+            ttl=TTL.parse(option.ttl).to_uint32(),
+            compact_revision=0), server)
+
+    def _lookup(self, query: dict, body: bytes) -> dict:
+        vid_str = query.get("volumeId", "")
+        if "," in vid_str:
+            vid_str = vid_str.split(",")[0]
+        vid = int(vid_str)
+        collection = query.get("collection", "")
+        locs = self.topo.lookup(collection, vid)
+        if locs:
+            return {"volumeId": vid, "locations": [
+                {"url": dn.url(), "publicUrl": dn.public_url}
+                for dn in locs]}
+        ec = self.topo.lookup_ec_shards(vid)
+        if ec is not None:
+            return {"volumeId": vid, "ecShards": {
+                str(sid): [{"url": dn.url(), "publicUrl": dn.public_url}
+                           for dn in dns]
+                for sid, dns in ec.locations.items() if dns}}
+        raise rpc.RpcError(404, f"volume {vid} not found")
+
+    def _status(self, query: dict, body: bytes) -> dict:
+        def node_dict(n):
+            out = {"id": n.id, "volumes": n.volume_count,
+                   "max": n.max_volume_count, "free": n.free_space(),
+                   "ecShards": n.ec_shard_count}
+            if n.children:
+                out["children"] = [node_dict(c)
+                                   for c in n.children.values()]
+            return out
+        return {"topology": node_dict(self.topo),
+                "max_volume_id": self.topo.max_volume_id}
+
+    def _grow(self, query: dict, body: bytes) -> dict:
+        option = self._option_from_query(query)
+        count = int(query.get("count", 0)) or None
+        with self._grow_lock:
+            grown = self.vg.grow_by_type(self.topo, option,
+                                         self._allocate_volume,
+                                         ) if count is None else \
+                self._grow_n(option, count)
+        return {"count": grown}
+
+    def _grow_n(self, option: VolumeGrowOption, n: int) -> int:
+        grown = 0
+        for _ in range(n):
+            try:
+                servers = self.vg.find_empty_slots_for_one_volume(
+                    self.topo, option)
+            except ValueError:
+                break
+            vid = self.topo.next_volume_id()
+            try:
+                for server in servers:
+                    self._allocate_volume(vid, option, server)
+            except Exception:  # noqa: BLE001 — a dead server shouldn't
+                continue       # void the volumes grown so far
+            grown += 1
+        return grown
+
+    def _col_list(self, query: dict, body: bytes) -> dict:
+        return {"collections": sorted(self.topo.collections)}
+
+    def _col_delete(self, query: dict, body: bytes) -> dict:
+        name = query.get("collection", "")
+        col = self.topo.collections.get(name)
+        if col is None:
+            raise rpc.RpcError(404, f"collection {name!r} not found")
+        # Tell every server holding its volumes to delete them.
+        deleted = 0
+        for vl in col.layouts.values():
+            for vid, dns in list(vl.vid2location.items()):
+                for dn in dns:
+                    try:
+                        rpc.call_json(
+                            f"http://{dn.url()}/admin/delete_volume",
+                            payload={"volume": vid})
+                        deleted += 1
+                    except rpc.RpcError:
+                        pass
+        self.topo.delete_collection(name)
+        return {"deleted_replicas": deleted}
+
+    def _cluster_status(self, query: dict, body: bytes) -> dict:
+        return {"leader": self.url(), "is_leader": True,
+                "volume_size_limit": self.topo.volume_size_limit}
+
+    # -- vacuum orchestration ------------------------------------------------
+
+    def _vacuum(self, query: dict, body: bytes) -> dict:
+        threshold = float(query.get("garbageThreshold",
+                                    self.garbage_threshold))
+        return {"vacuumed": self._run_vacuum_scan(threshold)}
+
+    def _run_vacuum_scan(self, threshold: float) -> list[int]:
+        """Ask each node for garbage ratios; vacuum replicas over threshold
+        (reference: topology/topology_vacuum.go)."""
+        vacuumed = []
+        for dn in list(self.topo.leaves()):
+            try:
+                status = rpc.call_json(f"http://{dn.url()}/admin/status",
+                                       payload={})
+            except Exception:  # noqa: BLE001
+                continue
+            for v in status.get("volumes", []):
+                if v.get("garbage_ratio", 0) > threshold:
+                    try:
+                        rpc.call_json(
+                            f"http://{dn.url()}/admin/vacuum",
+                            payload={"volume": v["id"]})
+                        vacuumed.append(v["id"])
+                    except rpc.RpcError:
+                        pass
+        return vacuumed
+
+    def _sweep_loop(self) -> None:
+        """Dead-node detection (CollectDeadNodeAndFullVolumes)."""
+        while not self._stop.wait(self.topo.pulse_seconds):
+            for dn in self.topo.collect_dead_nodes():
+                self.topo.unregister_data_node(dn)

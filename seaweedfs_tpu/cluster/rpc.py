@@ -1,0 +1,167 @@
+"""HTTP/JSON control plane.
+
+The reference runs gRPC (control) + HTTP (data) between roles
+(weed/pb/*.proto, SURVEY §2.4).  This build keeps the same service shapes
+— Assign/Lookup/heartbeat/allocate/EC RPCs with the same field names — but
+carries them as JSON over HTTP on a threading server: zero-dependency,
+debuggable with curl, and swappable for gRPC later without touching the
+handlers.  The bulk EC compute plane is jax collectives (parallel/), not
+these RPCs.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+
+class RpcError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class JsonHttpServer:
+    """Route table -> threading HTTP server.
+
+    Handlers: fn(query: dict, body: bytes) -> dict | bytes | tuple.
+    Returning bytes sends application/octet-stream; a (status, dict)
+    tuple sets the status code.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port or free_port()
+        self.routes: dict[tuple[str, str], Callable] = {}
+        self.prefix_routes: list[tuple[str, str, Callable]] = []
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def route(self, method: str, path: str, fn: Callable) -> None:
+        self.routes[(method, path)] = fn
+
+    def prefix_route(self, method: str, prefix: str, fn: Callable) -> None:
+        """fn(path, query, body) for paths starting with prefix."""
+        self.prefix_routes.append((method, prefix, fn))
+
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _dispatch(self, method: str):
+                parsed = urllib.parse.urlparse(self.path)
+                query = {k: v[0] for k, v in
+                         urllib.parse.parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                fn = server.routes.get((method, parsed.path))
+                args = (query, body)
+                if fn is None:
+                    for m, prefix, pfn in server.prefix_routes:
+                        if m == method and parsed.path.startswith(prefix):
+                            fn = pfn
+                            args = (parsed.path, query, body)
+                            break
+                if fn is None:
+                    self._send(404, {"error": f"no route {method} "
+                                              f"{parsed.path}"})
+                    return
+                try:
+                    result = fn(*args)
+                except RpcError as e:
+                    self._send(e.status, {"error": e.message})
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                if isinstance(result, tuple):
+                    status, payload = result
+                else:
+                    status, payload = 200, result
+                self._send(status, payload)
+
+            def _send(self, status: int, payload):
+                if isinstance(payload, (bytes, bytearray)):
+                    data = bytes(payload)
+                    ctype = "application/octet-stream"
+                else:
+                    data = json.dumps(payload or {}).encode()
+                    ctype = "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name=f"http:{self.port}",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def call(url: str, method: str = "GET", body: bytes | None = None,
+         timeout: float = 10.0):
+    """HTTP call returning parsed JSON (dict) or raw bytes."""
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            data = resp.read()
+            if resp.headers.get("Content-Type", "").startswith(
+                    "application/json"):
+                return json.loads(data or b"{}")
+            return data
+    except urllib.error.HTTPError as e:
+        try:
+            message = json.loads(e.read() or b"{}").get("error", str(e))
+        except Exception:  # noqa: BLE001
+            message = str(e)
+        raise RpcError(e.code, message) from None
+
+
+def call_json(url: str, method: str = "POST", payload: dict | None = None,
+              timeout: float = 10.0) -> dict:
+    body = json.dumps(payload or {}).encode()
+    out = call(url, method, body, timeout)
+    assert isinstance(out, dict)
+    return out

@@ -67,19 +67,28 @@ class EcVolume:
         self.ecx_size = os.path.getsize(base_file_name + ".ecx")
         self._ecj_lock = threading.Lock()
         self.load_local_shards()
-        if version is None:
-            version = self._detect_version()
-        self.version = version
+        # Version detection is lazy: a server holding only parity shards
+        # can still mount and serve raw shard bytes without knowing it.
+        self._version = version
+
+    @property
+    def version(self) -> int:
+        if self._version is None:
+            self._version = self._detect_version()
+        return self._version
 
     def _detect_version(self) -> int:
-        """Volume version from the superblock (head of shard 0).
+        """Volume version: .vif sidecar, else shard 0's superblock, else
+        reconstruct the superblock bytes from >=10 survivors.
 
-        When .ec00 is missing locally (the degraded case this class
-        exists for), reconstruct shard 0's first bytes from survivors
-        rather than guessing — a wrong version mis-sizes every record.
+        A wrong version mis-sizes every record, so no silent default.
         """
         from ..core.super_block import SuperBlock
         from .decoder import read_ec_volume_version
+        from .volume_info import load_volume_info
+        info = load_volume_info(self.base_file_name)
+        if info and "version" in info:
+            return int(info["version"])
         try:
             return read_ec_volume_version(self.base_file_name)
         except FileNotFoundError:

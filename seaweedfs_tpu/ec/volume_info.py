@@ -1,0 +1,33 @@
+"""`.vif` VolumeInfo sidecar.
+
+The reference stores a protobuf VolumeInfo next to volume/shard files
+(weed/pb/volume_info.go, maybeLoadVolumeInfo) carrying the needle version
+and tiering info; EC shard copies bring it along so a server holding only
+parity shards still knows how to size records.  Ours carries the same
+fields as JSON (the sidecar is operational metadata, not part of the
+byte-compat surface).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def save_volume_info(base_file_name: str, version: int,
+                     files: list[dict] | None = None) -> None:
+    payload = {"version": version}
+    if files:
+        payload["files"] = files
+    tmp = base_file_name + ".vif.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, base_file_name + ".vif")
+
+
+def load_volume_info(base_file_name: str) -> dict | None:
+    try:
+        with open(base_file_name + ".vif") as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None

@@ -1,0 +1,54 @@
+"""File-id sequencers (weed/sequence/): monotonically increasing needle keys.
+
+MemorySequencer mirrors the reference's default: in-memory counter,
+optionally checkpointed to a metadata file in steps of 100 so a restart
+never reissues keys (sequence.go / memory_sequencer.go).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+STEP = 100
+
+
+class MemorySequencer:
+    def __init__(self, meta_path: str | None = None):
+        self._lock = threading.Lock()
+        self.meta_path = meta_path
+        self.counter = 1
+        self._ceiling = 0
+        if meta_path and os.path.exists(meta_path):
+            with open(meta_path) as f:
+                try:
+                    self.counter = int(f.read().strip() or 1)
+                except ValueError:
+                    self.counter = 1
+        self._maybe_checkpoint()
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            start = self.counter
+            self.counter += count
+            self._maybe_checkpoint()
+            return start
+
+    def set_max(self, seen: int) -> None:
+        """Raise the counter past ids observed in heartbeats."""
+        with self._lock:
+            if seen >= self.counter:
+                self.counter = seen + 1
+                self._maybe_checkpoint()
+
+    def peek(self) -> int:
+        with self._lock:
+            return self.counter
+
+    def _maybe_checkpoint(self) -> None:
+        if self.meta_path and self.counter >= self._ceiling:
+            self._ceiling = self.counter + STEP
+            tmp = self.meta_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(self._ceiling))
+            os.replace(tmp, self.meta_path)

@@ -1,0 +1,212 @@
+"""Topology/placement tests with fake in-process clusters — mirrors the
+reference's topology_test.go / volume_growth_test.go approach."""
+
+import random
+
+import pytest
+
+from seaweedfs_tpu.storage.store import VolumeInfo
+from seaweedfs_tpu.topology.node import DataNode
+from seaweedfs_tpu.topology.sequence import MemorySequencer
+from seaweedfs_tpu.topology.topology import Topology, VolumeGrowOption
+from seaweedfs_tpu.topology.volume_growth import (VolumeGrowth,
+                                                  target_count_per_grow)
+
+
+def _vinfo(vid, collection="", size=0, read_only=False, rp=0, ttl=0,
+           max_file_key=0):
+    return VolumeInfo(id=vid, collection=collection, size=size,
+                      file_count=0, delete_count=0, deleted_byte_count=0,
+                      read_only=read_only, replica_placement=rp, ttl=ttl,
+                      compact_revision=0, max_file_key=max_file_key)
+
+
+def _cluster(topo, dcs=2, racks=2, nodes=2, max_volumes=10):
+    """Build dc{i}/rack{j}/node ip 10.i.j.k:8080."""
+    out = []
+    for i in range(dcs):
+        for j in range(racks):
+            for k in range(nodes):
+                dn = topo.register_data_node(
+                    f"dc{i}", f"rack{j}", f"10.{i}.{j}.{k}", 8080,
+                    max_volume_count=max_volumes)
+                out.append(dn)
+    return out
+
+
+def test_register_and_counters():
+    topo = Topology()
+    nodes = _cluster(topo, dcs=1, racks=1, nodes=2, max_volumes=5)
+    assert topo.max_volume_count == 10
+    topo.register_volume(_vinfo(1), nodes[0])
+    topo.register_volume(_vinfo(2), nodes[0])
+    assert topo.volume_count == 2
+    assert nodes[0].free_space() == 3
+    assert topo.free_space() == 8
+
+
+def test_full_sync_add_remove():
+    topo = Topology()
+    (dn,) = _cluster(topo, dcs=1, racks=1, nodes=1)
+    new, deleted = topo.sync_data_node_registration(
+        [_vinfo(1), _vinfo(2)], dn)
+    assert [v.id for v in new] == [1, 2]
+    new, deleted = topo.sync_data_node_registration([_vinfo(2)], dn)
+    assert [v.id for v in deleted] == [1]
+    assert topo.volume_count == 1
+    assert topo.lookup("", 2) == [dn]
+    assert topo.lookup("", 1) == []
+
+
+def test_writable_requires_enough_replicas():
+    topo = Topology()
+    nodes = _cluster(topo, dcs=1, racks=1, nodes=2)
+    v = _vinfo(5, rp=1)  # 001 -> 2 copies
+    topo.register_volume(v, nodes[0])
+    with pytest.raises(ValueError, match="no more writable"):
+        topo.pick_for_write(1, VolumeGrowOption(replica_placement="001"))
+    topo.register_volume(v, nodes[1])
+    fid, count, locs = topo.pick_for_write(
+        1, VolumeGrowOption(replica_placement="001"))
+    assert count == 1 and len(locs) == 2
+    vid = int(fid.split(",")[0])
+    assert vid == 5
+
+
+def test_oversized_not_writable():
+    topo = Topology(volume_size_limit=1000)
+    (dn,) = _cluster(topo, dcs=1, racks=1, nodes=1)
+    topo.register_volume(_vinfo(1, size=2000), dn)
+    with pytest.raises(ValueError):
+        topo.pick_for_write(1, VolumeGrowOption())
+    topo.register_volume(_vinfo(2, size=10), dn)
+    fid, _, _ = topo.pick_for_write(1, VolumeGrowOption())
+    assert fid.startswith("2,")
+
+
+def test_dead_node_unregisters_volumes():
+    topo = Topology()
+    nodes = _cluster(topo, dcs=1, racks=1, nodes=2)
+    v = _vinfo(1)
+    topo.register_volume(v, nodes[0])
+    assert topo.lookup("", 1) == [nodes[0]]
+    topo.unregister_data_node(nodes[0])
+    assert topo.lookup("", 1) == []
+    # Counter hygiene: only node 1's capacity (10 slots) remains.
+    assert topo.max_volume_count == 10
+    assert topo.volume_count == 0
+
+
+def test_sequencer_monotonic_and_restart(tmp_path):
+    meta = str(tmp_path / "seq.dat")
+    s = MemorySequencer(meta)
+    a = s.next_file_id(10)
+    b = s.next_file_id(1)
+    assert b == a + 10
+    s.set_max(5000)
+    assert s.next_file_id() == 5001
+    # Restart never reissues.
+    s2 = MemorySequencer(meta)
+    assert s2.next_file_id() > b
+
+
+def test_heartbeat_raises_sequencer():
+    topo = Topology()
+    (dn,) = _cluster(topo, dcs=1, racks=1, nodes=1)
+    topo.register_volume(_vinfo(1, max_file_key=999), dn)
+    assert topo.next_file_key() >= 1000
+
+
+def test_ec_shard_registration():
+    from seaweedfs_tpu.ec.shard_bits import ShardBits
+    topo = Topology()
+    nodes = _cluster(topo, dcs=1, racks=1, nodes=2)
+    bits_a = int(ShardBits(0).add_shard_id(0).add_shard_id(1))
+    bits_b = int(ShardBits(0).add_shard_id(2))
+    topo.register_ec_shards(7, "c", bits_a, nodes[0])
+    topo.register_ec_shards(7, "c", bits_b, nodes[1])
+    locs = topo.lookup_ec_shards(7)
+    assert locs.locations[0] == [nodes[0]]
+    assert locs.locations[2] == [nodes[1]]
+    assert topo.ec_shard_count == 3
+    # Shrink node 0 to shard 1 only.
+    topo.register_ec_shards(7, "c", int(ShardBits(0).add_shard_id(1)),
+                            nodes[0])
+    assert topo.lookup_ec_shards(7).locations.get(0, []) == []
+    assert topo.ec_shard_count == 2
+    topo.unregister_ec_shards(7, nodes[0])
+    topo.unregister_ec_shards(7, nodes[1])
+    assert topo.lookup_ec_shards(7) is None
+    assert topo.ec_shard_count == 0
+
+
+def test_growth_placement_respects_rp():
+    """Placement honoring 'one other DC, one other rack, one same rack'."""
+    rng = random.Random(42)
+    topo = Topology()
+    _cluster(topo, dcs=2, racks=3, nodes=3, max_volumes=10)
+    vg = VolumeGrowth(rng)
+    for trial in range(10):
+        servers = vg.find_empty_slots_for_one_volume(
+            topo, VolumeGrowOption(replica_placement="111"))
+        assert len(servers) == 4  # main + same-rack + other-rack + other-DC
+        assert len({s.id for s in servers}) == 4
+        dcs = {s.get_data_center().id for s in servers}
+        racks = {(s.get_data_center().id, s.get_rack().id) for s in servers}
+        assert len(dcs) == 2      # main DC + 1 other DC
+        assert len(racks) == 3    # main rack (x2 servers) + other + other-DC
+
+
+def test_growth_same_rack_placement():
+    rng = random.Random(7)
+    topo = Topology()
+    _cluster(topo, dcs=1, racks=1, nodes=4)
+    vg = VolumeGrowth(rng)
+    servers = vg.find_empty_slots_for_one_volume(
+        topo, VolumeGrowOption(replica_placement="002"))
+    assert len(servers) == 3
+    assert len({s.id for s in servers}) == 3  # distinct nodes
+
+
+def test_growth_insufficient_topology():
+    topo = Topology()
+    _cluster(topo, dcs=1, racks=1, nodes=1)
+    vg = VolumeGrowth(random.Random(1))
+    with pytest.raises(ValueError):
+        vg.find_empty_slots_for_one_volume(
+            topo, VolumeGrowOption(replica_placement="010"))
+
+
+def test_grow_by_type_allocates_on_servers():
+    topo = Topology()
+    nodes = _cluster(topo, dcs=1, racks=1, nodes=3)
+    vg = VolumeGrowth(random.Random(3))
+    allocated = []
+
+    def allocate(vid, option, server):
+        allocated.append((vid, server.id))
+        # Simulate the heartbeat that follows a real allocation.
+        topo.register_volume(
+            _vinfo(vid, rp=int(option.replica_placement)), server)
+
+    grown = vg.grow_by_type(
+        topo, VolumeGrowOption(replica_placement="001"), allocate)
+    assert grown == target_count_per_grow(2) == 6
+    assert len(allocated) == 12  # 6 volumes x 2 replicas
+    fid, _, locs = topo.pick_for_write(
+        1, VolumeGrowOption(replica_placement="001"))
+    assert len(locs) == 2
+
+
+def test_pick_for_write_dc_preference():
+    topo = Topology()
+    nodes = _cluster(topo, dcs=2, racks=1, nodes=1)
+    v = _vinfo(1)
+    topo.register_volume(v, nodes[0])   # dc0
+    v2 = _vinfo(2)
+    topo.register_volume(v2, nodes[1])  # dc1
+    for _ in range(5):
+        fid, _, locs = topo.pick_for_write(
+            1, VolumeGrowOption(data_center="dc1"))
+        assert fid.startswith("2,")
+        assert locs[0].get_data_center().id == "dc1"
