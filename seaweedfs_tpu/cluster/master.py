@@ -15,6 +15,7 @@ HTTP surface mirrors the reference master's API
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 
@@ -79,7 +80,17 @@ class MasterServer:
         s.route("GET", "/col/list", self._col_list)
         s.route("POST", "/col/delete", self._col_delete)
         s.route("GET", "/cluster/status", self._cluster_status)
+        s.route("GET", "/vol/list", self._vol_list)
+        s.route("POST", "/admin/lease", self._admin_lease)
+        s.route("POST", "/admin/release", self._admin_release)
         self._grow_lock = threading.Lock()
+        # Exclusive admin lock (wdclient/exclusive_locks): one shell at a
+        # time may run mutating maintenance commands.
+        self._admin_lock = threading.Lock()
+        self._admin_token: int | None = None
+        self._admin_holder = ""
+        self._admin_expires = 0.0
+        self._admin_lock_ttl = 10.0
         self._stop = threading.Event()
         self._sweeper = threading.Thread(target=self._sweep_loop,
                                          daemon=True, name="master-sweep")
@@ -100,7 +111,6 @@ class MasterServer:
     # -- handlers -----------------------------------------------------------
 
     def _heartbeat(self, query: dict, body: bytes) -> dict:
-        import json
         hb = json.loads(body)
         dn = self.topo.register_data_node(
             hb.get("data_center", "DefaultDataCenter"),
@@ -249,6 +259,58 @@ class MasterServer:
     def _cluster_status(self, query: dict, body: bytes) -> dict:
         return {"leader": self.url(), "is_leader": True,
                 "volume_size_limit": self.topo.volume_size_limit}
+
+    def _vol_list(self, query: dict, body: bytes) -> dict:
+        """Detailed topology dump (master VolumeList RPC): every node with
+        its full per-volume info and EC shard bits — the shell's view."""
+        dcs = []
+        with self.topo._lock:  # heartbeats mutate these dicts concurrently
+            for dc in list(self.topo.children.values()):
+                racks = []
+                for rack in list(dc.children.values()):
+                    nodes = []
+                    for dn in list(rack.children.values()):
+                        nodes.append({
+                            "id": dn.id, "url": dn.url(),
+                            "public_url": dn.public_url,
+                            "max_volume_count": dn.max_volume_count,
+                            "volumes": [vinfo_to_dict(v)
+                                        for v in list(dn.volumes.values())],
+                            "ec_shards": [
+                                {"id": vid, "shard_bits": bits}
+                                for vid, bits in dn.ec_shards.items()],
+                        })
+                    racks.append({"id": rack.id, "nodes": nodes})
+                dcs.append({"id": dc.id, "racks": racks})
+        return {"topology": {"data_centers": dcs},
+                "volume_size_limit": self.topo.volume_size_limit}
+
+    def _admin_lease(self, query: dict, body: bytes) -> dict:
+        """LeaseAdminToken: grant/renew the exclusive maintenance lock."""
+        req = json.loads(body) if body else {}
+        name = req.get("name", "shell")
+        prev = req.get("token")
+        now = time.time()
+        with self._admin_lock:
+            held = (self._admin_token is not None
+                    and now < self._admin_expires)
+            if held and self._admin_token != prev:
+                raise rpc.RpcError(
+                    409, f"admin lock held by {self._admin_holder}")
+            self._admin_token = prev or (hash((name, now)) & 0x7FFFFFFF)
+            self._admin_holder = name
+            self._admin_expires = now + self._admin_lock_ttl
+            return {"token": self._admin_token,
+                    "ttl": self._admin_lock_ttl}
+
+    def _admin_release(self, query: dict, body: bytes) -> dict:
+        req = json.loads(body) if body else {}
+        with self._admin_lock:
+            if self._admin_token == req.get("token"):
+                self._admin_token = None
+                self._admin_holder = ""
+                self._admin_expires = 0.0
+        return {}
 
     # -- vacuum orchestration ------------------------------------------------
 

@@ -12,6 +12,7 @@ these RPCs.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import urllib.error
@@ -102,6 +103,18 @@ class JsonHttpServer:
                 self._send(status, payload)
 
             def _send(self, status: int, payload):
+                if hasattr(payload, "read"):  # open file: stream it
+                    import shutil
+                    size = os.fstat(payload.fileno()).st_size
+                    self.send_response(status)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(size))
+                    self.end_headers()
+                    with payload:
+                        shutil.copyfileobj(payload, self.wfile,
+                                           length=1 << 20)
+                    return
                 if isinstance(payload, (bytes, bytearray)):
                     data = bytes(payload)
                     ctype = "application/octet-stream"
@@ -151,6 +164,29 @@ def call(url: str, method: str = "GET", body: bytes | None = None,
                     "application/json"):
                 return json.loads(data or b"{}")
             return data
+    except urllib.error.HTTPError as e:
+        try:
+            message = json.loads(e.read() or b"{}").get("error", str(e))
+        except Exception:  # noqa: BLE001
+            message = str(e)
+        raise RpcError(e.code, message) from None
+
+
+def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:
+    """Stream a GET response to a file in chunks; returns byte count.
+    Bulk transfers (volume/shard copies) must never buffer a 30GB .dat
+    in memory (the reference streams CopyFile in chunks too)."""
+    req = urllib.request.Request(url)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp, \
+                open(path, "wb") as f:
+            total = 0
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    return total
+                f.write(chunk)
+                total += len(chunk)
     except urllib.error.HTTPError as e:
         try:
             message = json.loads(e.read() or b"{}").get("error", str(e))

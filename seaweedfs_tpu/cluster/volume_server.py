@@ -66,6 +66,11 @@ class VolumeServer:
         s.route("GET", "/admin/ec/shard_read", self._ec_shard_read)
         s.route("GET", "/admin/ec/shard_file", self._ec_shard_file)
         s.route("POST", "/admin/ec/copy_shard", self._ec_copy_shard)
+        s.route("POST", "/admin/ec/to_volume", self._ec_to_volume)
+        s.route("GET", "/admin/volume_file", self._volume_file)
+        s.route("POST", "/admin/copy_volume", self._copy_volume)
+        s.route("POST", "/admin/mount", self._admin_mount)
+        s.route("POST", "/admin/unmount", self._admin_unmount)
         s.prefix_route("GET", "/", self._get_needle)
         s.prefix_route("POST", "/", self._post_needle)
         s.prefix_route("PUT", "/", self._post_needle)
@@ -460,6 +465,19 @@ class VolumeServer:
                 os.remove(base + to_ext(sid))
             except FileNotFoundError:
                 pass
+        # Last shard gone: unmount and drop the index sidecars too, else a
+        # restart re-registers a phantom zero-shard EC volume from the
+        # stale .ecx (VolumeEcShardsDelete does the same cleanup).
+        if not any(os.path.exists(base + to_ext(s))
+                   for s in range(TOTAL_SHARDS)):
+            ev = self.ec_volumes.pop(vid, None)
+            if ev is not None:
+                ev.close()
+            for ext in (".ecx", ".ecj", ".vif"):
+                try:
+                    os.remove(base + ext)
+                except FileNotFoundError:
+                    pass
         self._send_heartbeat()
         return {}
 
@@ -484,8 +502,7 @@ class VolumeServer:
         path = base + ext
         if not os.path.exists(path):
             raise rpc.RpcError(404, f"{os.path.basename(path)} not here")
-        with open(path, "rb") as f:
-            return f.read()
+        return open(path, "rb")  # streamed by the server in 1MB chunks
 
     def _ec_copy_shard(self, query: dict, body: bytes) -> dict:
         """VolumeEcShardsCopy: pull shard files from a source server."""
@@ -496,19 +513,96 @@ class VolumeServer:
         base = self._volume_base(vid)
         os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
         for sid in shard_ids:
-            data = rpc.call(f"http://{source}/admin/ec/shard_file?"
-                            f"volume={vid}&shard={sid}")
-            with open(base + to_ext(sid), "wb") as f:
-                f.write(data)
+            rpc.call_to_file(f"http://{source}/admin/ec/shard_file?"
+                             f"volume={vid}&shard={sid}",
+                             base + to_ext(sid))
         if req.get("copy_ecx", False):
             for ext in (".ecx", ".ecj", ".vif"):
                 try:
-                    data = rpc.call(f"http://{source}/admin/ec/shard_file?"
-                                    f"volume={vid}&ext={ext}")
-                    with open(base + ext, "wb") as f:
-                        f.write(data)
+                    rpc.call_to_file(
+                        f"http://{source}/admin/ec/shard_file?"
+                        f"volume={vid}&ext={ext}", base + ext)
                 except rpc.RpcError:
-                    pass
+                    try:
+                        os.remove(base + ext)  # don't leave a 0-byte file
+                    except FileNotFoundError:
+                        pass
+        return {}
+
+    def _ec_to_volume(self, query: dict, body: bytes) -> dict:
+        """VolumeEcShardsToVolume: local data shards (.ec00-.ec09) + .ecx
+        back into a normal .dat/.idx volume, then mount it
+        (server/volume_grpc_erasure_coding.go:330)."""
+        req = json.loads(body)
+        vid = req["volume"]
+        ev = self.ec_volumes.get(vid)
+        base = (ev.base_file_name if ev is not None
+                else self._volume_base(vid))
+        missing = [s for s in range(10)
+                   if not os.path.exists(base + to_ext(s))]
+        if missing:
+            raise rpc.RpcError(
+                409, f"data shards {missing} not on this server; "
+                     "copy them here first")
+        from ..ec.decoder import (find_dat_file_size, write_dat_file,
+                                  write_idx_file_from_ec_index)
+        if ev is not None:
+            self.ec_volumes.pop(vid).close()
+        dat_size = find_dat_file_size(base)
+        write_dat_file(base, dat_size)
+        write_idx_file_from_ec_index(base)
+        v = self.store.mount_volume(vid)
+        self._send_heartbeat(full=True)
+        return {"volume": vid, "size": v.dat_size()}
+
+    def _volume_file(self, query: dict, body: bytes):
+        """Stream a whole .dat/.idx/.vif file — the VolumeCopy/CopyFile RPC
+        for normal volumes (server/volume_grpc_copy.go)."""
+        vid = int(query["volume"])
+        ext = query.get("ext", ".dat")
+        if ext not in (".dat", ".idx", ".vif"):
+            raise rpc.RpcError(400, f"bad ext {ext}")
+        v = self.store.find_volume(vid)
+        base = v.file_name() if v is not None else self._volume_base(vid)
+        if v is not None:
+            v.sync()
+        path = base + ext
+        if not os.path.exists(path):
+            raise rpc.RpcError(404, f"{os.path.basename(path)} not here")
+        return open(path, "rb")  # streamed by the server in 1MB chunks
+
+    def _copy_volume(self, query: dict, body: bytes) -> dict:
+        """VolumeCopy: pull .idx then .dat from a source server, then
+        mount.  The shell freezes the source first; .idx-before-.dat
+        ordering additionally guarantees the copied index never references
+        bytes beyond the copied data snapshot."""
+        req = json.loads(body)
+        vid, source = req["volume"], req["source"]
+        if self.store.has_volume(vid):
+            raise rpc.RpcError(409, f"volume {vid} already here")
+        loc = self.store.free_location()
+        if loc is None:
+            raise rpc.RpcError(507, "no free disk location on this server")
+        collection = req.get("collection", "")
+        name = f"{collection}_{vid}" if collection else str(vid)
+        base = os.path.join(loc.directory, name)
+        for ext in (".idx", ".dat"):
+            rpc.call_to_file(f"http://{source}/admin/volume_file?"
+                             f"volume={vid}&ext={ext}", base + ext)
+        v = self.store.mount_volume(vid)
+        self._send_heartbeat()
+        return {"volume": vid, "size": v.dat_size()}
+
+    def _admin_mount(self, query: dict, body: bytes) -> dict:
+        req = json.loads(body)
+        self.store.mount_volume(req["volume"])
+        self._send_heartbeat()
+        return {}
+
+    def _admin_unmount(self, query: dict, body: bytes) -> dict:
+        req = json.loads(body)
+        self.store.unmount_volume(req["volume"])
+        self._send_heartbeat(full=True)
         return {}
 
     def _load_ec_volumes(self) -> None:

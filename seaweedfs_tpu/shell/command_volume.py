@@ -1,0 +1,295 @@
+"""Volume maintenance commands: list/balance/fix.replication/move/....
+
+Reference: weed/shell/command_volume_list.go, command_volume_balance.go
+(ideal-ratio moves), command_volume_fix_replication.go (under-replicated
+copy), command_volume_move.go / _copy.go / _delete.go / _mount.go,
+command_volume_vacuum (via master /vol/vacuum).
+"""
+
+from __future__ import annotations
+
+from ..core.replica_placement import ReplicaPlacement
+from ..cluster import rpc
+from .commands import Command, register
+from .env import CommandEnv, ShellError
+
+
+def _volumes_by_id(env: CommandEnv) -> dict[int, list[tuple[dict, dict]]]:
+    """vid -> [(node, vinfo), ...] across the cluster."""
+    out: dict[int, list[tuple[dict, dict]]] = {}
+    for n in env.data_nodes():
+        for v in n["volumes"]:
+            out.setdefault(v["id"], []).append((n, v))
+    return out
+
+
+@register
+class VolumeList(Command):
+    name = "volume.list"
+    help = "volume.list — topology tree with every volume and EC shard"
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        topo = env.topology()["topology"]
+        lines = []
+        for dc in topo["data_centers"]:
+            lines.append(f"DataCenter {dc['id']}")
+            for rack in dc["racks"]:
+                lines.append(f"  Rack {rack['id']}")
+                for n in rack["nodes"]:
+                    lines.append(
+                        f"    DataNode {n['url']} "
+                        f"volumes:{len(n['volumes'])}"
+                        f"/{n['max_volume_count']} "
+                        f"ec_volumes:{len(n['ec_shards'])}")
+                    for v in sorted(n["volumes"], key=lambda v: v["id"]):
+                        rp = ReplicaPlacement.from_byte(
+                            v.get("replica_placement", 0))
+                        lines.append(
+                            f"      volume id:{v['id']} "
+                            f"collection:{v.get('collection', '') or '-'} "
+                            f"size:{v['size']} "
+                            f"files:{v['file_count']} "
+                            f"replication:{rp} "
+                            f"{'readonly' if v.get('read_only') else 'rw'}")
+                    for e in sorted(n["ec_shards"], key=lambda e: e["id"]):
+                        from ..ec.shard_bits import ShardBits
+                        sids = ShardBits(e["shard_bits"]).shard_ids()
+                        lines.append(
+                            f"      ec volume id:{e['id']} shards:{sids}")
+        return "\n".join(lines)
+
+
+@register
+class VolumeMove(Command):
+    name = "volume.move"
+    help = ("volume.move -volumeId <id> -source <host:port> "
+            "-target <host:port> — copy a volume then remove the source")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        vid = int(flags["volumeId"])
+        source, target = flags["source"], flags["target"]
+        copy_volume(env, vid, source, target)
+        env.vs_call(source, "/admin/delete_volume", {"volume": vid})
+        return f"moved volume {vid}: {source} -> {target}"
+
+
+def copy_volume(env: CommandEnv, vid: int, source: str, target: str) -> None:
+    """Freeze the source, copy .idx+.dat to the target, restore.
+
+    Without the freeze a write landing between the two file fetches would
+    be referenced by neither copy — after a `move` deletes the source,
+    that needle would be lost (the reference freezes/tails instead)."""
+    locs = _volumes_by_id(env).get(vid, [])
+    collection = locs[0][1].get("collection", "") if locs else ""
+    was_readonly = bool(locs and locs[0][1].get("read_only"))
+    env.vs_call(source, "/admin/readonly",
+                {"volume": vid, "readonly": True})
+    try:
+        env.vs_call(target, "/admin/copy_volume",
+                    {"volume": vid, "source": source,
+                     "collection": collection})
+    finally:
+        if not was_readonly:
+            env.vs_call(source, "/admin/readonly",
+                        {"volume": vid, "readonly": False})
+
+
+@register
+class VolumeCopy(Command):
+    name = "volume.copy"
+    help = ("volume.copy -volumeId <id> -source <host:port> "
+            "-target <host:port>")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        vid = int(flags["volumeId"])
+        copy_volume(env, vid, flags["source"], flags["target"])
+        return f"copied volume {vid} to {flags['target']}"
+
+
+@register
+class VolumeDelete(Command):
+    name = "volume.delete"
+    help = "volume.delete -volumeId <id> -node <host:port>"
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        vid = int(flags["volumeId"])
+        env.vs_call(flags["node"], "/admin/delete_volume", {"volume": vid})
+        return f"deleted volume {vid} on {flags['node']}"
+
+
+@register
+class VolumeMount(Command):
+    name = "volume.mount"
+    help = "volume.mount -volumeId <id> -node <host:port>"
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        env.vs_call(flags["node"], "/admin/mount",
+                    {"volume": int(flags["volumeId"])})
+        return "mounted"
+
+
+@register
+class VolumeUnmount(Command):
+    name = "volume.unmount"
+    help = "volume.unmount -volumeId <id> -node <host:port>"
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        env.vs_call(flags["node"], "/admin/unmount",
+                    {"volume": int(flags["volumeId"])})
+        return "unmounted"
+
+
+@register
+class VolumeBalance(Command):
+    name = "volume.balance"
+    help = ("volume.balance [-collection <name>] — move volumes so every "
+            "node is at a similar fill ratio")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        collection = flags.get("collection")
+        out = []
+        for _ in range(64):
+            nodes = env.data_nodes()
+            if len(nodes) < 2:
+                break
+            ratios = {n["url"]: len(n["volumes"]) / max(
+                n["max_volume_count"], 1) for n in nodes}
+            hi = max(ratios, key=ratios.get)  # type: ignore[arg-type]
+            lo = min(ratios, key=ratios.get)  # type: ignore[arg-type]
+            hi_n = next(n for n in nodes if n["url"] == hi)
+            lo_n = next(n for n in nodes if n["url"] == lo)
+            if (len(hi_n["volumes"]) - len(lo_n["volumes"])) <= 1:
+                break
+            lo_vids = {v["id"] for v in lo_n["volumes"]}
+            candidates = [v for v in hi_n["volumes"]
+                          if v["id"] not in lo_vids
+                          and (collection is None
+                               or v.get("collection", "") == collection)]
+            if not candidates:
+                break
+            v = min(candidates, key=lambda v: v["size"])
+            copy_volume(env, v["id"], hi, lo)
+            env.vs_call(hi, "/admin/delete_volume", {"volume": v["id"]})
+            out.append(f"moved volume {v['id']}: {hi} -> {lo}")
+        return "\n".join(out) or "already balanced"
+
+
+@register
+class VolumeFixReplication(Command):
+    name = "volume.fix.replication"
+    help = ("volume.fix.replication [-n] — re-copy under-replicated "
+            "volumes to spare nodes")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        dry = "n" in flags
+        out = []
+        for vid, holders in sorted(_volumes_by_id(env).items()):
+            rp = ReplicaPlacement.from_byte(
+                holders[0][1].get("replica_placement", 0))
+            want = rp.copy_count()
+            have = len(holders)
+            if have >= want:
+                continue
+            holder_urls = {n["url"] for n, _v in holders}
+            spares = [n for n in env.data_nodes()
+                      if n["url"] not in holder_urls
+                      and len(n["volumes"]) < n["max_volume_count"]]
+            # Prefer placement matching the rp: different rack first when
+            # diff_rack_count is set, etc. (simplified pickBestNode).
+            src_rack = holders[0][0]["rack"]
+            src_dc = holders[0][0]["dc"]
+            if rp.diff_data_center_count:
+                spares.sort(key=lambda n: n["dc"] == src_dc)
+            elif rp.diff_rack_count:
+                spares.sort(key=lambda n: n["rack"] == src_rack)
+            for spare in spares[:want - have]:
+                if dry:
+                    out.append(f"volume {vid}: would copy to "
+                               f"{spare['url']}")
+                    continue
+                copy_volume(env, vid, holders[0][0]["url"], spare["url"])
+                out.append(f"volume {vid}: copied to {spare['url']} "
+                           f"({have}/{want} -> {have + 1}/{want})")
+        return "\n".join(out) or "all volumes sufficiently replicated"
+
+
+@register
+class VolumeVacuum(Command):
+    name = "volume.vacuum"
+    help = "volume.vacuum [-garbageThreshold 0.3] — trigger a vacuum scan"
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, _ = self.parse_flags(args)
+        q = ""
+        if "garbageThreshold" in flags:
+            q = f"?garbageThreshold={flags['garbageThreshold']}"
+        resp = rpc.call_json(f"{env.master_url}/vol/vacuum{q}")
+        return f"vacuumed volumes: {resp.get('vacuumed', [])}"
+
+
+@register
+class VolumeServerEvacuate(Command):
+    name = "volumeServer.evacuate"
+    help = ("volumeServer.evacuate -node <host:port> — move every volume "
+            "and EC shard off one server")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        node = flags["node"]
+        me = next((n for n in env.data_nodes() if n["url"] == node), None)
+        if me is None:
+            raise ShellError(f"node {node} not found")
+        out = []
+        failed = []
+        i = 0
+        for v in me["volumes"]:
+            # Re-fetch per move: capacities change as copies land.
+            others = [n for n in env.data_nodes() if n["url"] != node]
+            if not others:
+                raise ShellError("no other nodes to evacuate to")
+            placed = False
+            for _ in range(len(others)):
+                target = others[i % len(others)]
+                i += 1
+                if len(target["volumes"]) < target["max_volume_count"] and \
+                        v["id"] not in {x["id"] for x in target["volumes"]}:
+                    copy_volume(env, v["id"], node, target["url"])
+                    env.vs_call(node, "/admin/delete_volume",
+                                {"volume": v["id"]})
+                    out.append(f"volume {v['id']} -> {target['url']}")
+                    placed = True
+                    break
+            if not placed:
+                failed.append(f"volume {v['id']}")
+        from .command_ec import move_shard
+        from ..ec.shard_bits import ShardBits
+        for e in me["ec_shards"]:
+            others = [n for n in env.data_nodes() if n["url"] != node]
+            for sid in ShardBits(e["shard_bits"]).shard_ids():
+                target = others[i % len(others)]
+                i += 1
+                move_shard(env, e["id"], sid, node, target["url"])
+                out.append(f"ec {e['id']}.{sid} -> {target['url']}")
+        if failed:
+            # The node is NOT safe to decommission — refuse to report
+            # success with replicas still aboard.
+            raise ShellError(
+                "evacuation incomplete, no capacity for: "
+                + ", ".join(failed)
+                + ("\n" + "\n".join(out) if out else ""))
+        return "\n".join(out) or "nothing to evacuate"

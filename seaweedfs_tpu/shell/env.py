@@ -1,0 +1,109 @@
+"""CommandEnv: the shell's connection to the cluster.
+
+Reference: weed/shell/commands.go (CommandEnv with MasterClient + exclusive
+lock) and weed/wdclient/exclusive_locks/exclusive_locker.go (the admin
+lease that gates mutating commands — `lock`/`unlock`, confirmIsLocked).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..cluster import rpc
+from ..cluster.client import WeedClient
+
+
+class ShellError(Exception):
+    pass
+
+
+class CommandEnv:
+    def __init__(self, master_url: str):
+        self.master_url = master_url.rstrip("/")
+        self.client = WeedClient(self.master_url)
+        self._lock_token: int | None = None
+        self._renewer: threading.Timer | None = None
+        self.cwd = "/"  # for fs.* commands
+
+    # -- cluster views -------------------------------------------------------
+
+    def topology(self) -> dict:
+        return rpc.call(f"{self.master_url}/vol/list")
+
+    def data_nodes(self) -> list[dict]:
+        """Flattened node list with dc/rack annotations."""
+        out = []
+        topo = self.topology()["topology"]
+        for dc in topo["data_centers"]:
+            for rack in dc["racks"]:
+                for n in rack["nodes"]:
+                    n = dict(n)
+                    n["dc"] = dc["id"]
+                    n["rack"] = rack["id"]
+                    out.append(n)
+        return out
+
+    def volume_locations(self, vid: int) -> list[str]:
+        """Always fresh from the master — maintenance decisions must not
+        act on the client cache's 60s-stale view."""
+        resp = rpc.call(f"{self.master_url}/dir/lookup?volumeId={vid}")
+        return [loc["url"] for loc in resp.get("locations", [])]
+
+    def ec_shard_locations(self, vid: int) -> dict[int, list[str]]:
+        resp = rpc.call(f"{self.master_url}/dir/lookup?volumeId={vid}")
+        return {int(s): [d["url"] for d in dns]
+                for s, dns in resp.get("ecShards", {}).items()}
+
+    # -- volume server RPC shorthands ---------------------------------------
+
+    def vs_call(self, url: str, path: str, payload: dict | None = None,
+                timeout: float = 120.0) -> dict:
+        return rpc.call_json(f"http://{url}{path}", payload=payload,
+                             timeout=timeout)
+
+    # -- exclusive admin lock ------------------------------------------------
+
+    def lock(self, name: str = "shell") -> None:
+        resp = rpc.call_json(f"{self.master_url}/admin/lease",
+                             payload={"name": name,
+                                      "token": self._lock_token})
+        self._lock_token = resp["token"]
+        ttl = float(resp.get("ttl", 10.0))
+        self._schedule_renew(name, ttl / 2)
+
+    def _schedule_renew(self, name: str, delay: float) -> None:
+        self._cancel_renew()
+
+        def renew():
+            try:
+                self.lock(name)
+            except Exception:  # noqa: BLE001 — lost the lease; commands
+                self._lock_token = None  # will fail confirm_is_locked
+
+        self._renewer = threading.Timer(delay, renew)
+        self._renewer.daemon = True
+        self._renewer.start()
+
+    def _cancel_renew(self) -> None:
+        if self._renewer is not None:
+            self._renewer.cancel()
+            self._renewer = None
+
+    def unlock(self) -> None:
+        self._cancel_renew()
+        if self._lock_token is not None:
+            rpc.call_json(f"{self.master_url}/admin/release",
+                          payload={"token": self._lock_token})
+            self._lock_token = None
+
+    def confirm_is_locked(self) -> None:
+        if self._lock_token is None:
+            raise ShellError(
+                "lock is lost, or this command requires the `lock` first")
+
+    def close(self) -> None:
+        self._cancel_renew()
+        try:
+            self.unlock()
+        except Exception:  # noqa: BLE001
+            pass

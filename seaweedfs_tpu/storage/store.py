@@ -111,6 +111,10 @@ class Store:
     def has_volume(self, vid: int) -> bool:
         return self.find_volume(vid) is not None
 
+    def free_location(self) -> DiskLocation | None:
+        """A disk location with spare volume slots, or None when full."""
+        return self._find_free_location()
+
     def _find_free_location(self) -> DiskLocation | None:
         best, best_free = None, 0
         for loc in self.locations:
@@ -150,6 +154,36 @@ class Store:
                         except FileNotFoundError:
                             pass
                     self.deleted_volumes.append(info)
+                    return
+            raise VolumeError(f"volume {vid} not found")
+
+    def mount_volume(self, vid: int) -> Volume:
+        """Load an existing .dat/.idx pair from disk into the store
+        (VolumeServer.VolumeMount — used after VolumeCopy pulls files)."""
+        with self._lock:
+            v = self.find_volume(vid)
+            if v is not None:
+                return v
+            for loc in self.locations:
+                for path in glob.glob(os.path.join(loc.directory, "*.dat")):
+                    m = _VOLUME_RE.match(os.path.basename(path))
+                    if not m or int(m.group("vid")) != vid:
+                        continue
+                    v = Volume(loc.directory, m.group("collection") or "",
+                               vid, create=False)
+                    loc.volumes[vid] = v
+                    self.new_volumes.append(self._volume_info(v))
+                    return v
+            raise VolumeError(f"no volume files for {vid} on this server")
+
+    def unmount_volume(self, vid: int) -> None:
+        """Detach a volume without deleting its files (VolumeUnmount)."""
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    self.deleted_volumes.append(self._volume_info(v))
+                    v.close()
                     return
             raise VolumeError(f"volume {vid} not found")
 

@@ -1,0 +1,209 @@
+"""Shell command suite against an in-process cluster.
+
+Mirrors the reference's shell tests (weed/shell/command_ec_test.go,
+command_volume_balance_test.go) but runs the real command implementations
+against live master + volume servers, like §3.3's lifecycle.
+"""
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.shell import CommandEnv, ShellError, run_command
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def env(cluster):
+    master, _servers = cluster
+    e = CommandEnv(master.url())
+    yield e
+    e.close()
+
+
+def _freshen(servers):
+    for vs in servers:
+        vs._send_heartbeat(full=True)
+        vs._ec_loc_cache.clear()
+
+
+def _upload_some(master, n=20):
+    """Returns (client, vid, [(payload, fid), ...]) for one volume."""
+    client = WeedClient(master.url())
+    pairs = [(f"shell-payload-{i}".encode(),
+              client.upload_data(f"shell-payload-{i}".encode()))
+             for i in range(n)]
+    vid = int(pairs[0][1].split(",")[0])
+    return client, vid, [(p, f) for p, f in pairs
+                         if int(f.split(",")[0]) == vid]
+
+
+def test_lock_required(env):
+    with pytest.raises(ShellError, match="lock"):
+        run_command(env, "ec.encode -volumeId 1")
+
+
+def test_help_lists_commands(env):
+    out = run_command(env, "help")
+    for name in ("ec.encode", "ec.rebuild", "ec.balance", "ec.decode",
+                 "volume.balance", "volume.fix.replication", "lock"):
+        assert name in out
+
+
+def test_volume_list(cluster, env):
+    master, servers = cluster
+    _client, vid, _fids = _upload_some(master)
+    _freshen(servers)
+    out = run_command(env, "volume.list")
+    assert f"volume id:{vid}" in out
+    assert "DataNode" in out
+
+
+def test_ec_encode_balance_rebuild_decode_lifecycle(cluster, env):
+    master, servers = cluster
+    client, vid, fids = _upload_some(master)
+    _freshen(servers)
+    run_command(env, "lock")
+
+    # encode: volume becomes 14 shards spread over the 3 servers.
+    out = run_command(env, f"ec.encode -volumeId {vid}")
+    assert f"volume {vid}" in out
+    _freshen(servers)
+    shard_map = env.ec_shard_locations(vid)
+    assert sorted(shard_map) == list(range(14))
+    # original volume gone everywhere
+    for vs in servers:
+        assert vs.store.find_volume(vid) is None
+    # reads still work through any server
+    for payload, fid in fids[:3]:
+        data = rpc.call(f"http://{servers[0].url()}/{fid}")
+        assert bytes(data) == payload
+
+    # balance: shard counts stay within 1 of each other.
+    run_command(env, "ec.balance")
+    _freshen(servers)
+    counts = {vs.url(): 0 for vs in servers}
+    for sid, urls in env.ec_shard_locations(vid).items():
+        assert len(urls) == 1, f"shard {sid} duplicated"
+        counts[urls[0]] += 1
+    assert max(counts.values()) - min(counts.values()) <= 1
+
+    # lose two shards, rebuild restores all 14.
+    victim = servers[0]
+    have = sorted(sid for sid, urls in env.ec_shard_locations(vid).items()
+                  if victim.url() in urls)
+    drop = have[:2]
+    rpc.call_json(f"http://{victim.url()}/admin/ec/delete_shards", "POST",
+                  {"volume": vid, "shards": drop})
+    _freshen(servers)
+    assert len(env.ec_shard_locations(vid)) == 14 - len(drop)
+    out = run_command(env, f"ec.rebuild -volumeId {vid}")
+    assert "rebuilt" in out
+    _freshen(servers)
+    assert sorted(env.ec_shard_locations(vid)) == list(range(14))
+
+    # decode: back to a normal volume; all payloads intact.
+    out = run_command(env, f"ec.decode -volumeId {vid}")
+    assert "decoded" in out
+    _freshen(servers)
+    assert env.ec_shard_locations(vid) == {}
+    locs = env.volume_locations(vid)
+    assert len(locs) == 1
+    client.cache.forget(vid)
+    for payload, fid in fids:
+        assert client.download(fid) == payload
+
+
+def test_volume_balance_and_move(cluster, env):
+    master, servers = cluster
+    client = WeedClient(master.url())
+    # Grow several volumes; they all land via weighted placement, then
+    # balance evens them out.
+    rpc.call_json(f"{master.url()}/vol/grow?count=6", payload={})
+    _freshen(servers)
+    run_command(env, "lock")
+    run_command(env, "volume.balance")
+    _freshen(servers)
+    counts = [len(n["volumes"]) for n in env.data_nodes()]
+    assert max(counts) - min(counts) <= 1
+
+    # move one volume explicitly and read through the new location.
+    fid = client.upload_data(b"move-me")
+    vid = int(fid.split(",")[0])
+    src = env.volume_locations(vid)[0]
+    dst = next(n["url"] for n in env.data_nodes() if n["url"] != src)
+    # target may already hold a replica; pick a fresh vid if so
+    run_command(env,
+                f"volume.move -volumeId {vid} -source {src} -target {dst}")
+    _freshen(servers)
+    client.cache.forget(vid)
+    assert client.download(fid) == b"move-me"
+    assert dst in env.volume_locations(vid)
+    assert src not in env.volume_locations(vid)
+
+
+def test_volume_fix_replication(cluster, env):
+    master, servers = cluster
+    client = WeedClient(master.url())
+    a = client.assign(replication="001")
+    fid = a["fid"]
+    rpc.call(f"http://{a['url']}/{fid}", "POST", b"replicated-data")
+    vid = int(fid.split(",")[0])
+    _freshen(servers)
+    locs = env.volume_locations(vid)
+    assert len(locs) == 2
+    # Kill one replica.
+    dead = locs[1]
+    env.vs_call(dead, "/admin/delete_volume", {"volume": vid})
+    _freshen(servers)
+    assert len(env.volume_locations(vid)) == 1
+    run_command(env, "lock")
+    out = run_command(env, "volume.fix.replication")
+    assert f"volume {vid}" in out
+    _freshen(servers)
+    assert len(env.volume_locations(vid)) == 2
+
+
+def test_collection_commands(cluster, env):
+    master, servers = cluster
+    client = WeedClient(master.url())
+    client.upload_data(b"x", collection="photos")
+    _freshen(servers)
+    out = run_command(env, "collection.list")
+    assert "photos" in out
+    run_command(env, "lock")
+    out = run_command(env, "collection.delete -collection photos")
+    assert "photos" in out
+
+
+def test_evacuate(cluster, env):
+    master, servers = cluster
+    client, vid, fids = _upload_some(master, n=5)
+    _freshen(servers)
+    node = env.volume_locations(vid)[0]
+    run_command(env, "lock")
+    out = run_command(env, f"volumeServer.evacuate -node {node}")
+    assert "->" in out
+    _freshen(servers)
+    assert node not in env.volume_locations(vid)
+    client.cache.forget(vid)
+    payload, fid = fids[0]
+    assert client.download(fid) == payload
