@@ -74,6 +74,13 @@ class JsonHttpServer:
                 parsed = urllib.parse.urlparse(self.path)
                 query = {k: v[0] for k, v in
                          urllib.parse.parse_qs(parsed.query).items()}
+                # Select request headers handlers care about (Range for
+                # partial reads, Content-Type for upload mime) ride along
+                # in the query dict under reserved keys.
+                if self.headers.get("Range"):
+                    query["_range_header"] = self.headers["Range"]
+                if self.headers.get("Content-Type"):
+                    query["_content_type"] = self.headers["Content-Type"]
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 fn = server.routes.get((method, parsed.path))
@@ -96,13 +103,17 @@ class JsonHttpServer:
                 except Exception as e:  # noqa: BLE001
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
                     return
+                extra = None
                 if isinstance(result, tuple):
-                    status, payload = result
+                    if len(result) == 3:
+                        status, payload, extra = result
+                    else:
+                        status, payload = result
                 else:
                     status, payload = 200, result
-                self._send(status, payload)
+                self._send(status, payload, extra)
 
-            def _send(self, status: int, payload):
+            def _send(self, status: int, payload, extra=None):
                 if hasattr(payload, "read"):  # open file: stream it
                     import shutil
                     size = os.fstat(payload.fileno()).st_size
@@ -110,25 +121,35 @@ class JsonHttpServer:
                     self.send_header("Content-Type",
                                      "application/octet-stream")
                     self.send_header("Content-Length", str(size))
+                    for k, v in (extra or {}).items():
+                        self.send_header(k, v)
                     self.end_headers()
                     with payload:
                         shutil.copyfileobj(payload, self.wfile,
                                            length=1 << 20)
                     return
+                extra = dict(extra or {})
                 if isinstance(payload, (bytes, bytearray)):
                     data = bytes(payload)
-                    ctype = "application/octet-stream"
+                    ctype = extra.pop("Content-Type",
+                                      "application/octet-stream")
                 else:
                     data = json.dumps(payload or {}).encode()
-                    ctype = "application/json"
+                    ctype = extra.pop("Content-Type", "application/json")
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
                 self.end_headers()
-                self.wfile.write(data)
+                if self.command != "HEAD":
+                    self.wfile.write(data)
 
             def do_GET(self):
                 self._dispatch("GET")
+
+            def do_HEAD(self):
+                self._dispatch("HEAD")
 
             def do_POST(self):
                 self._dispatch("POST")

@@ -321,8 +321,9 @@ class VolumeServer:
         threads = []
         me = self.url()
         # Preserve the original query (name/mime/...) so replica needle
-        # bytes are identical to the primary's.
-        fwd = dict(query)
+        # bytes are identical to the primary's.  Reserved _keys carry
+        # request headers, not client parameters — strip them.
+        fwd = {k: v for k, v in query.items() if not k.startswith("_")}
         fwd["type"] = "replicate"
         qs = urllib.parse.urlencode(fwd)
 

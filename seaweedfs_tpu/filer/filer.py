@@ -1,0 +1,291 @@
+"""Filer core: namespace CRUD over a FilerStore + chunk GC + event log.
+
+Reference: weed/filer/filer.go (CreateEntry with recursive parent
+creation :129-235, FindEntry with TTL expiry :250-311, DeleteEntryMetaAndData),
+filer_deletion.go (async chunk deletion pump to volume servers),
+filer_notify.go (NotifyUpdateEvent meta log), meta_aggregator.go
+(subscription fan-out — here a simple in-process pub/sub + ring buffer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable
+
+from .entry import Attributes, Entry, FileChunk
+from .filechunks import minus_chunks
+from .filerstore import FilerStore, MemoryStore, NotFound, _norm
+
+ROOT = Entry(path="/", is_directory=True,
+             attributes=Attributes(mode=0o755))
+
+
+class FilerError(Exception):
+    pass
+
+
+class MetaEvent:
+    """One namespace mutation (filer.proto EventNotification)."""
+
+    __slots__ = ("ts_ns", "directory", "old_entry", "new_entry")
+
+    def __init__(self, directory: str, old_entry: Entry | None,
+                 new_entry: Entry | None, ts_ns: int | None = None):
+        self.ts_ns = ts_ns if ts_ns is not None else time.time_ns()
+        self.directory = directory
+        self.old_entry = old_entry
+        self.new_entry = new_entry
+
+    def to_dict(self) -> dict:
+        return {"ts_ns": self.ts_ns, "directory": self.directory,
+                "old_entry": self.old_entry.to_dict()
+                if self.old_entry else None,
+                "new_entry": self.new_entry.to_dict()
+                if self.new_entry else None}
+
+
+class Filer:
+    def __init__(self, store: FilerStore | None = None,
+                 delete_file_id_fn: Callable[[list[str]], None]
+                 | None = None,
+                 log_capacity: int = 4096):
+        self.store = store or MemoryStore()
+        # Chunk GC: file ids queued here are batch-deleted from the blob
+        # store by the deletion pump (filer_deletion.go).
+        self._delete_fn = delete_file_id_fn
+        self._pending_deletions: list[str] = []
+        self._del_lock = threading.Lock()
+        # Meta log ring buffer + live subscribers (log_buffer + notify).
+        self._log: list[MetaEvent] = []
+        self._log_capacity = log_capacity
+        self._log_lock = threading.Lock()
+        self._subscribers: list[Callable[[MetaEvent], None]] = []
+        self._stop = threading.Event()
+        self._pump = threading.Thread(target=self._deletion_pump,
+                                      daemon=True, name="filer-gc")
+        self._pump.start()
+
+    # -- namespace CRUD ------------------------------------------------------
+
+    def find_entry(self, path: str) -> Entry:
+        path = _norm(path)
+        if path == "/":
+            return ROOT.clone()
+        e = self.store.find_entry(path)
+        if e.is_expired():
+            self._queue_chunk_deletion(e.chunks)
+            self.store.delete_entry(path)
+            self._notify(e.dir, e, None)
+            raise NotFound(path)
+        return e
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.find_entry(path)
+            return True
+        except NotFound:
+            return False
+
+    def create_entry(self, entry: Entry,
+                     o_excl: bool = False) -> Entry:
+        """Insert/overwrite an entry, creating parent directories
+        (CreateEntry, filer.go:129).  Overwriting a file queues its
+        replaced chunks for deletion."""
+        entry.path = _norm(entry.path)
+        if entry.path == "/":
+            return entry
+        self._ensure_parents(entry.dir, entry.attributes)
+        old: Entry | None
+        try:
+            old = self.store.find_entry(entry.path)
+        except NotFound:
+            old = None
+        if old is not None:
+            if o_excl:
+                raise FilerError(f"{entry.path} already exists")
+            if old.is_directory != entry.is_directory:
+                raise FilerError(
+                    f"{entry.path} exists as a "
+                    f"{'directory' if old.is_directory else 'file'}")
+            garbage = minus_chunks(old.chunks, entry.chunks)
+            self._queue_chunk_deletion(garbage)
+        if not entry.attributes.crtime:
+            entry.attributes.crtime = time.time()
+        if not entry.attributes.mtime:
+            entry.attributes.mtime = time.time()
+        self.store.insert_entry(entry)
+        self._notify(entry.dir, old, entry)
+        return entry
+
+    def update_entry(self, entry: Entry) -> Entry:
+        entry.path = _norm(entry.path)
+        old = self.store.find_entry(entry.path)  # must exist
+        garbage = minus_chunks(old.chunks, entry.chunks)
+        self._queue_chunk_deletion(garbage)
+        entry.attributes.mtime = time.time()
+        self.store.update_entry(entry)
+        self._notify(entry.dir, old, entry)
+        return entry
+
+    def _ensure_parents(self, dir_path: str, attr: Attributes) -> None:
+        if dir_path == "/":
+            return
+        try:
+            e = self.store.find_entry(dir_path)
+            if not e.is_directory:
+                raise FilerError(f"{dir_path} is a file, not a directory")
+            return
+        except NotFound:
+            pass
+        parent = dir_path.rsplit("/", 1)[0] or "/"
+        self._ensure_parents(parent, attr)
+        d = Entry(path=dir_path, is_directory=True,
+                  attributes=Attributes(
+                      mtime=time.time(), crtime=time.time(), mode=0o775,
+                      uid=attr.uid, gid=attr.gid,
+                      collection=attr.collection,
+                      replication=attr.replication))
+        self.store.insert_entry(d)
+        self._notify(d.dir, None, d)
+
+    def delete_entry(self, path: str, recursive: bool = False,
+                     ignore_recursive_error: bool = False) -> None:
+        """Delete an entry; directories need recursive=True when non-empty.
+        All referenced chunks are queued for blob deletion."""
+        path = _norm(path)
+        if path == "/":
+            raise FilerError("cannot delete root")
+        e = self.store.find_entry(path)
+        if e.is_directory:
+            children = self.store.list_directory_entries(path, "", True, 2)
+            if children and not recursive:
+                raise FilerError(f"{path} is not empty")
+            for child in list(self._walk(path)):
+                if child.path == path:
+                    continue
+                self._queue_chunk_deletion(child.chunks)
+            self.store.delete_folder_children(path)
+        self._queue_chunk_deletion(e.chunks)
+        self.store.delete_entry(path)
+        self._notify(e.dir, e, None)
+
+    def _walk(self, root: str) -> Iterable[Entry]:
+        from .filerstore import iterate_tree
+        return iterate_tree(self.store, root)
+
+    def list_entries(self, dir_path: str, start_file_name: str = "",
+                     include_start: bool = False,
+                     limit: int = 1024) -> list[Entry]:
+        out: list[Entry] = []
+        start, include = start_file_name, include_start
+        # Refill after expiry filtering: a short page must mean
+        # end-of-directory, or callers stop paginating too early.
+        while len(out) < limit:
+            page = self.store.list_directory_entries(
+                dir_path, start, include, limit - len(out))
+            if not page:
+                break
+            for e in page:
+                if e.is_expired():
+                    self._queue_chunk_deletion(e.chunks)
+                    self.store.delete_entry(e.path)
+                    self._notify(e.dir, e, None)
+                    continue
+                out.append(e)
+            start, include = page[-1].name, False
+        return out
+
+    def rename(self, old_path: str, new_path: str) -> Entry:
+        """AtomicRenameEntry: move an entry (and any subtree) without
+        touching chunk data (filer_grpc_server_rename.go)."""
+        old_path, new_path = _norm(old_path), _norm(new_path)
+        if new_path == old_path or new_path.startswith(old_path + "/"):
+            # Moving a directory under itself would delete the subtree's
+            # parent and orphan the moved entries.
+            raise FilerError(f"cannot move {old_path} under itself")
+        e = self.store.find_entry(old_path)
+        if self.exists(new_path):
+            raise FilerError(f"{new_path} already exists")
+        moves = [(old_path, new_path, e)]
+        if e.is_directory:
+            for child in self._walk(old_path):
+                if child.path == old_path:
+                    continue
+                moves.append((child.path,
+                              new_path + child.path[len(old_path):],
+                              child))
+        self._ensure_parents(
+            new_path.rsplit("/", 1)[0] or "/", e.attributes)
+        for src, dst, entry in moves:
+            entry = entry.clone()
+            entry.path = dst
+            self.store.insert_entry(entry)
+        for src, _dst, entry in reversed(moves):
+            self.store.delete_entry(src)
+        moved = self.store.find_entry(new_path)
+        self._notify(e.dir, e, None)
+        self._notify(moved.dir, None, moved)
+        return moved
+
+    # -- chunk GC ------------------------------------------------------------
+
+    def _queue_chunk_deletion(self, chunks: list[FileChunk]) -> None:
+        if not chunks:
+            return
+        with self._del_lock:
+            self._pending_deletions.extend(c.file_id for c in chunks)
+
+    def _deletion_pump(self) -> None:
+        """Batch-delete queued file ids (loopProcessingDeletion)."""
+        while not self._stop.wait(1.0):
+            self.flush_deletions()
+
+    def flush_deletions(self) -> None:
+        with self._del_lock:
+            batch, self._pending_deletions = self._pending_deletions, []
+        if batch and self._delete_fn is not None:
+            try:
+                self._delete_fn(batch)
+            except Exception:  # noqa: BLE001 — blob servers may be down;
+                with self._del_lock:  # retry next tick
+                    self._pending_deletions = batch + \
+                        self._pending_deletions
+
+    # -- meta log / subscriptions -------------------------------------------
+
+    def _notify(self, directory: str, old: Entry | None,
+                new: Entry | None) -> None:
+        ev = MetaEvent(directory, old, new)
+        with self._log_lock:
+            self._log.append(ev)
+            if len(self._log) > self._log_capacity:
+                self._log = self._log[-self._log_capacity:]
+            subscribers = list(self._subscribers)
+        for fn in subscribers:
+            try:
+                fn(ev)
+            except Exception:  # noqa: BLE001 — one bad subscriber must
+                pass           # not break mutations
+
+    def subscribe(self, fn: Callable[[MetaEvent], None],
+                  since_ns: int = 0) -> Callable[[], None]:
+        """Replay events newer than since_ns, then deliver live events
+        (SubscribeMetadata: replay-from-log then tail).  Returns an
+        unsubscribe function."""
+        with self._log_lock:
+            replay = [ev for ev in self._log if ev.ts_ns > since_ns]
+            self._subscribers.append(fn)
+        for ev in replay:
+            fn(ev)
+
+        def unsubscribe():
+            with self._log_lock:
+                if fn in self._subscribers:
+                    self._subscribers.remove(fn)
+        return unsubscribe
+
+    def close(self) -> None:
+        self._stop.set()
+        self.flush_deletions()
+        self.store.close()

@@ -1,0 +1,288 @@
+"""FilerStore: pluggable metadata backends.
+
+Reference: weed/filer/filerstore.go:20-43 (the interface) and the 11
+backends under weed/filer/{leveldb,redis,mysql,...}.  This build ships:
+
+- MemoryStore  — btree-ish sorted dict (the reference's memdb, test store)
+- SqliteStore  — stdlib sqlite3, the `abstract_sql` moral equivalent and
+                 the durable default (the reference defaults to leveldb;
+                 sqlite is the batteries-included analog here)
+
+Both implement the same five-method contract + KV, and pass the same
+conformance tests (tests/test_filer.py::TestStoreConformance).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import sqlite3
+import threading
+from typing import Iterable
+
+from .entry import Entry
+
+
+class FilerStoreError(Exception):
+    pass
+
+
+class NotFound(FilerStoreError):
+    pass
+
+
+class FilerStore:
+    """The store contract (filerstore.go FilerStore interface)."""
+
+    name = "abstract"
+
+    def insert_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def find_entry(self, path: str) -> Entry:
+        raise NotImplementedError
+
+    def delete_entry(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete_folder_children(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str,
+                               include_start: bool,
+                               limit: int) -> list[Entry]:
+        raise NotImplementedError
+
+    # KV (filer.proto KvGet/KvPut — used for sync checkpoints etc.)
+    def kv_put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def kv_get(self, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def _norm(path: str) -> str:
+    if not path.startswith("/"):
+        raise FilerStoreError(f"path must be absolute: {path!r}")
+    while "//" in path:
+        path = path.replace("//", "/")
+    return path.rstrip("/") or "/"
+
+
+def _dir_key(dir_path: str) -> str:
+    """Key prefix under which a directory's children sort."""
+    return dir_path if dir_path.endswith("/") else dir_path + "/"
+
+
+class MemoryStore(FilerStore):
+    """Sorted-key in-memory store (reference: filer/needle-free memdb)."""
+
+    name = "memory"
+
+    def __init__(self) -> None:
+        self._keys: list[str] = []
+        self._m: dict[str, Entry] = {}
+        self._kv: dict[str, bytes] = {}
+        self._lock = threading.RLock()
+
+    def insert_entry(self, entry: Entry) -> None:
+        path = _norm(entry.path)
+        with self._lock:
+            if path not in self._m:
+                bisect.insort(self._keys, path)
+            self._m[path] = entry.clone()
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry:
+        path = _norm(path)
+        with self._lock:
+            e = self._m.get(path)
+            if e is None:
+                raise NotFound(path)
+            return e.clone()
+
+    def delete_entry(self, path: str) -> None:
+        path = _norm(path)
+        with self._lock:
+            if path in self._m:
+                del self._m[path]
+                i = bisect.bisect_left(self._keys, path)
+                del self._keys[i]
+
+    def delete_folder_children(self, path: str) -> None:
+        prefix = _dir_key(_norm(path))
+        with self._lock:
+            lo = bisect.bisect_left(self._keys, prefix)
+            hi = bisect.bisect_left(self._keys, prefix + "￿")
+            for k in self._keys[lo:hi]:
+                del self._m[k]
+            del self._keys[lo:hi]
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str,
+                               include_start: bool,
+                               limit: int) -> list[Entry]:
+        prefix = _dir_key(_norm(dir_path))
+        with self._lock:
+            if start_file_name:
+                key = prefix + start_file_name
+                lo = bisect.bisect_left(self._keys, key)
+                if (not include_start and lo < len(self._keys)
+                        and self._keys[lo] == key):
+                    lo += 1
+            else:
+                lo = bisect.bisect_left(self._keys, prefix)
+            out = []
+            for k in self._keys[lo:]:
+                if not k.startswith(prefix):
+                    break
+                if "/" in k[len(prefix):]:
+                    continue  # grandchildren don't list here
+                out.append(self._m[k].clone())
+                if len(out) >= limit:
+                    break
+            return out
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._kv[key] = bytes(value)
+
+    def kv_get(self, key: str) -> bytes | None:
+        with self._lock:
+            return self._kv.get(key)
+
+
+class SqliteStore(FilerStore):
+    """sqlite3-backed store — the abstract_sql analog
+    (filer/abstract_sql/abstract_sql_store.go: dirhash+name keyed table;
+    here (dir, name) with a covering index, same listing semantics)."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS filemeta ("
+                " dir TEXT NOT NULL, name TEXT NOT NULL,"
+                " meta TEXT NOT NULL, PRIMARY KEY (dir, name))")
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS filer_kv ("
+                " k TEXT PRIMARY KEY, v BLOB NOT NULL)")
+            self._db.commit()
+
+    @staticmethod
+    def _split(path: str) -> tuple[str, str]:
+        path = _norm(path)
+        if path == "/":
+            return "", "/"
+        d, name = path.rsplit("/", 1)
+        return d or "/", name
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = self._split(entry.path)
+        meta = json.dumps(entry.to_dict())
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO filemeta (dir, name, meta) "
+                "VALUES (?, ?, ?)", (d, name, meta))
+            self._db.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry:
+        d, name = self._split(path)
+        with self._lock:
+            row = self._db.execute(
+                "SELECT meta FROM filemeta WHERE dir=? AND name=?",
+                (d, name)).fetchone()
+        if row is None:
+            raise NotFound(path)
+        return Entry.from_dict(json.loads(row[0]))
+
+    def delete_entry(self, path: str) -> None:
+        d, name = self._split(path)
+        with self._lock:
+            self._db.execute(
+                "DELETE FROM filemeta WHERE dir=? AND name=?", (d, name))
+            self._db.commit()
+
+    def delete_folder_children(self, path: str) -> None:
+        path = _norm(path)
+        # Escape LIKE metacharacters: '_'/'%' are legal in file names and
+        # unescaped would match siblings (e.g. /a_b matching /axb).
+        pat = (_dir_key(path).replace("\\", "\\\\")
+               .replace("%", "\\%").replace("_", "\\_")) + "%"
+        with self._lock:
+            self._db.execute(
+                "DELETE FROM filemeta WHERE dir=? OR dir LIKE ? "
+                "ESCAPE '\\'", (path, pat))
+            self._db.commit()
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str,
+                               include_start: bool,
+                               limit: int) -> list[Entry]:
+        d = _norm(dir_path)
+        op = ">=" if include_start else ">"
+        with self._lock:
+            rows = self._db.execute(
+                f"SELECT meta FROM filemeta WHERE dir=? AND name {op} ? "
+                "ORDER BY name LIMIT ?",
+                (d, start_file_name, limit)).fetchall()
+        return [Entry.from_dict(json.loads(r[0])) for r in rows]
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO filer_kv (k, v) VALUES (?, ?)",
+                (key, sqlite3.Binary(bytes(value))))
+            self._db.commit()
+
+    def kv_get(self, key: str) -> bytes | None:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT v FROM filer_kv WHERE k=?", (key,)).fetchone()
+        return bytes(row[0]) if row else None
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
+def store_for_path(path: str | None) -> FilerStore:
+    """Factory: None -> memory, else sqlite file (scaffold's store choice)."""
+    if path is None:
+        return MemoryStore()
+    return SqliteStore(path)
+
+
+def iterate_tree(store: FilerStore, root: str,
+                 batch: int = 1024) -> Iterable[Entry]:
+    """Depth-first walk of a subtree (util for fs.du/meta.save/sync)."""
+    try:
+        root_entry = store.find_entry(root)
+    except NotFound:
+        return
+    yield root_entry
+    if not root_entry.is_directory:
+        return
+    stack = [root]
+    while stack:
+        d = stack.pop()
+        start, include = "", True
+        while True:
+            entries = store.list_directory_entries(d, start, include, batch)
+            if not entries:
+                break
+            for e in entries:
+                yield e
+                if e.is_directory:
+                    stack.append(e.path)
+            start, include = entries[-1].name, False

@@ -1,0 +1,224 @@
+"""Filer HTTP server: path-addressed file API over the blob store.
+
+Reference: weed/server/filer_server.go + filer_server_handlers_*.go:
+
+  GET    /path/to/file          content (Range supported)
+  GET    /path/to/dir/          JSON listing (?limit=&lastFileName=)
+  GET    /path?metadata=true    entry metadata JSON
+  POST   /path/to/file          upload (auto-chunked, _write_autochunk.go)
+  PUT    /path/to/file          same
+  POST   /path?mv.to=/new/path  rename (AtomicRenameEntry)
+  DELETE /path[?recursive=true] delete entry / subtree
+  GET    /.meta/subscribe?since_ns=  meta events since a timestamp
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+
+from ..cluster import rpc
+from ..cluster.client import WeedClient
+from .entry import Attributes, Entry
+from .filechunks import etag as chunks_etag, total_size
+from .filer import Filer, FilerError
+from .filerstore import NotFound, store_for_path
+from .stream import ChunkedWriter, ChunkStreamer
+
+
+class FilerServer:
+    def __init__(self, master_url: str, host: str = "127.0.0.1",
+                 port: int = 0, store_path: str | None = None,
+                 chunk_size: int = 4 * 1024 * 1024,
+                 collection: str = "", replication: str | None = None):
+        self.master_url = master_url
+        self.client = WeedClient(master_url)
+        self.chunk_size = chunk_size
+        self.collection = collection
+        self.replication = replication
+        self.filer = Filer(store=store_for_path(store_path),
+                           delete_file_id_fn=self._delete_file_ids)
+        self.streamer = ChunkStreamer(self.client)
+        self.server = rpc.JsonHttpServer(host, port)
+        s = self.server
+        s.route("GET", "/.meta/subscribe", self._meta_subscribe)
+        s.prefix_route("GET", "/", self._get)
+        s.prefix_route("HEAD", "/", self._head)
+        s.prefix_route("POST", "/", self._post)
+        s.prefix_route("PUT", "/", self._post)
+        s.prefix_route("DELETE", "/", self._delete)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.filer.close()
+
+    def url(self) -> str:
+        return self.server.url()
+
+    def _delete_file_ids(self, fids: list[str]) -> None:
+        for fid in fids:
+            try:
+                self.client.delete(fid)
+            except Exception:  # noqa: BLE001 — volume may be down/EC'd;
+                pass           # orphan blobs are vacuum's problem
+
+    # -- read ----------------------------------------------------------------
+
+    def _get(self, path: str, query: dict, body: bytes,
+             head: bool = False):
+        path = urllib.parse.unquote(path)
+        is_dir_request = path.endswith("/") and path != "/"
+        lookup = path.rstrip("/") or "/"
+        try:
+            e = self.filer.find_entry(lookup)
+        except NotFound:
+            raise rpc.RpcError(404, f"{lookup} not found") from None
+        if query.get("metadata") == "true":
+            return e.to_dict()
+        if e.is_directory:
+            return self._list_dir(lookup, query)
+        if is_dir_request:
+            raise rpc.RpcError(404, f"{lookup} is a file")
+        return self._serve_file(e, query, head=head)
+
+    def _head(self, path: str, query: dict, body: bytes):
+        return self._get(path, query, body, head=True)
+
+    def _list_dir(self, path: str, query: dict) -> dict:
+        limit = int(query.get("limit", 1024))
+        last = query.get("lastFileName", "")
+        entries = self.filer.list_entries(path, last, False, limit)
+        return {
+            "path": path,
+            "entries": [self._entry_summary(e) for e in entries],
+            "lastFileName": entries[-1].name if entries else "",
+            "shouldDisplayLoadMore": len(entries) >= limit,
+        }
+
+    @staticmethod
+    def _entry_summary(e: Entry) -> dict:
+        return {"FullPath": e.path, "name": e.name,
+                "is_directory": e.is_directory, "size": e.size(),
+                "mtime": e.attributes.mtime, "mode": e.attributes.mode,
+                "mime": e.attributes.mime}
+
+    def _serve_file(self, e: Entry, query: dict, head: bool = False):
+        size = total_size(e.chunks)
+        mime = e.attributes.mime or "application/octet-stream"
+        headers = {"Content-Type": mime, "Accept-Ranges": "bytes",
+                   "ETag": f'"{chunks_etag(e.chunks)}"' if e.chunks
+                   else '""'}
+        if head:  # never materialize chunks just to discard the body
+            headers["X-File-Size"] = str(size)
+            return (200, b"", headers)
+        rng = self._parse_range(query.get("_range_header", ""), size)
+        if rng is not None:
+            lo, hi = rng
+            if lo > hi:
+                raise rpc.RpcError(416, "range not satisfiable")
+            data = self.streamer.read(e.chunks, lo, hi - lo + 1)
+            headers["Content-Range"] = f"bytes {lo}-{hi}/{size}"
+            return (206, data, headers)
+        return (200, self.streamer.read(e.chunks), headers)
+
+    @staticmethod
+    def _parse_range(rng: str, size: int) -> tuple[int, int] | None:
+        """Single-range 'bytes=' header -> (lo, hi) inclusive; None means
+        serve the whole file (RFC 7233: ignore unparseable ranges)."""
+        if not rng.startswith("bytes=") or "," in rng:
+            return None
+        lo_s, _, hi_s = rng[6:].partition("-")
+        try:
+            if lo_s:
+                lo = int(lo_s)
+                hi = int(hi_s) if hi_s else size - 1
+            else:  # suffix form: bytes=-N
+                lo = max(size - int(hi_s), 0)
+                hi = size - 1
+        except ValueError:
+            return None
+        return lo, min(hi, size - 1)
+
+    # -- write ---------------------------------------------------------------
+
+    def _post(self, path: str, query: dict, body: bytes):
+        path = urllib.parse.unquote(path).rstrip("/") or "/"
+        if "mv.to" in query:
+            dst = query["mv.to"]
+            try:
+                self.filer.rename(path, dst)
+            except NotFound:
+                raise rpc.RpcError(404, f"{path} not found") from None
+            except FilerError as e:
+                raise rpc.RpcError(400, str(e)) from None
+            return {"from": path, "to": dst}
+        if query.get("mkdir") == "true":
+            try:
+                self.filer.create_entry(Entry(
+                    path=path, is_directory=True,
+                    attributes=Attributes(mtime=time.time(),
+                                          crtime=time.time(), mode=0o775)))
+            except FilerError as e:
+                raise rpc.RpcError(409, str(e)) from None
+            return {"path": path, "is_directory": True}
+        if path == "/":
+            raise rpc.RpcError(400, "cannot upload to the root directory")
+        collection = query.get("collection", self.collection)
+        ttl = query.get("ttl", "")
+        writer = ChunkedWriter(
+            self.client, chunk_size=self.chunk_size,
+            collection=collection, replication=self.replication, ttl=ttl)
+        chunks = writer.write(body)
+        attr = Attributes(
+            mtime=time.time(), crtime=time.time(),
+            mime=query.get("_content_type",
+                           "application/octet-stream"),
+            ttl_sec=_ttl_seconds(ttl), collection=collection,
+            replication=self.replication or "")
+        try:
+            entry = self.filer.create_entry(
+                Entry(path=path, chunks=chunks, attributes=attr))
+        except FilerError as e:
+            # Roll back the uploaded chunks: the entry never existed.
+            self._delete_file_ids([c.file_id for c in chunks])
+            raise rpc.RpcError(409, str(e)) from None
+        return {"name": entry.name, "size": total_size(chunks),
+                "eTag": chunks_etag(chunks)}
+
+    # -- delete --------------------------------------------------------------
+
+    def _delete(self, path: str, query: dict, body: bytes):
+        path = urllib.parse.unquote(path).rstrip("/") or "/"
+        recursive = query.get("recursive") == "true"
+        try:
+            self.filer.delete_entry(path, recursive=recursive)
+        except NotFound:
+            raise rpc.RpcError(404, f"{path} not found") from None
+        except FilerError as e:
+            raise rpc.RpcError(400, str(e)) from None
+        return {"deleted": path}
+
+    # -- meta subscription ---------------------------------------------------
+
+    def _meta_subscribe(self, query: dict, body: bytes) -> dict:
+        """Poll-based metadata tail: events newer than since_ns
+        (SubscribeMetadata's replay half; clients poll to tail)."""
+        since = int(query.get("since_ns", 0))
+        with self.filer._log_lock:
+            events = [ev.to_dict() for ev in self.filer._log
+                      if ev.ts_ns > since]
+        return {"events": events,
+                "last_ns": events[-1]["ts_ns"] if events else since}
+
+
+def _ttl_seconds(ttl: str) -> int:
+    if not ttl:
+        return 0
+    from ..core.ttl import TTL
+    return TTL.parse(ttl).minutes() * 60

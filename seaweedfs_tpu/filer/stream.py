@@ -1,0 +1,135 @@
+"""Streaming chunked file content to/from the blob store.
+
+Reference: weed/filer/stream.go (StreamContent), reader_at.go
+(ChunkReadAt with chunk cache), operation/upload_content.go.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Iterator
+
+from ..cluster.client import WeedClient
+from .entry import FileChunk
+from .filechunks import read_chunk_views, total_size
+
+
+class ChunkCache:
+    """Tiny LRU of chunk bytes (reference: util/chunk_cache tiered cache)."""
+
+    def __init__(self, capacity_bytes: int = 64 * 1024 * 1024):
+        self.capacity = capacity_bytes
+        self._m: OrderedDict[str, bytes] = OrderedDict()
+        self._size = 0
+        self._lock = threading.Lock()
+
+    def get(self, file_id: str) -> bytes | None:
+        with self._lock:
+            data = self._m.get(file_id)
+            if data is not None:
+                self._m.move_to_end(file_id)
+            return data
+
+    def put(self, file_id: str, data: bytes) -> None:
+        if len(data) > self.capacity:
+            return
+        with self._lock:
+            if file_id in self._m:
+                return
+            self._m[file_id] = data
+            self._size += len(data)
+            while self._size > self.capacity:
+                _k, v = self._m.popitem(last=False)
+                self._size -= len(v)
+
+
+class ChunkStreamer:
+    """Resolves chunk views and fetches the bytes (StreamContent)."""
+
+    def __init__(self, client: WeedClient,
+                 cache: ChunkCache | None = None):
+        self.client = client
+        self.cache = cache or ChunkCache()
+
+    def _fetch(self, file_id: str) -> bytes:
+        data = self.cache.get(file_id)
+        if data is None:
+            data = self.client.download(file_id)
+            self.cache.put(file_id, data)
+        return data
+
+    def read(self, chunks: list[FileChunk], offset: int = 0,
+             size: int = -1) -> bytes:
+        """Materialize byte range [offset, offset+size) (gaps are zeros,
+        like a sparse file)."""
+        file_size = total_size(chunks)
+        if size < 0:
+            size = max(file_size - offset, 0)
+        size = min(size, max(file_size - offset, 0))
+        if size <= 0:
+            return b""
+        out = bytearray(size)
+        for view in read_chunk_views(chunks, offset, size):
+            data = self._fetch(view.file_id)
+            piece = data[view.offset_in_chunk:
+                         view.offset_in_chunk + view.size]
+            lo = view.logical_offset - offset
+            out[lo:lo + len(piece)] = piece
+        return bytes(out)
+
+    def iter_content(self, chunks: list[FileChunk], offset: int = 0,
+                     size: int = -1,
+                     chunk_bytes: int = 4 * 1024 * 1024
+                     ) -> Iterator[bytes]:
+        """Yield the range in bounded pieces (HTTP streaming)."""
+        file_size = total_size(chunks)
+        if size < 0:
+            size = max(file_size - offset, 0)
+        end = offset + min(size, max(file_size - offset, 0))
+        pos = offset
+        while pos < end:
+            n = min(chunk_bytes, end - pos)
+            yield self.read(chunks, pos, n)
+            pos += n
+
+
+class ChunkedWriter:
+    """Upload a byte stream as fixed-size chunks (the filer's auto-chunk
+    upload, filer_server_handlers_write_autochunk.go:188)."""
+
+    def __init__(self, client: WeedClient, chunk_size: int = 4 * 1024 * 1024,
+                 collection: str = "", replication: str | None = None,
+                 ttl: str = ""):
+        self.client = client
+        self.chunk_size = chunk_size
+        self.collection = collection
+        self.replication = replication
+        self.ttl = ttl
+
+    def write(self, reader, offset: int = 0) -> list[FileChunk]:
+        """Consume reader (bytes or file-like), upload chunk_size pieces,
+        return the FileChunk list starting at logical `offset`."""
+        if isinstance(reader, (bytes, bytearray)):
+            data = bytes(reader)
+            import io
+            reader = io.BytesIO(data)
+        chunks: list[FileChunk] = []
+        pos = offset
+        while True:
+            piece = reader.read(self.chunk_size)
+            if not piece:
+                break
+            a = self.client.assign(collection=self.collection,
+                                   replication=self.replication,
+                                   ttl=self.ttl)
+            fid = a["fid"]
+            from ..cluster import rpc
+            resp = rpc.call(f"http://{a['url']}/{fid}", "POST", piece)
+            etag = resp.get("eTag", "") if isinstance(resp, dict) else ""
+            chunks.append(FileChunk(file_id=fid, offset=pos,
+                                    size=len(piece),
+                                    mtime=time.time_ns(), etag=etag))
+            pos += len(piece)
+        return chunks

@@ -1,0 +1,357 @@
+"""Filer layer: chunk algebra, store conformance, namespace core.
+
+Mirrors the reference's pure-function test style for the chunk model
+(weed/filer/filechunks_test.go) and per-backend store conformance
+(filer/leveldb/leveldb_store_test.go).
+"""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import (Attributes, Entry, FileChunk, Filer,
+                                 FilerError, MemoryStore, SqliteStore,
+                                 compact_file_chunks,
+                                 non_overlapping_visible_intervals,
+                                 read_chunk_views, total_size)
+from seaweedfs_tpu.filer.filerstore import NotFound, iterate_tree
+
+
+def C(fid, offset, size, mtime):
+    return FileChunk(file_id=fid, offset=offset, size=size, mtime=mtime)
+
+
+# -- chunk algebra (filechunks_test.go scenarios) ---------------------------
+
+class TestVisibleIntervals:
+    def test_append_only(self):
+        vis = non_overlapping_visible_intervals(
+            [C("a", 0, 100, 1), C("b", 100, 100, 2)])
+        assert [(v.start, v.stop, v.file_id) for v in vis] == \
+            [(0, 100, "a"), (100, 200, "b")]
+
+    def test_full_overwrite(self):
+        vis = non_overlapping_visible_intervals(
+            [C("a", 0, 100, 1), C("b", 0, 100, 2)])
+        assert [(v.start, v.stop, v.file_id) for v in vis] == \
+            [(0, 100, "b")]
+
+    def test_partial_tail_overwrite(self):
+        vis = non_overlapping_visible_intervals(
+            [C("a", 0, 100, 1), C("b", 50, 100, 2)])
+        assert [(v.start, v.stop, v.file_id) for v in vis] == \
+            [(0, 50, "a"), (50, 150, "b")]
+
+    def test_hole_punch_middle(self):
+        vis = non_overlapping_visible_intervals(
+            [C("a", 0, 300, 1), C("b", 100, 100, 2)])
+        assert [(v.start, v.stop, v.file_id, v.chunk_offset)
+                for v in vis] == \
+            [(0, 100, "a", 0), (100, 200, "b", 0), (200, 300, "a", 200)]
+
+    def test_older_chunk_arrives_later_in_list(self):
+        # List order must not matter — only mtime does.
+        vis = non_overlapping_visible_intervals(
+            [C("b", 0, 100, 2), C("a", 0, 200, 1)])
+        assert [(v.start, v.stop, v.file_id) for v in vis] == \
+            [(0, 100, "b"), (100, 200, "a")]
+
+    def test_interleaved_writes(self):
+        vis = non_overlapping_visible_intervals([
+            C("a", 0, 100, 1), C("b", 50, 100, 2), C("c", 25, 50, 3)])
+        assert [(v.start, v.stop, v.file_id) for v in vis] == \
+            [(0, 25, "a"), (25, 75, "c"), (75, 150, "b")]
+
+    def test_random_writes_against_oracle(self):
+        import random
+        rng = random.Random(42)
+        for _trial in range(50):
+            file_len = 1000
+            oracle = [None] * file_len
+            chunks = []
+            for mtime in range(1, 16):
+                off = rng.randrange(0, file_len - 10)
+                size = rng.randrange(1, file_len - off)
+                fid = f"f{mtime}"
+                chunks.append(C(fid, off, size, mtime))
+                for i in range(off, off + size):
+                    oracle[i] = fid
+            rng.shuffle(chunks)
+            vis = non_overlapping_visible_intervals(chunks)
+            # disjoint + sorted
+            for u, v in zip(vis, vis[1:]):
+                assert u.stop <= v.start
+            got = [None] * file_len
+            for v in vis:
+                for i in range(v.start, min(v.stop, file_len)):
+                    got[i] = v.file_id
+            assert got == oracle
+
+
+class TestReadViews:
+    def test_view_clipping(self):
+        chunks = [C("a", 0, 100, 1), C("b", 100, 100, 2)]
+        views = read_chunk_views(chunks, 50, 100)
+        assert [(v.file_id, v.offset_in_chunk, v.size, v.logical_offset)
+                for v in views] == [("a", 50, 50, 50), ("b", 0, 50, 100)]
+
+    def test_view_inside_remnant(self):
+        # overwrite middle, then read from the tail remnant: the
+        # offset_in_chunk must account for the clipped head.
+        chunks = [C("a", 0, 300, 1), C("b", 100, 100, 2)]
+        views = read_chunk_views(chunks, 250, 50)
+        assert [(v.file_id, v.offset_in_chunk, v.size) for v in views] == \
+            [("a", 250, 50)]
+
+
+def test_compact_chunks():
+    chunks = [C("a", 0, 100, 1), C("b", 0, 50, 2), C("c", 50, 50, 3)]
+    compacted, garbage = compact_file_chunks(chunks)
+    assert {c.file_id for c in compacted} == {"b", "c"}
+    assert {c.file_id for c in garbage} == {"a"}
+
+
+def test_total_size():
+    assert total_size([]) == 0
+    assert total_size([C("a", 0, 100, 1), C("b", 50, 100, 2)]) == 150
+
+
+# -- store conformance -------------------------------------------------------
+
+@pytest.fixture(params=["memory", "sqlite", "sqlite-file"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        s = MemoryStore()
+    elif request.param == "sqlite":
+        s = SqliteStore()
+    else:
+        s = SqliteStore(str(tmp_path / "filer.db"))
+    yield s
+    s.close()
+
+
+class TestStoreConformance:
+    def test_insert_find_delete(self, store):
+        e = Entry(path="/a/b/c.txt", attributes=Attributes(mtime=1.0))
+        store.insert_entry(e)
+        got = store.find_entry("/a/b/c.txt")
+        assert got.path == "/a/b/c.txt"
+        assert got.attributes.mtime == 1.0
+        store.delete_entry("/a/b/c.txt")
+        with pytest.raises(NotFound):
+            store.find_entry("/a/b/c.txt")
+
+    def test_find_missing(self, store):
+        with pytest.raises(NotFound):
+            store.find_entry("/nope")
+
+    def test_update_overwrites(self, store):
+        store.insert_entry(Entry(path="/x", attributes=Attributes(uid=1)))
+        store.update_entry(Entry(path="/x", attributes=Attributes(uid=2)))
+        assert store.find_entry("/x").attributes.uid == 2
+
+    def test_listing_order_and_pagination(self, store):
+        names = ["a.txt", "b.txt", "c.txt", "d.txt"]
+        for n in names:
+            store.insert_entry(Entry(path=f"/dir/{n}"))
+        store.insert_entry(Entry(path="/dir/sub", is_directory=True))
+        store.insert_entry(Entry(path="/dir/sub/nested.txt"))
+        got = store.list_directory_entries("/dir", "", True, 100)
+        assert [e.name for e in got] == names + ["sub"]
+        # pagination: resume after b.txt
+        got = store.list_directory_entries("/dir", "b.txt", False, 2)
+        assert [e.name for e in got] == ["c.txt", "d.txt"]
+        # inclusive start
+        got = store.list_directory_entries("/dir", "b.txt", True, 2)
+        assert [e.name for e in got] == ["b.txt", "c.txt"]
+
+    def test_delete_folder_children(self, store):
+        store.insert_entry(Entry(path="/d", is_directory=True))
+        store.insert_entry(Entry(path="/d/x"))
+        store.insert_entry(Entry(path="/d/sub", is_directory=True))
+        store.insert_entry(Entry(path="/d/sub/y"))
+        store.insert_entry(Entry(path="/dz"))  # sibling, must survive
+        store.delete_folder_children("/d")
+        assert store.find_entry("/d") is not None
+        assert store.find_entry("/dz") is not None
+        with pytest.raises(NotFound):
+            store.find_entry("/d/x")
+        with pytest.raises(NotFound):
+            store.find_entry("/d/sub/y")
+
+    def test_delete_folder_children_like_metachars(self, store):
+        # '_' in SQL LIKE matches any char: /a_b must not delete /axb's.
+        store.insert_entry(Entry(path="/a_b", is_directory=True))
+        store.insert_entry(Entry(path="/a_b/gone"))
+        store.insert_entry(Entry(path="/axb", is_directory=True))
+        store.insert_entry(Entry(path="/axb/kept"))
+        store.insert_entry(Entry(path="/axb/sub", is_directory=True))
+        store.insert_entry(Entry(path="/axb/sub/kept2"))
+        store.delete_folder_children("/a_b")
+        assert store.find_entry("/axb/kept") is not None
+        assert store.find_entry("/axb/sub/kept2") is not None
+        with pytest.raises(NotFound):
+            store.find_entry("/a_b/gone")
+
+    def test_chunks_roundtrip(self, store):
+        e = Entry(path="/f", chunks=[C("3,abc123", 0, 10, 5)])
+        store.insert_entry(e)
+        got = store.find_entry("/f")
+        assert got.chunks[0].file_id == "3,abc123"
+        assert got.chunks[0].size == 10
+
+    def test_kv(self, store):
+        assert store.kv_get("k") is None
+        store.kv_put("k", b"v1")
+        assert store.kv_get("k") == b"v1"
+        store.kv_put("k", b"v2")
+        assert store.kv_get("k") == b"v2"
+
+    def test_iterate_tree(self, store):
+        for p in ("/t/a", "/t/b/c", "/t/b/d"):
+            d = p.rsplit("/", 1)[0]
+            parts = d.split("/")
+            for i in range(2, len(parts) + 1):
+                store.insert_entry(Entry(path="/".join(parts[:i]),
+                                         is_directory=True))
+            store.insert_entry(Entry(path=p))
+        paths = {e.path for e in iterate_tree(store, "/t")}
+        assert paths == {"/t", "/t/a", "/t/b", "/t/b/c", "/t/b/d"}
+
+
+# -- filer core --------------------------------------------------------------
+
+class TestFiler:
+    def test_create_makes_parents(self):
+        f = Filer()
+        f.create_entry(Entry(path="/a/b/c/file.txt"))
+        assert f.find_entry("/a").is_directory
+        assert f.find_entry("/a/b/c").is_directory
+        assert not f.find_entry("/a/b/c/file.txt").is_directory
+        f.close()
+
+    def test_overwrite_queues_old_chunks(self):
+        deleted = []
+        f = Filer(delete_file_id_fn=deleted.extend)
+        f.create_entry(Entry(path="/f", chunks=[C("1,aa", 0, 10, 1)]))
+        f.create_entry(Entry(path="/f", chunks=[C("1,bb", 0, 20, 2)]))
+        f.flush_deletions()
+        assert deleted == ["1,aa"]
+        f.close()
+
+    def test_delete_recursive_collects_chunks(self):
+        deleted = []
+        f = Filer(delete_file_id_fn=deleted.extend)
+        f.create_entry(Entry(path="/d/x", chunks=[C("1,x", 0, 1, 1)]))
+        f.create_entry(Entry(path="/d/sub/y", chunks=[C("1,y", 0, 1, 1)]))
+        with pytest.raises(FilerError):
+            f.delete_entry("/d")  # non-empty, not recursive
+        f.delete_entry("/d", recursive=True)
+        f.flush_deletions()
+        assert sorted(deleted) == ["1,x", "1,y"]
+        assert not f.exists("/d")
+        assert not f.exists("/d/sub/y")
+        f.close()
+
+    def test_o_excl(self):
+        f = Filer()
+        f.create_entry(Entry(path="/f"))
+        with pytest.raises(FilerError):
+            f.create_entry(Entry(path="/f"), o_excl=True)
+        f.close()
+
+    def test_file_dir_conflict(self):
+        f = Filer()
+        f.create_entry(Entry(path="/x"))
+        with pytest.raises(FilerError):
+            f.create_entry(Entry(path="/x/y"))  # /x is a file
+        f.close()
+
+    def test_rename_file_and_tree(self):
+        f = Filer()
+        f.create_entry(Entry(path="/old/deep/f1", chunks=[C("1,a", 0, 5, 1)]))
+        f.create_entry(Entry(path="/old/f2"))
+        f.rename("/old", "/new")
+        assert f.find_entry("/new/deep/f1").chunks[0].file_id == "1,a"
+        assert f.exists("/new/f2")
+        assert not f.exists("/old")
+        f.close()
+
+    def test_rename_refuses_move_under_itself(self):
+        f = Filer()
+        f.create_entry(Entry(path="/d/x"))
+        with pytest.raises(FilerError):
+            f.rename("/d", "/d/sub")
+        with pytest.raises(FilerError):
+            f.rename("/d", "/d")
+        assert f.exists("/d/x")
+        f.close()
+
+    def test_rename_refuses_overwrite(self):
+        f = Filer()
+        f.create_entry(Entry(path="/a"))
+        f.create_entry(Entry(path="/b"))
+        with pytest.raises(FilerError):
+            f.rename("/a", "/b")
+        f.close()
+
+    def test_ttl_expiry(self):
+        deleted = []
+        f = Filer(delete_file_id_fn=deleted.extend)
+        e = Entry(path="/tmp/x", chunks=[C("1,t", 0, 1, 1)],
+                  attributes=Attributes(ttl_sec=1,
+                                        crtime=time.time() - 10))
+        f.create_entry(e)
+        assert not f.exists("/tmp/x")  # expired on read
+        f.flush_deletions()
+        assert deleted == ["1,t"]
+        f.close()
+
+    def test_listing_skips_expired(self):
+        f = Filer()
+        f.create_entry(Entry(path="/d/live"))
+        f.create_entry(Entry(
+            path="/d/dead",
+            attributes=Attributes(ttl_sec=1, crtime=time.time() - 10)))
+        names = [e.name for e in f.list_entries("/d")]
+        assert names == ["live"]
+        f.close()
+
+    def test_listing_refills_page_after_expiry(self):
+        # expired entries inside a page must not truncate pagination.
+        f = Filer()
+        expired = Attributes(ttl_sec=1, crtime=time.time() - 10)
+        for i in range(4):
+            f.create_entry(Entry(path=f"/p/a{i}", attributes=expired))
+        for i in range(3):
+            f.create_entry(Entry(path=f"/p/z{i}"))
+        got = f.list_entries("/p", limit=3)
+        assert [e.name for e in got] == ["z0", "z1", "z2"]
+        f.close()
+
+    def test_subscribe_replay_and_tail(self):
+        f = Filer()
+        f.create_entry(Entry(path="/one"))
+        events = []
+        unsub = f.subscribe(lambda ev: events.append(ev))
+        # replayed /one (and its parent creations)
+        assert any(ev.new_entry and ev.new_entry.path == "/one"
+                   for ev in events)
+        n = len(events)
+        f.create_entry(Entry(path="/two"))
+        assert len(events) > n
+        assert events[-1].new_entry.path == "/two"
+        unsub()
+        f.create_entry(Entry(path="/three"))
+        assert events[-1].new_entry.path == "/two"
+        f.close()
+
+    def test_sqlite_backed_filer(self, tmp_path):
+        db = str(tmp_path / "meta.db")
+        f = Filer(store=SqliteStore(db))
+        f.create_entry(Entry(path="/persist/me",
+                             chunks=[C("2,zz", 0, 7, 1)]))
+        f.close()
+        f2 = Filer(store=SqliteStore(db))
+        assert f2.find_entry("/persist/me").chunks[0].file_id == "2,zz"
+        f2.close()

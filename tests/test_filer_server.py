@@ -1,0 +1,196 @@
+"""Filer HTTP server against a live master + volume server.
+
+Covers auto-chunked uploads, range reads, listings, rename, recursive
+delete, and chunk GC (reference: weed/server/filer_server_handlers_*).
+"""
+
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer.server import FilerServer
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("filer-stack")
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp / "vs")], pulse_seconds=60)
+    vs.start()
+    filer = FilerServer(master.url(), chunk_size=64)  # tiny: force chunking
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def _url(filer, path):
+    return f"{filer.url()}{path}"
+
+
+def _req(filer, path, method="GET", data=None, headers=None):
+    req = urllib.request.Request(_url(filer, path), data=data,
+                                 method=method, headers=headers or {})
+    return urllib.request.urlopen(req, timeout=10)
+
+
+def test_upload_download_roundtrip(stack):
+    _m, _vs, filer = stack
+    body = b"hello filer world " * 40  # 720B -> 12 chunks of 64
+    with _req(filer, "/docs/hello.txt", "POST", body,
+              {"Content-Type": "text/plain"}) as resp:
+        import json
+        meta = json.load(resp)
+    assert meta["size"] == len(body)
+    with _req(filer, "/docs/hello.txt") as resp:
+        assert resp.read() == body
+        assert resp.headers["Content-Type"] == "text/plain"
+
+
+def test_range_read(stack):
+    _m, _vs, filer = stack
+    body = bytes(range(256)) * 4  # 1024B across 16 chunks
+    _req(filer, "/range.bin", "POST", body).read()
+    with _req(filer, "/range.bin", headers={"Range": "bytes=100-299"}) as r:
+        assert r.status == 206
+        assert r.read() == body[100:300]
+        assert r.headers["Content-Range"] == "bytes 100-299/1024"
+    with _req(filer, "/range.bin", headers={"Range": "bytes=-50"}) as r:
+        assert r.read() == body[-50:]
+    with _req(filer, "/range.bin", headers={"Range": "bytes=1000-"}) as r:
+        assert r.read() == body[1000:]
+
+
+def test_directory_listing_and_metadata(stack):
+    _m, _vs, filer = stack
+    for name in ("a.txt", "b.txt", "c.txt"):
+        _req(filer, f"/listdir/{name}", "POST", b"x").read()
+    import json
+    with _req(filer, "/listdir/") as resp:
+        listing = json.load(resp)
+    assert [e["name"] for e in listing["entries"]] == \
+        ["a.txt", "b.txt", "c.txt"]
+    with _req(filer, "/listdir/?limit=1&lastFileName=a.txt") as resp:
+        listing = json.load(resp)
+    assert [e["name"] for e in listing["entries"]] == ["b.txt"]
+    with _req(filer, "/listdir/a.txt?metadata=true") as resp:
+        meta = json.load(resp)
+    assert meta["path"] == "/listdir/a.txt"
+    assert meta["chunks"][0]["size"] == 1
+
+
+def test_rename(stack):
+    _m, _vs, filer = stack
+    _req(filer, "/mv/src.txt", "POST", b"move-payload").read()
+    _req(filer, "/mv/src.txt?mv.to=/mv/dst.txt", "POST", b"").read()
+    with _req(filer, "/mv/dst.txt") as resp:
+        assert resp.read() == b"move-payload"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(filer, "/mv/src.txt")
+    assert ei.value.code == 404
+
+
+def test_delete_and_chunk_gc(stack):
+    _m, _vs, filer = stack
+    _req(filer, "/gc/file.bin", "POST", b"Z" * 200).read()
+    import json
+    with _req(filer, "/gc/file.bin?metadata=true") as resp:
+        fids = [c["file_id"] for c in json.load(resp)["chunks"]]
+    assert fids
+    _req(filer, "/gc/file.bin", "DELETE").read()
+    with pytest.raises(urllib.error.HTTPError):
+        _req(filer, "/gc/file.bin")
+    filer.filer.flush_deletions()
+    # blobs must be gone from the volume server
+    for fid in fids:
+        with pytest.raises(rpc.RpcError):
+            rpc.call(f"http://{filer.client.lookup(int(fid.split(',')[0]))[0]['url']}/{fid}")
+
+
+def test_delete_dir_requires_recursive(stack):
+    _m, _vs, filer = stack
+    _req(filer, "/deldir/x", "POST", b"1").read()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(filer, "/deldir", "DELETE")
+    assert ei.value.code == 400
+    _req(filer, "/deldir?recursive=true", "DELETE").read()
+    with pytest.raises(urllib.error.HTTPError):
+        _req(filer, "/deldir/x")
+
+
+def test_overwrite_gcs_old_chunks(stack):
+    _m, _vs, filer = stack
+    import json
+    _req(filer, "/ow.bin", "POST", b"old" * 50).read()
+    with _req(filer, "/ow.bin?metadata=true") as resp:
+        old_fids = {c["file_id"] for c in json.load(resp)["chunks"]}
+    _req(filer, "/ow.bin", "POST", b"new-content").read()
+    with _req(filer, "/ow.bin") as resp:
+        assert resp.read() == b"new-content"
+    filer.filer.flush_deletions()
+    with _req(filer, "/ow.bin?metadata=true") as resp:
+        new_fids = {c["file_id"] for c in json.load(resp)["chunks"]}
+    assert not (old_fids & new_fids)
+
+
+def test_meta_subscribe(stack):
+    _m, _vs, filer = stack
+    import json
+    with _req(filer, "/.meta/subscribe?since_ns=0") as resp:
+        before = json.load(resp)
+    _req(filer, "/subevent.txt", "POST", b"ping").read()
+    with _req(filer, f"/.meta/subscribe?since_ns={before['last_ns']}") as r:
+        after = json.load(r)
+    paths = [e["new_entry"]["path"] for e in after["events"]
+             if e["new_entry"]]
+    assert "/subevent.txt" in paths
+
+
+def test_head_and_bad_ranges(stack):
+    _m, _vs, filer = stack
+    body = b"H" * 500
+    _req(filer, "/head.bin", "POST", body).read()
+    with _req(filer, "/head.bin", "HEAD") as r:
+        assert r.read() == b""
+        assert r.headers["X-File-Size"] == "500"
+    # unparseable / multi-range headers serve the full body (RFC 7233)
+    for bad in ("bytes=abc-", "bytes=0-1,5-6", "chars=0-5"):
+        with _req(filer, "/head.bin", headers={"Range": bad}) as r:
+            assert r.status == 200
+            assert r.read() == body
+
+
+def test_upload_to_root_rejected(stack):
+    _m, _vs, filer = stack
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(filer, "/", "POST", b"data")
+    assert ei.value.code == 400
+
+
+def test_mkdir_on_file_conflict(stack):
+    _m, _vs, filer = stack
+    _req(filer, "/conf.txt", "POST", b"f").read()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(filer, "/conf.txt?mkdir=true", "POST", b"")
+    assert ei.value.code == 409
+
+
+def test_mv_under_itself_rejected(stack):
+    _m, _vs, filer = stack
+    _req(filer, "/selfdir/f", "POST", b"1").read()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(filer, "/selfdir?mv.to=/selfdir/sub", "POST", b"")
+    assert ei.value.code == 400
+
+
+def test_mkdir(stack):
+    _m, _vs, filer = stack
+    import json
+    _req(filer, "/made/dir?mkdir=true", "POST", b"").read()
+    with _req(filer, "/made/dir?metadata=true") as resp:
+        assert json.load(resp)["is_directory"] is True
