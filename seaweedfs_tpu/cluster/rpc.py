@@ -43,9 +43,11 @@ class JsonHttpServer:
     tuple sets the status code.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 pass_headers: bool = False):
         self.host = host
         self.port = port or free_port()
+        self.pass_headers = pass_headers
         self.routes: dict[tuple[str, str], Callable] = {}
         self.prefix_routes: list[tuple[str, str, Callable]] = []
         self._httpd: ThreadingHTTPServer | None = None
@@ -72,8 +74,10 @@ class JsonHttpServer:
 
             def _dispatch(self, method: str):
                 parsed = urllib.parse.urlparse(self.path)
-                query = {k: v[0] for k, v in
-                         urllib.parse.parse_qs(parsed.query).items()}
+                # keep_blank_values: S3-style flag params (?uploads,
+                # ?tagging, ?delete) have no '=value'.
+                query = {k: v[0] for k, v in urllib.parse.parse_qs(
+                    parsed.query, keep_blank_values=True).items()}
                 # Select request headers handlers care about (Range for
                 # partial reads, Content-Type for upload mime) ride along
                 # in the query dict under reserved keys.
@@ -81,6 +85,14 @@ class JsonHttpServer:
                     query["_range_header"] = self.headers["Range"]
                 if self.headers.get("Content-Type"):
                     query["_content_type"] = self.headers["Content-Type"]
+                if server.pass_headers:
+                    # Full header dict + raw query string for handlers
+                    # that authenticate requests (S3 sig v4 needs the
+                    # exact header set and query encoding).
+                    query["_headers"] = {k.lower(): v for k, v
+                                         in self.headers.items()}
+                    query["_raw_query"] = parsed.query
+                    query["_method"] = method
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 fn = server.routes.get((method, parsed.path))
@@ -125,8 +137,9 @@ class JsonHttpServer:
                         self.send_header(k, v)
                     self.end_headers()
                     with payload:
-                        shutil.copyfileobj(payload, self.wfile,
-                                           length=1 << 20)
+                        if self.command != "HEAD":
+                            shutil.copyfileobj(payload, self.wfile,
+                                               length=1 << 20)
                     return
                 extra = dict(extra or {})
                 if isinstance(payload, (bytes, bytearray)):
@@ -138,7 +151,10 @@ class JsonHttpServer:
                     ctype = extra.pop("Content-Type", "application/json")
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
+                # HEAD handlers advertise the real body size without
+                # materializing it.
+                clen = extra.pop("Content-Length", str(len(data)))
+                self.send_header("Content-Length", clen)
                 for k, v in extra.items():
                     self.send_header(k, v)
                 self.end_headers()

@@ -57,9 +57,11 @@ class Filer:
         self._pending_deletions: list[str] = []
         self._del_lock = threading.Lock()
         # Meta log ring buffer + live subscribers (log_buffer + notify).
+        # RLock: delivery happens under the lock so one subscriber sees
+        # events strictly in order; replay during subscribe() holds it.
         self._log: list[MetaEvent] = []
         self._log_capacity = log_capacity
-        self._log_lock = threading.Lock()
+        self._log_lock = threading.RLock()
         self._subscribers: list[Callable[[MetaEvent], None]] = []
         self._stop = threading.Event()
         self._pump = threading.Thread(target=self._deletion_pump,
@@ -150,9 +152,11 @@ class Filer:
         self._notify(d.dir, None, d)
 
     def delete_entry(self, path: str, recursive: bool = False,
-                     ignore_recursive_error: bool = False) -> None:
+                     delete_chunks: bool = True) -> None:
         """Delete an entry; directories need recursive=True when non-empty.
-        All referenced chunks are queued for blob deletion."""
+        Referenced chunks are queued for blob deletion unless
+        delete_chunks=False (metadata-only delete — used when the chunks
+        are shared, e.g. S3 multipart parts after completion)."""
         path = _norm(path)
         if path == "/":
             raise FilerError("cannot delete root")
@@ -161,12 +165,14 @@ class Filer:
             children = self.store.list_directory_entries(path, "", True, 2)
             if children and not recursive:
                 raise FilerError(f"{path} is not empty")
-            for child in list(self._walk(path)):
-                if child.path == path:
-                    continue
-                self._queue_chunk_deletion(child.chunks)
+            if delete_chunks:
+                for child in list(self._walk(path)):
+                    if child.path == path:
+                        continue
+                    self._queue_chunk_deletion(child.chunks)
             self.store.delete_folder_children(path)
-        self._queue_chunk_deletion(e.chunks)
+        if delete_chunks:
+            self._queue_chunk_deletion(e.chunks)
         self.store.delete_entry(path)
         self._notify(e.dir, e, None)
 
@@ -261,12 +267,13 @@ class Filer:
             self._log.append(ev)
             if len(self._log) > self._log_capacity:
                 self._log = self._log[-self._log_capacity:]
-            subscribers = list(self._subscribers)
-        for fn in subscribers:
-            try:
-                fn(ev)
-            except Exception:  # noqa: BLE001 — one bad subscriber must
-                pass           # not break mutations
+            # Deliver under the lock: a subscriber mid-replay in
+            # subscribe() must not observe newer events first.
+            for fn in list(self._subscribers):
+                try:
+                    fn(ev)
+                except Exception:  # noqa: BLE001 — one bad subscriber
+                    pass           # must not break mutations
 
     def subscribe(self, fn: Callable[[MetaEvent], None],
                   since_ns: int = 0) -> Callable[[], None]:
@@ -275,9 +282,9 @@ class Filer:
         unsubscribe function."""
         with self._log_lock:
             replay = [ev for ev in self._log if ev.ts_ns > since_ns]
+            for ev in replay:
+                fn(ev)
             self._subscribers.append(fn)
-        for ev in replay:
-            fn(ev)
 
         def unsubscribe():
             with self._log_lock:

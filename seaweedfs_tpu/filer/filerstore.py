@@ -118,9 +118,13 @@ class MemoryStore(FilerStore):
 
     def delete_folder_children(self, path: str) -> None:
         prefix = _dir_key(_norm(path))
+        # Range end: bump the final char ('/' -> '0') so EVERY key with
+        # this prefix — including astral-plane names above U+FFFF — is
+        # inside [prefix, end).
+        end = prefix[:-1] + chr(ord(prefix[-1]) + 1)
         with self._lock:
             lo = bisect.bisect_left(self._keys, prefix)
-            hi = bisect.bisect_left(self._keys, prefix + "￿")
+            hi = bisect.bisect_left(self._keys, end)
             for k in self._keys[lo:hi]:
                 del self._m[k]
             del self._keys[lo:hi]

@@ -115,7 +115,7 @@ class FilerServer:
                    "ETag": f'"{chunks_etag(e.chunks)}"' if e.chunks
                    else '""'}
         if head:  # never materialize chunks just to discard the body
-            headers["X-File-Size"] = str(size)
+            headers["Content-Length"] = str(size)
             return (200, b"", headers)
         rng = self._parse_range(query.get("_range_header", ""), size)
         if rng is not None:
@@ -149,6 +149,17 @@ class FilerServer:
 
     def _post(self, path: str, query: dict, body: bytes):
         path = urllib.parse.unquote(path).rstrip("/") or "/"
+        if query.get("entry") == "true":
+            # Raw entry create with an explicit chunk list — the filer
+            # gRPC CreateEntry surface (used by S3 multipart completion
+            # and filer.sync, which move chunks without re-uploading).
+            d = json.loads(body)
+            d["path"] = path
+            try:
+                e = self.filer.create_entry(Entry.from_dict(d))
+            except FilerError as err:
+                raise rpc.RpcError(409, str(err)) from None
+            return e.to_dict()
         if "mv.to" in query:
             dst = query["mv.to"]
             try:
@@ -196,8 +207,10 @@ class FilerServer:
     def _delete(self, path: str, query: dict, body: bytes):
         path = urllib.parse.unquote(path).rstrip("/") or "/"
         recursive = query.get("recursive") == "true"
+        keep_chunks = query.get("skipChunkDeletion") == "true"
         try:
-            self.filer.delete_entry(path, recursive=recursive)
+            self.filer.delete_entry(path, recursive=recursive,
+                                    delete_chunks=not keep_chunks)
         except NotFound:
             raise rpc.RpcError(404, f"{path} not found") from None
         except FilerError as e:

@@ -1,0 +1,681 @@
+"""S3 gateway server: path-style S3 REST protocol over the filer.
+
+Reference: weed/s3api/s3api_server.go:38-131 (route table) and the
+handlers in s3api_object_handlers.go, s3api_bucket_handlers.go,
+filer_multipart.go, s3api_object_tagging_handlers.go.
+
+Objects live under /buckets/<bucket>/<key> in the filer namespace, like
+the reference's filerBucketsPath.  Multipart parts are uploaded as
+ordinary filer files and the completed object is assembled by merging the
+parts' chunk lists with adjusted offsets — no data copy (the reference
+does exactly this with gRPC CreateEntry; here it is the filer's
+?entry=true raw-create endpoint).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+import urllib.parse
+import urllib.request
+import uuid
+import xml.etree.ElementTree as ET
+
+from ..cluster import rpc
+from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
+                   ACTION_WRITE, AuthError, Identity,
+                   IdentityAccessManagement)
+
+BUCKETS_PATH = "/buckets"
+UPLOADS_DIR = ".uploads"
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _xml(root: ET.Element) -> bytes:
+    return (b'<?xml version="1.0" encoding="UTF-8"?>'
+            + ET.tostring(root))
+
+
+def _el(parent, tag, text=None):
+    e = ET.SubElement(parent, tag)
+    if text is not None:
+        e.text = str(text)
+    return e
+
+
+def _error_xml(code: str, message: str) -> bytes:
+    root = ET.Element("Error")
+    _el(root, "Code", code)
+    _el(root, "Message", message)
+    return _xml(root)
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+def _decode_aws_chunked(body: bytes) -> bytes:
+    """Strip aws-chunked framing: repeated
+    '<hex-size>[;chunk-signature=...]\r\n<data>\r\n', 0-size terminates
+    (the SDKs' default signed streaming upload encoding)."""
+    out = bytearray()
+    pos = 0
+    while pos < len(body):
+        nl = body.find(b"\r\n", pos)
+        if nl < 0:
+            if pos == 0:  # no framing at all: body is plain
+                return bytes(body)
+            break
+        header = body[pos:nl]
+        size_hex = header.split(b";", 1)[0].strip()
+        try:
+            size = int(size_hex, 16)
+        except ValueError:
+            # Not actually chunk-framed: return as-is.
+            return bytes(body)
+        pos = nl + 2
+        if size == 0:
+            break
+        out += body[pos:pos + size]
+        pos += size + 2  # skip trailing CRLF
+    return bytes(out)
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(message)
+        self.status = status
+        self.code = code
+        self.message = message
+
+
+class FilerProxy:
+    """Thin client of the filer HTTP surface."""
+
+    def __init__(self, filer_url: str):
+        self.url = filer_url.rstrip("/")
+
+    def _q(self, path: str) -> str:
+        return self.url + urllib.parse.quote(path)
+
+    def get(self, path: str, range_header: str = ""):
+        req = urllib.request.Request(self._q(path))
+        if range_header:
+            req.add_header("Range", range_header)
+        return urllib.request.urlopen(req, timeout=60)
+
+    def meta(self, path: str) -> dict | None:
+        try:
+            out = rpc.call(self._q(path) + "?metadata=true")
+            assert isinstance(out, dict)
+            return out
+        except rpc.RpcError:
+            return None
+
+    def put(self, path: str, body: bytes, content_type: str = "") -> dict:
+        req = urllib.request.Request(self._q(path), data=body,
+                                     method="POST")
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return json.load(resp)
+
+    def create_entry(self, path: str, entry: dict) -> dict:
+        out = rpc.call(self._q(path) + "?entry=true", "POST",
+                       json.dumps(entry).encode())
+        assert isinstance(out, dict)
+        return out
+
+    def mkdir(self, path: str) -> None:
+        rpc.call(self._q(path) + "?mkdir=true", "POST", b"")
+
+    def delete(self, path: str, recursive: bool = False,
+               keep_chunks: bool = False) -> bool:
+        q = []
+        if recursive:
+            q.append("recursive=true")
+        if keep_chunks:
+            q.append("skipChunkDeletion=true")
+        try:
+            rpc.call(self._q(path) + ("?" + "&".join(q) if q else ""),
+                     "DELETE")
+            return True
+        except rpc.RpcError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    def list(self, path: str, last: str = "", limit: int = 1024) -> list:
+        q = f"?limit={limit}"
+        if last:
+            q += f"&lastFileName={urllib.parse.quote(last)}"
+        try:
+            out = rpc.call(self._q(path.rstrip('/') + '/') + q)
+        except rpc.RpcError:
+            return []
+        assert isinstance(out, dict)
+        return out.get("entries", [])
+
+
+class S3ApiServer:
+    def __init__(self, filer_url: str, host: str = "127.0.0.1",
+                 port: int = 0,
+                 identities: list[Identity] | None = None):
+        self.filer = FilerProxy(filer_url)
+        self.iam = IdentityAccessManagement(identities)
+        self.server = rpc.JsonHttpServer(host, port, pass_headers=True)
+        for method in ("GET", "HEAD", "PUT", "POST", "DELETE"):
+            self.server.prefix_route(method, "/", self._route)
+        try:
+            self.filer.mkdir(BUCKETS_PATH)
+        except Exception:  # noqa: BLE001 — filer may not be up yet
+            pass
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    def url(self) -> str:
+        return self.server.url()
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, path: str, query: dict, body: bytes):
+        method = query.get("_method", "GET")
+        headers = query.get("_headers", {})
+        raw_query = query.get("_raw_query", "")
+        try:
+            identity = self.iam.authenticate(method, path, raw_query,
+                                             headers, body)
+            if headers.get("x-amz-content-sha256", "").startswith(
+                    "STREAMING-"):
+                # aws-chunked framing: strip the chunk headers/signatures
+                # or the framed wire bytes would be stored as content.
+                body = _decode_aws_chunked(body)
+            return self._dispatch(method, path, query, headers, body,
+                                  identity)
+        except AuthError as e:
+            return (e.status, _error_xml(e.code, str(e)),
+                    {"Content-Type": "application/xml"})
+        except S3Error as e:
+            return (e.status, _error_xml(e.code, e.message),
+                    {"Content-Type": "application/xml"})
+
+    def _dispatch(self, method: str, path: str, query: dict,
+                  headers: dict, body: bytes,
+                  identity: Identity | None):
+        path = urllib.parse.unquote(path)
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        auth = lambda action: self.iam.authorize(identity, action, bucket)  # noqa: E731
+
+        if not bucket:  # service level
+            auth(ACTION_ADMIN)
+            return self._list_buckets()
+        if not key:  # bucket level
+            if method == "PUT":
+                auth(ACTION_ADMIN)
+                return self._create_bucket(bucket)
+            if method == "DELETE":
+                auth(ACTION_ADMIN)
+                return self._delete_bucket(bucket)
+            if method == "HEAD":
+                auth(ACTION_READ)
+                return self._head_bucket(bucket)
+            if method == "POST" and "delete" in query:
+                auth(ACTION_WRITE)
+                return self._delete_multiple(bucket, body)
+            if method == "GET":
+                if "uploads" in query:
+                    auth(ACTION_LIST)
+                    return self._list_multipart_uploads(bucket)
+                auth(ACTION_LIST)
+                return self._list_objects(bucket, query)
+            raise S3Error(405, "MethodNotAllowed", method)
+
+        # object level
+        if method == "POST" and "uploads" in query:
+            auth(ACTION_WRITE)
+            return self._initiate_multipart(bucket, key, headers)
+        if method == "PUT" and "partNumber" in query:
+            auth(ACTION_WRITE)
+            return self._upload_part(bucket, key, query, body)
+        if method == "POST" and "uploadId" in query:
+            auth(ACTION_WRITE)
+            return self._complete_multipart(bucket, key, query, body)
+        if method == "DELETE" and "uploadId" in query:
+            auth(ACTION_WRITE)
+            return self._abort_multipart(bucket, key, query)
+        if "tagging" in query:
+            if method == "PUT":
+                auth(ACTION_TAGGING)
+                return self._put_tagging(bucket, key, body)
+            if method == "GET":
+                auth(ACTION_READ)
+                return self._get_tagging(bucket, key)
+            if method == "DELETE":
+                auth(ACTION_TAGGING)
+                return self._delete_tagging(bucket, key)
+        if method == "PUT":
+            auth(ACTION_WRITE)
+            src = headers.get("x-amz-copy-source", "")
+            if src:
+                return self._copy_object(bucket, key, src)
+            return self._put_object(bucket, key, headers, body)
+        if method in ("GET", "HEAD"):
+            auth(ACTION_READ)
+            return self._get_object(bucket, key, headers,
+                                    head=(method == "HEAD"))
+        if method == "DELETE":
+            auth(ACTION_WRITE)
+            return self._delete_object(bucket, key)
+        raise S3Error(405, "MethodNotAllowed", method)
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _bucket_path(bucket: str) -> str:
+        return f"{BUCKETS_PATH}/{bucket}"
+
+    def _obj_path(self, bucket: str, key: str) -> str:
+        return f"{BUCKETS_PATH}/{bucket}/{key}"
+
+    def _require_bucket(self, bucket: str) -> dict:
+        meta = self.filer.meta(self._bucket_path(bucket))
+        if meta is None or not meta.get("is_directory"):
+            raise S3Error(404, "NoSuchBucket",
+                          f"bucket {bucket} does not exist")
+        return meta
+
+    # -- service / bucket ----------------------------------------------------
+
+    def _list_buckets(self):
+        root = ET.Element("ListAllMyBucketsResult",
+                          {"xmlns": XMLNS})
+        owner = _el(root, "Owner")
+        _el(owner, "ID", "seaweedfs")
+        buckets = _el(root, "Buckets")
+        for e in self.filer.list(BUCKETS_PATH):
+            if not e.get("is_directory") or e["name"] == UPLOADS_DIR:
+                continue
+            b = _el(buckets, "Bucket")
+            _el(b, "Name", e["name"])
+            _el(b, "CreationDate", _iso(e.get("mtime", 0)))
+        return (200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _create_bucket(self, bucket: str):
+        self.filer.mkdir(self._bucket_path(bucket))
+        return (200, b"", {"Location": f"/{bucket}"})
+
+    def _delete_bucket(self, bucket: str):
+        self._require_bucket(bucket)
+        if self.filer.list(self._bucket_path(bucket), limit=1):
+            raise S3Error(409, "BucketNotEmpty",
+                          f"bucket {bucket} is not empty")
+        self.filer.delete(self._bucket_path(bucket), recursive=True)
+        # Abort any in-progress multipart uploads with the bucket, or
+        # their part chunks leak and resurface on bucket re-create.
+        self.filer.delete(f"{BUCKETS_PATH}/{UPLOADS_DIR}/{bucket}",
+                          recursive=True)
+        return (204, b"")
+
+    def _head_bucket(self, bucket: str):
+        self._require_bucket(bucket)
+        return (200, b"")
+
+    # -- objects -------------------------------------------------------------
+
+    def _put_object(self, bucket: str, key: str, headers: dict,
+                    body: bytes):
+        self._require_bucket(bucket)
+        if key.endswith("/"):  # directory marker
+            self.filer.mkdir(self._obj_path(bucket, key.rstrip("/")))
+            return (200, b"", {"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'})
+        ctype = headers.get("content-type",
+                            "application/octet-stream")
+        self.filer.put(self._obj_path(bucket, key), body, ctype)
+        md5 = hashlib.md5(body).hexdigest()
+        return (200, b"", {"ETag": f'"{md5}"'})
+
+    def _copy_object(self, bucket: str, key: str, src: str):
+        self._require_bucket(bucket)
+        src = urllib.parse.unquote(src).lstrip("/")
+        sbucket, _, skey = src.partition("/")
+        spath = self._obj_path(sbucket, skey)
+        smeta = self.filer.meta(spath)
+        if smeta is None or smeta.get("is_directory"):
+            raise S3Error(404, "NoSuchKey", f"source {src} not found")
+        # Re-upload the bytes: sharing chunk ids between two entries would
+        # double-free when either copy is later deleted (the filer GC has
+        # no refcounting; the reference copies data too).
+        with self.filer.get(spath) as resp:
+            data = resp.read()
+        ctype = smeta.get("attributes", {}).get(
+            "mime", "application/octet-stream")
+        self.filer.put(self._obj_path(bucket, key), data, ctype)
+        root = ET.Element("CopyObjectResult", {"xmlns": XMLNS})
+        _el(root, "LastModified", _iso(time.time()))
+        _el(root, "ETag", f'"{hashlib.md5(data).hexdigest()}"')
+        return (200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _get_object(self, bucket: str, key: str, headers: dict,
+                    head: bool = False):
+        path = self._obj_path(bucket, key)
+        meta = self.filer.meta(path)
+        if meta is None or meta.get("is_directory"):
+            raise S3Error(404, "NoSuchKey", f"{key} not found")
+        attrs = meta.get("attributes", {})
+        size = sum(c["size"] for c in self._visible_sizes(meta))
+        base_headers = {
+            "Content-Type": attrs.get("mime",
+                                      "application/octet-stream"),
+            "Last-Modified": time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT",
+                time.gmtime(attrs.get("mtime", 0))),
+            "Accept-Ranges": "bytes",
+        }
+        if head:
+            base_headers["Content-Length"] = str(size)
+            return (200, b"", base_headers)
+        rng = headers.get("range", "")
+        with self.filer.get(path, rng) as resp:
+            data = resp.read()
+            if resp.status == 206:
+                base_headers["Content-Range"] = \
+                    resp.headers.get("Content-Range", "")
+                return (206, data, base_headers)
+        return (200, data, base_headers)
+
+    @staticmethod
+    def _visible_sizes(meta: dict) -> list[dict]:
+        from ..filer.entry import FileChunk
+        from ..filer.filechunks import non_overlapping_visible_intervals
+        chunks = [FileChunk.from_dict(c) for c in meta.get("chunks", [])]
+        return [{"size": v.stop - v.start}
+                for v in non_overlapping_visible_intervals(chunks)]
+
+    def _delete_object(self, bucket: str, key: str):
+        self.filer.delete(self._obj_path(bucket, key), recursive=True)
+        return (204, b"")
+
+    def _delete_multiple(self, bucket: str, body: bytes):
+        root = ET.fromstring(body)
+        ns = ""
+        if root.tag.startswith("{"):
+            ns = root.tag[:root.tag.index("}") + 1]
+        deleted, errors = [], []
+        for obj in root.iter(f"{ns}Object"):
+            key_el = obj.find(f"{ns}Key")
+            if key_el is None or not key_el.text:
+                continue
+            key = key_el.text
+            try:
+                self.filer.delete(self._obj_path(bucket, key),
+                                  recursive=True)
+                deleted.append(key)
+            except Exception as e:  # noqa: BLE001
+                errors.append((key, str(e)))
+        out = ET.Element("DeleteResult", {"xmlns": XMLNS})
+        for key in deleted:
+            d = _el(out, "Deleted")
+            _el(d, "Key", key)
+        for key, msg in errors:
+            er = _el(out, "Error")
+            _el(er, "Key", key)
+            _el(er, "Message", msg)
+        return (200, _xml(out), {"Content-Type": "application/xml"})
+
+    # -- listing -------------------------------------------------------------
+
+    def _walk_keys(self, bucket: str, prefix: str):
+        """Yield (key, entry) sorted, depth-first, under prefix."""
+        base = self._bucket_path(bucket)
+
+        def rec(dir_rel: str):
+            dir_abs = base + ("/" + dir_rel if dir_rel else "")
+            last = ""
+            while True:
+                entries = self.filer.list(dir_abs, last, 1024)
+                if not entries:
+                    return
+                for e in entries:
+                    rel = (dir_rel + "/" if dir_rel else "") + e["name"]
+                    if e.get("is_directory"):
+                        if e["name"] == UPLOADS_DIR and not dir_rel:
+                            continue
+                        yield from rec(rel)
+                    else:
+                        if rel.startswith(prefix):
+                            yield rel, e
+                last = entries[-1]["name"]
+                if len(entries) < 1024:
+                    return
+
+        # Start from the deepest directory fully inside the prefix to
+        # avoid walking the whole bucket.
+        yield from rec("")
+
+    def _list_objects(self, bucket: str, query: dict):
+        self._require_bucket(bucket)
+        prefix = query.get("prefix", "")
+        delimiter = query.get("delimiter", "")
+        max_keys = int(query.get("max-keys", 1000))
+        v2 = query.get("list-type") == "2"
+        after = query.get("continuation-token",
+                          query.get("start-after", "")) if v2 else \
+            query.get("marker", "")
+        contents, common = [], []
+        truncated = False
+        seen_prefixes = set()
+        for key, e in self._walk_keys(bucket, prefix):
+            if after and key <= after:
+                continue
+            if delimiter:
+                rest = key[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if cp not in seen_prefixes:
+                        seen_prefixes.add(cp)
+                        common.append(cp)
+                    continue
+            contents.append((key, e))
+            if len(contents) + len(common) >= max_keys:
+                truncated = True
+                break
+        root = ET.Element("ListBucketResult", {"xmlns": XMLNS})
+        _el(root, "Name", bucket)
+        _el(root, "Prefix", prefix)
+        _el(root, "MaxKeys", max_keys)
+        _el(root, "IsTruncated", "true" if truncated else "false")
+        if v2:
+            _el(root, "KeyCount", len(contents))
+            if truncated and contents:
+                _el(root, "NextContinuationToken", contents[-1][0])
+        elif truncated and contents:
+            _el(root, "NextMarker", contents[-1][0])
+        for key, e in contents:
+            c = _el(root, "Contents")
+            _el(c, "Key", key)
+            _el(c, "LastModified", _iso(e.get("mtime", 0)))
+            _el(c, "Size", e.get("size", 0))
+            _el(c, "StorageClass", "STANDARD")
+        for cp in common:
+            p = _el(root, "CommonPrefixes")
+            _el(p, "Prefix", cp)
+        return (200, _xml(root), {"Content-Type": "application/xml"})
+
+    # -- multipart -----------------------------------------------------------
+
+    def _uploads_path(self, bucket: str, upload_id: str) -> str:
+        return f"{BUCKETS_PATH}/{UPLOADS_DIR}/{bucket}/{upload_id}"
+
+    def _initiate_multipart(self, bucket: str, key: str, headers: dict):
+        self._require_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        self.filer.mkdir(self._uploads_path(bucket, upload_id))
+        # Remember the target key + content type on the upload dir.
+        self.filer.create_entry(
+            self._uploads_path(bucket, upload_id) + "/.manifest",
+            {"attributes": {"mime": "application/json"},
+             "extended": {"key": key,
+                          "content_type": headers.get(
+                              "content-type",
+                              "application/octet-stream")}})
+        root = ET.Element("InitiateMultipartUploadResult",
+                          {"xmlns": XMLNS})
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "UploadId", upload_id)
+        return (200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _upload_part(self, bucket: str, key: str, query: dict,
+                     body: bytes):
+        part = int(query["partNumber"])
+        upload_id = query["uploadId"]
+        path = f"{self._uploads_path(bucket, upload_id)}/{part:05d}.part"
+        self.filer.put(path, body)
+        md5 = hashlib.md5(body).hexdigest()
+        return (200, b"", {"ETag": f'"{md5}"'})
+
+    def _complete_multipart(self, bucket: str, key: str, query: dict,
+                            body: bytes):
+        upload_id = query["uploadId"]
+        updir = self._uploads_path(bucket, upload_id)
+        manifest = self.filer.meta(updir + "/.manifest")
+        if manifest is None:
+            raise S3Error(404, "NoSuchUpload", upload_id)
+        uploaded = sorted(
+            (e["name"] for e in self.filer.list(updir, limit=10000)
+             if e["name"].endswith(".part")))
+        # S3 semantics: only the parts listed in the request body are
+        # assembled; unlisted uploaded parts are excluded.
+        wanted = self._requested_part_numbers(body)
+        if wanted is not None:
+            by_number = {int(n.split(".")[0]): n for n in uploaded}
+            missing = [p for p in wanted if p not in by_number]
+            if missing:
+                raise S3Error(400, "InvalidPart",
+                              f"parts {missing} were not uploaded")
+            parts = [by_number[p] for p in sorted(wanted)]
+        else:
+            parts = uploaded
+        if not parts:
+            raise S3Error(400, "MalformedXML",
+                          "completion requires at least one part")
+        chunks: list[dict] = []
+        offset = 0
+        for name in parts:
+            meta = self.filer.meta(f"{updir}/{name}")
+            if meta is None:
+                continue
+            for c in sorted(meta.get("chunks", []),
+                            key=lambda c: c["offset"]):
+                chunks.append({**c, "offset": offset + c["offset"]})
+            offset += sum(c["size"] for c in meta.get("chunks", []))
+        ctype = manifest.get("extended", {}).get(
+            "content_type", "application/octet-stream")
+        self.filer.create_entry(
+            self._obj_path(bucket, key),
+            {"attributes": {"mime": ctype}, "chunks": chunks})
+        # Excluded parts' chunks are NOT in the final object: free them.
+        for name in uploaded:
+            if name not in parts:
+                self.filer.delete(f"{updir}/{name}")
+        # Metadata-only delete of the used parts: their chunks now belong
+        # to the completed object (filer_multipart.go does the same merge).
+        self.filer.delete(updir, recursive=True, keep_chunks=True)
+        root = ET.Element("CompleteMultipartUploadResult",
+                          {"xmlns": XMLNS})
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "ETag", f'"{upload_id}-{len(parts)}"')
+        return (200, _xml(root), {"Content-Type": "application/xml"})
+
+    @staticmethod
+    def _requested_part_numbers(body: bytes) -> list[int] | None:
+        """PartNumbers from a CompleteMultipartUpload body; None when the
+        body lists none (legacy/minimal clients: use all parts)."""
+        if not body.strip():
+            return None
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error(400, "MalformedXML",
+                          "cannot parse completion body") from None
+        ns = root.tag[:root.tag.index("}") + 1] if \
+            root.tag.startswith("{") else ""
+        nums = [int(el.text) for el in root.iter(f"{ns}PartNumber")
+                if el.text and el.text.strip().isdigit()]
+        return nums or None
+
+    def _abort_multipart(self, bucket: str, key: str, query: dict):
+        self.filer.delete(
+            self._uploads_path(bucket, query["uploadId"]),
+            recursive=True)
+        return (204, b"")
+
+    def _list_multipart_uploads(self, bucket: str):
+        root = ET.Element("ListMultipartUploadsResult", {"xmlns": XMLNS})
+        _el(root, "Bucket", bucket)
+        base = f"{BUCKETS_PATH}/{UPLOADS_DIR}/{bucket}"
+        for e in self.filer.list(base):
+            if not e.get("is_directory"):
+                continue
+            manifest = self.filer.meta(f"{base}/{e['name']}/.manifest")
+            u = _el(root, "Upload")
+            _el(u, "UploadId", e["name"])
+            if manifest:
+                _el(u, "Key",
+                    manifest.get("extended", {}).get("key", ""))
+        return (200, _xml(root), {"Content-Type": "application/xml"})
+
+    # -- tagging -------------------------------------------------------------
+
+    def _put_tagging(self, bucket: str, key: str, body: bytes):
+        meta = self.filer.meta(self._obj_path(bucket, key))
+        if meta is None:
+            raise S3Error(404, "NoSuchKey", key)
+        root = ET.fromstring(body)
+        ns = root.tag[:root.tag.index("}") + 1] if \
+            root.tag.startswith("{") else ""
+        tags = {}
+        for t in root.iter(f"{ns}Tag"):
+            k = t.find(f"{ns}Key")
+            v = t.find(f"{ns}Value")
+            if k is not None and k.text:
+                tags[k.text] = v.text or "" if v is not None else ""
+        extended = meta.get("extended", {})
+        extended = {k: v for k, v in extended.items()
+                    if not k.startswith("x-amz-tag-")}
+        for k, v in tags.items():
+            extended[f"x-amz-tag-{k}"] = v
+        meta["extended"] = extended
+        self.filer.create_entry(self._obj_path(bucket, key), meta)
+        return (200, b"")
+
+    def _get_tagging(self, bucket: str, key: str):
+        meta = self.filer.meta(self._obj_path(bucket, key))
+        if meta is None:
+            raise S3Error(404, "NoSuchKey", key)
+        root = ET.Element("Tagging", {"xmlns": XMLNS})
+        ts = _el(root, "TagSet")
+        for k, v in meta.get("extended", {}).items():
+            if k.startswith("x-amz-tag-"):
+                t = _el(ts, "Tag")
+                _el(t, "Key", k[len("x-amz-tag-"):])
+                _el(t, "Value", v)
+        return (200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _delete_tagging(self, bucket: str, key: str):
+        meta = self.filer.meta(self._obj_path(bucket, key))
+        if meta is None:
+            raise S3Error(404, "NoSuchKey", key)
+        meta["extended"] = {k: v for k, v in
+                            meta.get("extended", {}).items()
+                            if not k.startswith("x-amz-tag-")}
+        self.filer.create_entry(self._obj_path(bucket, key), meta)
+        return (204, b"")

@@ -157,7 +157,7 @@ def test_head_and_bad_ranges(stack):
     _req(filer, "/head.bin", "POST", body).read()
     with _req(filer, "/head.bin", "HEAD") as r:
         assert r.read() == b""
-        assert r.headers["X-File-Size"] == "500"
+        assert r.headers["Content-Length"] == "500"
     # unparseable / multi-range headers serve the full body (RFC 7233)
     for bad in ("bytes=abc-", "bytes=0-1,5-6", "chars=0-5"):
         with _req(filer, "/head.bin", headers={"Range": bad}) as r:
