@@ -1,0 +1,9 @@
+"""`python -m seaweedfs_tpu <command>` — the `weed` binary equivalent
+(reference: weed/weed.go:38-80)."""
+
+import sys
+
+from .command import main
+
+if __name__ == "__main__":
+    sys.exit(main())

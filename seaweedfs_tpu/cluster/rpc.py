@@ -126,14 +126,21 @@ class JsonHttpServer:
                 self._send(status, payload, extra)
 
             def _send(self, status: int, payload, extra=None):
-                if hasattr(payload, "read"):  # open file: stream it
+                if hasattr(payload, "read"):
+                    # Stream any file-like payload (open file, upstream
+                    # HTTP response) without buffering it: O(1MB) memory
+                    # per in-flight large read.
                     import shutil
-                    size = os.fstat(payload.fileno()).st_size
+                    extra = dict(extra or {})
+                    ctype = extra.pop("Content-Type",
+                                      "application/octet-stream")
+                    size = extra.pop("Content-Length", None)
+                    if size is None:
+                        size = str(os.fstat(payload.fileno()).st_size)
                     self.send_response(status)
-                    self.send_header("Content-Type",
-                                     "application/octet-stream")
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(size))
-                    for k, v in (extra or {}).items():
+                    for k, v in extra.items():
                         self.send_header(k, v)
                     self.end_headers()
                     with payload:
@@ -175,6 +182,32 @@ class JsonHttpServer:
 
             def do_DELETE(self):
                 self._dispatch("DELETE")
+
+            # WebDAV verbs (gateways route them like any other method)
+
+            def do_OPTIONS(self):
+                self._dispatch("OPTIONS")
+
+            def do_PROPFIND(self):
+                self._dispatch("PROPFIND")
+
+            def do_PROPPATCH(self):
+                self._dispatch("PROPPATCH")
+
+            def do_MKCOL(self):
+                self._dispatch("MKCOL")
+
+            def do_MOVE(self):
+                self._dispatch("MOVE")
+
+            def do_COPY(self):
+                self._dispatch("COPY")
+
+            def do_LOCK(self):
+                self._dispatch("LOCK")
+
+            def do_UNLOCK(self):
+                self._dispatch("UNLOCK")
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self._httpd.daemon_threads = True

@@ -1,0 +1,119 @@
+"""CLI command registry + dispatcher (reference: weed/command/command.go:10-32,
+weed/weed.go:38-80).
+
+Every subcommand registers a `Command(name, usage, help, run)`; `main`
+dispatches `weed <name> [flags]`.  Commands accept Go-style single-dash
+flags (`-port 9333` or `-port=9333`) like the reference so existing muscle
+memory and scripts carry over.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..utils import glog
+
+
+@dataclass
+class Command:
+    name: str
+    usage: str
+    short: str
+    run: Callable[["Flags", list[str]], int]
+    flag_defs: dict[str, tuple[str, str]] = field(default_factory=dict)
+    # flag -> (default, help); all flags parse as strings, converted by use
+
+
+class Flags:
+    """Parsed `-key value` / `-key=value` flags with typed getters."""
+
+    def __init__(self, values: dict[str, str]):
+        self._v = values
+
+    def get(self, key: str, default: str = "") -> str:
+        return self._v.get(key, default)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        val = self._v.get(key)
+        return int(val) if val not in (None, "") else default
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        val = self._v.get(key)
+        return float(val) if val not in (None, "") else default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        val = self._v.get(key)
+        if val is None:
+            return default
+        return val.lower() in ("", "1", "true", "yes", "on")
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._v
+
+
+def parse_flags(args: list[str]) -> tuple[Flags, list[str]]:
+    flags: dict[str, str] = {}
+    rest: list[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--":
+            rest.extend(args[i + 1:])
+            break
+        if a.startswith("-") and len(a) > 1 and not a[1].isdigit():
+            key = a.lstrip("-")
+            if "=" in key:
+                key, val = key.split("=", 1)
+                flags[key] = val
+            elif i + 1 < len(args) and not args[i + 1].startswith("-"):
+                flags[key] = args[i + 1]
+                i += 1
+            else:
+                flags[key] = ""  # bare boolean flag
+        else:
+            rest.append(a)
+        i += 1
+    return Flags(flags), rest
+
+
+COMMANDS: dict[str, Command] = {}
+
+
+def register(cmd: Command) -> None:
+    COMMANDS[cmd.name] = cmd
+
+
+def _load_all() -> None:
+    # Import for registration side effects.
+    from . import client_cmds  # noqa: F401
+    from . import offline_cmds  # noqa: F401
+    from . import servers  # noqa: F401
+
+
+def usage() -> str:
+    _load_all()
+    lines = ["usage: weed <command> [flags] [args]", "", "commands:"]
+    for name in sorted(COMMANDS):
+        lines.append(f"  {name:<18} {COMMANDS[name].short}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    _load_all()
+    if not argv or argv[0] in ("-h", "-help", "--help", "help"):
+        print(usage())
+        return 0
+    name, args = argv[0], argv[1:]
+    cmd = COMMANDS.get(name)
+    if cmd is None:
+        print(f"unknown command {name!r}\n\n{usage()}", file=sys.stderr)
+        return 2
+    flags, rest = parse_flags(args)
+    glog.setup(verbosity=flags.get_int("v", 0))
+    try:
+        return cmd.run(flags, rest)
+    except KeyboardInterrupt:
+        return 130

@@ -1,0 +1,196 @@
+"""Client-side subcommands: upload / download / shell / watch / version /
+scaffold (reference: weed/command/upload.go, download.go, shell.go,
+watch.go, version.go, scaffold.go).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+from .. import __version__
+from . import Command, Flags, register
+
+
+def _master(flags: Flags, key: str = "master") -> str:
+    addr = flags.get(key, "127.0.0.1:9333")
+    return addr if addr.startswith("http") else f"http://{addr}"
+
+
+def run_upload(flags: Flags, args: list[str]) -> int:
+    """Upload files (or a directory with -dir); prints JSON results like
+    the reference (command/upload.go)."""
+    from ..cluster.client import WeedClient
+    client = WeedClient(_master(flags))
+    paths: list[str] = []
+    if flags.get("dir"):
+        for root, _dirs, files in os.walk(flags.get("dir")):
+            paths.extend(os.path.join(root, f) for f in files)
+    paths.extend(args)
+    if not paths:
+        print("nothing to upload: pass files or -dir", file=sys.stderr)
+        return 2
+    results = []
+    for p in paths:
+        with open(p, "rb") as f:
+            data = f.read()
+        res = client.submit(data, collection=flags.get("collection", ""),
+                            replication=flags.get("replication") or None,
+                            ttl=flags.get("ttl", ""))
+        res["fileName"] = os.path.basename(p)
+        results.append(res)
+    print(json.dumps(results, indent=2))
+    return 0
+
+
+def run_download(flags: Flags, args: list[str]) -> int:
+    """Download fids to -dir (command/download.go)."""
+    from ..cluster.client import WeedClient
+    client = WeedClient(_master(flags, "server"))
+    out_dir = flags.get("dir", ".")
+    os.makedirs(out_dir, exist_ok=True)
+    for fid in args:
+        data = client.download(fid)
+        name = fid.replace(",", "_")
+        with open(os.path.join(out_dir, name), "wb") as f:
+            f.write(data)
+        print(f"{fid} -> {name} ({len(data)} bytes)")
+    return 0
+
+
+def run_shell(flags: Flags, args: list[str]) -> int:
+    from ..shell.repl import run_shell
+    return run_shell(_master(flags), commands=args or None)
+
+
+def run_watch(flags: Flags, args: list[str]) -> int:
+    """Tail filer metadata events (command/watch.go): poll
+    /.meta/subscribe from `now` and print each event as JSON."""
+    filer = flags.get("filer", "127.0.0.1:8888")
+    filer = filer if filer.startswith("http") else f"http://{filer}"
+    prefix = flags.get("pathPrefix", "/")
+    since_ns = int(time.time() * 1e9)
+    while True:
+        url = f"{filer}/.meta/subscribe?since_ns={since_ns}"
+        with urllib.request.urlopen(url) as resp:
+            events = json.loads(resp.read()).get("events", [])
+        for ev in events:
+            since_ns = max(since_ns, ev.get("ts_ns", since_ns) + 1)
+            path = ev.get("directory", "") + "/" + (
+                (ev.get("new_entry") or ev.get("old_entry") or {})
+                .get("name", ""))
+            if path.startswith(prefix):
+                print(json.dumps(ev))
+        sys.stdout.flush()
+        time.sleep(flags.get_float("interval", 1.0))
+
+
+def run_version(flags: Flags, args: list[str]) -> int:
+    print(f"version {__version__} (seaweedfs-tpu)")
+    return 0
+
+
+SCAFFOLDS = {
+    "security": '''\
+# security.toml — put in ./ , ~/.seaweedfs/ , or /etc/seaweedfs/
+[jwt.signing]
+key = ""
+expires_after_seconds = 10
+
+[access]
+ui = false
+white_list = []
+''',
+    "master": '''\
+# master.toml
+[master.maintenance]
+# periodic scripts, one shell command per line
+scripts = """
+  ec.encode -fullPercent=95 -quietFor=1h
+  ec.rebuild -force
+  ec.balance -force
+  volume.balance -force
+"""
+sleep_minutes = 17
+
+[master.sequencer]
+type = "memory"   # or "etcd"
+''',
+    "filer": '''\
+# filer.toml
+[filer.options]
+recursive_delete = false
+
+[memory]
+enabled = false
+
+[sqlite]
+enabled = true
+file = "filer.db"
+
+[leveldb_file]
+enabled = false
+dir = "."
+''',
+    "notification": '''\
+# notification.toml
+[notification.log]
+enabled = false
+
+[notification.file_queue]
+enabled = false
+dir = "/tmp/weed_notify"
+''',
+    "replication": '''\
+# replication.toml
+[source.filer]
+enabled = true
+grpcAddress = "localhost:8888"
+directory = "/buckets"
+
+[sink.filer]
+enabled = false
+grpcAddress = "localhost:8889"
+directory = "/backup"
+replication = ""
+
+[sink.local]
+enabled = false
+directory = "/backup"
+''',
+}
+
+
+def run_scaffold(flags: Flags, args: list[str]) -> int:
+    """Emit config templates (command/scaffold.go:12-58)."""
+    name = flags.get("config", "filer")
+    if name not in SCAFFOLDS:
+        print(f"unknown config {name!r}; one of {sorted(SCAFFOLDS)}",
+              file=sys.stderr)
+        return 2
+    content = SCAFFOLDS[name]
+    out_dir = flags.get("output", "")
+    if out_dir:
+        path = os.path.join(out_dir, name + ".toml")
+        with open(path, "w") as f:
+            f.write(content)
+        print(f"wrote {path}")
+    else:
+        print(content, end="")
+    return 0
+
+
+register(Command("upload", "upload -master=host:9333 file1 [file2 ...]",
+                 "upload files to the cluster", run_upload))
+register(Command("download", "download -server=host:9333 -dir=. fid1 ...",
+                 "download files by fid", run_download))
+register(Command("shell", "shell -master=host:9333 ['cmd1' 'cmd2' ...]",
+                 "interactive admin shell", run_shell))
+register(Command("watch", "watch -filer=host:8888 -pathPrefix=/",
+                 "stream filer metadata change events", run_watch))
+register(Command("version", "version", "print version", run_version))
+register(Command("scaffold", "scaffold -config=filer [-output=.]",
+                 "emit a TOML config template", run_scaffold))

@@ -1,0 +1,196 @@
+"""Server-role subcommands: master / volume / filer / s3 / server
+(reference: weed/command/master.go, volume.go, filer.go, s3.go, server.go).
+
+Each starts the corresponding in-process server object and blocks until
+SIGINT/SIGTERM.  `weed server` composes master + volume (+ filer + s3)
+in one process, like the reference's all-in-one command.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+from ..utils import glog
+from . import Command, Flags, register
+
+
+def _wait_forever(servers: list) -> int:
+    stop = threading.Event()
+
+    def handler(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    try:
+        stop.wait()
+    finally:
+        for s in reversed(servers):
+            s.stop()
+    return 0
+
+
+def run_master(flags: Flags, args: list[str]) -> int:
+    from ..cluster.master import MasterServer as Master
+    m = Master(
+        host=flags.get("ip", "127.0.0.1"),
+        port=flags.get_int("port", 9333),
+        meta_dir=flags.get("mdir") or None,
+        volume_size_limit_mb=flags.get_int("volumeSizeLimitMB", 30 * 1024),
+        default_replication=flags.get("defaultReplication", "000"),
+        garbage_threshold=flags.get_float("garbageThreshold", 0.3))
+    m.start()
+    glog.infof("master serving at %s", m.server.url())
+    return _wait_forever([m])
+
+
+def run_volume(flags: Flags, args: list[str]) -> int:
+    from ..cluster.volume_server import VolumeServer
+    dirs = [d for d in flags.get("dir", "./data").split(",") if d]
+    maxes = [int(x) for x in flags.get("max", "8").split(",")]
+    if len(maxes) == 1:
+        maxes = maxes * len(dirs)
+    vs = VolumeServer(
+        master_url=_norm_master(flags.get("mserver", "127.0.0.1:9333")),
+        directories=dirs,
+        host=flags.get("ip", "127.0.0.1"),
+        port=flags.get_int("port", 8080),
+        max_volume_counts=maxes,
+        data_center=flags.get("dataCenter", "DefaultDataCenter"),
+        rack=flags.get("rack", "DefaultRack"))
+    vs.start()
+    glog.infof("volume server serving at %s (dirs %s)",
+               vs.server.url(), dirs)
+    return _wait_forever([vs])
+
+
+def run_filer(flags: Flags, args: list[str]) -> int:
+    from ..filer.server import FilerServer
+    fs = FilerServer(
+        master_url=_norm_master(flags.get("master", "127.0.0.1:9333")),
+        host=flags.get("ip", "127.0.0.1"),
+        port=flags.get_int("port", 8888),
+        store_path=flags.get("dir") or None,
+        collection=flags.get("collection", ""),
+        replication=flags.get("defaultReplicaPlacement") or None)
+    fs.start()
+    glog.infof("filer serving at %s", fs.server.url())
+    return _wait_forever([fs])
+
+
+def _s3_identities(config_path: str):
+    """Load identities from the reference's JSON config shape
+    (s3api/auth_credentials.go: {"identities":[{name, credentials:
+    [{accessKey, secretKey}], actions}]})."""
+    import json
+
+    from ..s3api.auth import Identity
+    if not config_path:
+        return None
+    with open(config_path) as f:
+        cfg = json.load(f)
+    out = []
+    for ident in cfg.get("identities", []):
+        cred = (ident.get("credentials") or [{}])[0]
+        out.append(Identity(name=ident.get("name", ""),
+                            access_key=cred.get("accessKey", ""),
+                            secret_key=cred.get("secretKey", ""),
+                            actions=ident.get("actions", ["Admin"])))
+    return out
+
+
+def run_s3(flags: Flags, args: list[str]) -> int:
+    from ..s3api.server import S3ApiServer
+    s3 = S3ApiServer(
+        filer_url=_norm_master(flags.get("filer", "127.0.0.1:8888")),
+        host=flags.get("ip", "127.0.0.1"),
+        port=flags.get_int("port", 8333),
+        identities=_s3_identities(flags.get("config")))
+    s3.start()
+    glog.infof("s3 gateway serving at %s", s3.server.url())
+    return _wait_forever([s3])
+
+
+def run_webdav(flags: Flags, args: list[str]) -> int:
+    from ..webdav.server import WebDavServer
+    dav = WebDavServer(
+        filer_url=_norm_master(flags.get("filer", "127.0.0.1:8888")),
+        host=flags.get("ip", "127.0.0.1"),
+        port=flags.get_int("port", 7333))
+    dav.start()
+    glog.infof("webdav serving at %s", dav.server.url())
+    return _wait_forever([dav])
+
+
+def run_server(flags: Flags, args: list[str]) -> int:
+    """All-in-one: master + volume [+ filer [+ s3]]."""
+    from ..cluster.master import MasterServer as Master
+    from ..cluster.volume_server import VolumeServer
+    servers: list = []
+    ip = flags.get("ip", "127.0.0.1")
+    m = Master(host=ip, port=flags.get_int("master.port", 9333),
+               meta_dir=flags.get("mdir") or None,
+               volume_size_limit_mb=flags.get_int(
+                   "volumeSizeLimitMB", 30 * 1024),
+               default_replication=flags.get("defaultReplication", "000"))
+    m.start()
+    servers.append(m)
+    dirs = [d for d in flags.get("dir", "./data").split(",") if d]
+    maxes = [int(x) for x in flags.get("volume.max", "8").split(",")]
+    if len(maxes) == 1:
+        maxes = maxes * len(dirs)
+    vs = VolumeServer(master_url=m.server.url(), directories=dirs,
+                      host=ip, port=flags.get_int("volume.port", 8080),
+                      max_volume_counts=maxes,
+                      data_center=flags.get("dataCenter",
+                                            "DefaultDataCenter"),
+                      rack=flags.get("rack", "DefaultRack"))
+    vs.start()
+    servers.append(vs)
+    glog.infof("master at %s, volume at %s", m.server.url(),
+               vs.server.url())
+    if flags.get_bool("filer", False):
+        from ..filer.server import FilerServer
+        fs = FilerServer(master_url=m.server.url(), host=ip,
+                         port=flags.get_int("filer.port", 8888),
+                         store_path=flags.get("filer.dir") or None)
+        fs.start()
+        servers.append(fs)
+        glog.infof("filer at %s", fs.server.url())
+        if flags.get_bool("s3", False):
+            from ..s3api.server import S3ApiServer
+            s3 = S3ApiServer(filer_url=fs.server.url(), host=ip,
+                             port=flags.get_int("s3.port", 8333))
+            s3.start()
+            servers.append(s3)
+            glog.infof("s3 at %s", s3.server.url())
+        if flags.get_bool("webdav", False):
+            from ..webdav.server import WebDavServer
+            dav = WebDavServer(filer_url=fs.server.url(), host=ip,
+                               port=flags.get_int("webdav.port", 7333))
+            dav.start()
+            servers.append(dav)
+            glog.infof("webdav at %s", dav.server.url())
+    return _wait_forever(servers)
+
+
+def _norm_master(addr: str) -> str:
+    return addr if addr.startswith("http") else f"http://{addr}"
+
+
+register(Command("master", "master -port=9333 -mdir=/tmp/meta",
+                 "start a master server", run_master))
+register(Command("volume",
+                 "volume -port=8080 -dir=/data -max=8 -mserver=host:9333",
+                 "start a volume server", run_volume))
+register(Command("filer", "filer -port=8888 -master=host:9333",
+                 "start a filer server", run_filer))
+register(Command("s3", "s3 -port=8333 -filer=host:8888",
+                 "start an S3-compatible gateway", run_s3))
+register(Command("webdav", "webdav -port=7333 -filer=host:8888",
+                 "start a WebDAV gateway", run_webdav))
+register(Command("server",
+                 "server -dir=/data -filer=true -s3=true",
+                 "start master+volume(+filer+s3) in one process",
+                 run_server))

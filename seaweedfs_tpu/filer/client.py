@@ -1,0 +1,105 @@
+"""Filer HTTP client used by the gateways (S3, WebDAV, mount).
+
+The reference gateways talk to the filer over gRPC
+(weed/s3api/s3api_handlers.go WithFilerClient, weed/server/webdav_server.go);
+here the filer's HTTP surface is the single wire, so one thin proxy serves
+every gateway.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+
+from ..cluster import rpc
+
+
+class FilerProxy:
+    """Thin client of the filer HTTP surface."""
+
+    def __init__(self, filer_url: str):
+        self.url = filer_url.rstrip("/")
+
+    def _q(self, path: str) -> str:
+        return self.url + urllib.parse.quote(path)
+
+    def get(self, path: str, range_header: str = ""):
+        req = urllib.request.Request(self._q(path))
+        if range_header:
+            req.add_header("Range", range_header)
+        return urllib.request.urlopen(req, timeout=60)
+
+    def meta(self, path: str) -> dict | None:
+        try:
+            out = rpc.call(self._q(path) + "?metadata=true")
+            assert isinstance(out, dict)
+            return out
+        except rpc.RpcError as e:
+            if e.status == 404:
+                return None
+            raise  # a filer 5xx is not "no such key"
+
+    def put(self, path: str, body: bytes, content_type: str = "") -> dict:
+        req = urllib.request.Request(self._q(path), data=body,
+                                     method="POST")
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return json.load(resp)
+
+    def create_entry(self, path: str, entry: dict) -> dict:
+        out = rpc.call(self._q(path) + "?entry=true", "POST",
+                       json.dumps(entry).encode())
+        assert isinstance(out, dict)
+        return out
+
+    def mkdir(self, path: str) -> None:
+        rpc.call(self._q(path) + "?mkdir=true", "POST", b"")
+
+    def rename(self, path: str, new_path: str) -> None:
+        rpc.call(self._q(path) + "?mv.to=" +
+                 urllib.parse.quote(new_path, safe=""), "POST", b"")
+
+    def delete(self, path: str, recursive: bool = False,
+               keep_chunks: bool = False) -> bool:
+        q = []
+        if recursive:
+            q.append("recursive=true")
+        if keep_chunks:
+            q.append("skipChunkDeletion=true")
+        try:
+            rpc.call(self._q(path) + ("?" + "&".join(q) if q else ""),
+                     "DELETE")
+            return True
+        except rpc.RpcError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    def list(self, path: str, last: str = "", limit: int = 1024) -> list:
+        q = f"?limit={limit}"
+        if last:
+            q += f"&lastFileName={urllib.parse.quote(last)}"
+        try:
+            out = rpc.call(self._q(path.rstrip('/') + '/') + q)
+        except rpc.RpcError as e:
+            if e.status == 404:
+                return []
+            raise  # a filer 5xx is not "empty directory"
+        assert isinstance(out, dict)
+        return out.get("entries", [])
+
+    def list_all(self, path: str) -> list:
+        """Paginate until exhausted (for unbounded listings like
+        multipart-part enumeration)."""
+        out: list = []
+        last = ""
+        while True:
+            page = self.list(path, last, 1024)
+            if not page:
+                return out
+            out.extend(page)
+            last = page[-1]["name"]
+            if len(page) < 1024:
+                return out

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 
@@ -134,6 +135,7 @@ class IdentityAccessManagement:
             raise AuthError("InvalidAccessKeyId",
                             f"unknown access key {access_key}")
         amz_date = headers.get("x-amz-date", "")
+        self._check_date(amz_date, scope)
         payload_hash = headers.get("x-amz-content-sha256") or \
             _sha256(body)
         if payload_hash == "UNSIGNED-PAYLOAD":
@@ -153,6 +155,29 @@ class IdentityAccessManagement:
             raise AuthError("SignatureDoesNotMatch",
                             "signature mismatch")
         return identity
+
+    @staticmethod
+    def _check_date(amz_date: str, scope: str) -> None:
+        """Reject requests outside a 15-minute clock-skew window and
+        requests whose x-amz-date disagrees with the credential-scope
+        date (auth_signature_v4.go's replay protection)."""
+        import calendar
+        try:
+            ts = calendar.timegm(time.strptime(amz_date,
+                                               "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            raise AuthError("AuthorizationHeaderMalformed",
+                            f"bad x-amz-date {amz_date!r}",
+                            400) from None
+        if abs(time.time() - ts) > 15 * 60:
+            raise AuthError("RequestTimeTooSkewed",
+                            "request time differs from server time by "
+                            "more than 15 minutes")
+        scope_date = scope.split("/", 1)[0]
+        if scope_date != amz_date[:8]:
+            raise AuthError("AuthorizationHeaderMalformed",
+                            "credential scope date does not match "
+                            "x-amz-date", 400)
 
     def authorize(self, identity: Identity | None, action: str,
                   bucket: str) -> None:

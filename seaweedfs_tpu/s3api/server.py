@@ -23,6 +23,7 @@ import uuid
 import xml.etree.ElementTree as ET
 
 from ..cluster import rpc
+from ..filer.client import FilerProxy
 from .auth import (ACTION_ADMIN, ACTION_LIST, ACTION_READ, ACTION_TAGGING,
                    ACTION_WRITE, AuthError, Identity,
                    IdentityAccessManagement)
@@ -82,80 +83,20 @@ def _decode_aws_chunked(body: bytes) -> bytes:
     return bytes(out)
 
 
+def _valid_bucket_name(name: str) -> bool:
+    """AWS bucket naming rules (the subset the reference enforces):
+    3-63 chars of [a-z0-9.-], starting/ending alphanumeric — which also
+    keeps reserved names like '.uploads' out of the bucket namespace."""
+    import re
+    return bool(re.fullmatch(r"[a-z0-9][a-z0-9.-]{1,61}[a-z0-9]", name))
+
+
 class S3Error(Exception):
     def __init__(self, status: int, code: str, message: str):
         super().__init__(message)
         self.status = status
         self.code = code
         self.message = message
-
-
-class FilerProxy:
-    """Thin client of the filer HTTP surface."""
-
-    def __init__(self, filer_url: str):
-        self.url = filer_url.rstrip("/")
-
-    def _q(self, path: str) -> str:
-        return self.url + urllib.parse.quote(path)
-
-    def get(self, path: str, range_header: str = ""):
-        req = urllib.request.Request(self._q(path))
-        if range_header:
-            req.add_header("Range", range_header)
-        return urllib.request.urlopen(req, timeout=60)
-
-    def meta(self, path: str) -> dict | None:
-        try:
-            out = rpc.call(self._q(path) + "?metadata=true")
-            assert isinstance(out, dict)
-            return out
-        except rpc.RpcError:
-            return None
-
-    def put(self, path: str, body: bytes, content_type: str = "") -> dict:
-        req = urllib.request.Request(self._q(path), data=body,
-                                     method="POST")
-        if content_type:
-            req.add_header("Content-Type", content_type)
-        with urllib.request.urlopen(req, timeout=600) as resp:
-            return json.load(resp)
-
-    def create_entry(self, path: str, entry: dict) -> dict:
-        out = rpc.call(self._q(path) + "?entry=true", "POST",
-                       json.dumps(entry).encode())
-        assert isinstance(out, dict)
-        return out
-
-    def mkdir(self, path: str) -> None:
-        rpc.call(self._q(path) + "?mkdir=true", "POST", b"")
-
-    def delete(self, path: str, recursive: bool = False,
-               keep_chunks: bool = False) -> bool:
-        q = []
-        if recursive:
-            q.append("recursive=true")
-        if keep_chunks:
-            q.append("skipChunkDeletion=true")
-        try:
-            rpc.call(self._q(path) + ("?" + "&".join(q) if q else ""),
-                     "DELETE")
-            return True
-        except rpc.RpcError as e:
-            if e.status == 404:
-                return False
-            raise
-
-    def list(self, path: str, last: str = "", limit: int = 1024) -> list:
-        q = f"?limit={limit}"
-        if last:
-            q += f"&lastFileName={urllib.parse.quote(last)}"
-        try:
-            out = rpc.call(self._q(path.rstrip('/') + '/') + q)
-        except rpc.RpcError:
-            return []
-        assert isinstance(out, dict)
-        return out.get("entries", [])
 
 
 class S3ApiServer:
@@ -214,8 +155,8 @@ class S3ApiServer:
         auth = lambda action: self.iam.authorize(identity, action, bucket)  # noqa: E731
 
         if not bucket:  # service level
-            auth(ACTION_ADMIN)
-            return self._list_buckets()
+            auth(ACTION_LIST)
+            return self._list_buckets(identity)
         if not key:  # bucket level
             if method == "PUT":
                 auth(ACTION_ADMIN)
@@ -264,6 +205,11 @@ class S3ApiServer:
             auth(ACTION_WRITE)
             src = headers.get("x-amz-copy-source", "")
             if src:
+                # The caller must also be allowed to READ the source
+                # bucket (s3api_object_copy_handlers.go checks both).
+                sbucket = urllib.parse.unquote(src).lstrip("/") \
+                    .partition("/")[0]
+                self.iam.authorize(identity, ACTION_READ, sbucket)
                 return self._copy_object(bucket, key, src)
             return self._put_object(bucket, key, headers, body)
         if method in ("GET", "HEAD"):
@@ -293,14 +239,20 @@ class S3ApiServer:
 
     # -- service / bucket ----------------------------------------------------
 
-    def _list_buckets(self):
+    def _list_buckets(self, identity: Identity | None = None):
         root = ET.Element("ListAllMyBucketsResult",
                           {"xmlns": XMLNS})
         owner = _el(root, "Owner")
         _el(owner, "ID", "seaweedfs")
         buckets = _el(root, "Buckets")
-        for e in self.filer.list(BUCKETS_PATH):
+        for e in self.filer.list_all(BUCKETS_PATH):
             if not e.get("is_directory") or e["name"] == UPLOADS_DIR:
+                continue
+            # Only buckets the caller may actually touch
+            # (s3api_bucket_handlers.go filters by identity.canDo).
+            if identity is not None and not (
+                    identity.allows(ACTION_LIST, e["name"])
+                    or identity.allows(ACTION_READ, e["name"])):
                 continue
             b = _el(buckets, "Bucket")
             _el(b, "Name", e["name"])
@@ -308,6 +260,9 @@ class S3ApiServer:
         return (200, _xml(root), {"Content-Type": "application/xml"})
 
     def _create_bucket(self, bucket: str):
+        if not _valid_bucket_name(bucket):
+            raise S3Error(400, "InvalidBucketName",
+                          f"{bucket!r} is not a valid bucket name")
         self.filer.mkdir(self._bucket_path(bucket))
         return (200, b"", {"Location": f"/{bucket}"})
 
@@ -337,9 +292,14 @@ class S3ApiServer:
             return (200, b"", {"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'})
         ctype = headers.get("content-type",
                             "application/octet-stream")
-        self.filer.put(self._obj_path(bucket, key), body, ctype)
-        md5 = hashlib.md5(body).hexdigest()
-        return (200, b"", {"ETag": f'"{md5}"'})
+        path = self._obj_path(bucket, key)
+        self.filer.put(path, body, ctype)
+        # Return the same ETag GET/HEAD will serve (computed from the
+        # stored chunk list) so sync clients' change detection is stable.
+        meta = self.filer.meta(path)
+        etag = self._entry_etag(meta) if meta else \
+            hashlib.md5(body).hexdigest()
+        return (200, b"", {"ETag": f'"{etag}"'})
 
     def _copy_object(self, bucket: str, key: str, src: str):
         self._require_bucket(bucket)
@@ -356,10 +316,14 @@ class S3ApiServer:
             data = resp.read()
         ctype = smeta.get("attributes", {}).get(
             "mime", "application/octet-stream")
-        self.filer.put(self._obj_path(bucket, key), data, ctype)
+        dpath = self._obj_path(bucket, key)
+        self.filer.put(dpath, data, ctype)
+        dmeta = self.filer.meta(dpath)
+        etag = self._entry_etag(dmeta) if dmeta else \
+            hashlib.md5(data).hexdigest()
         root = ET.Element("CopyObjectResult", {"xmlns": XMLNS})
         _el(root, "LastModified", _iso(time.time()))
-        _el(root, "ETag", f'"{hashlib.md5(data).hexdigest()}"')
+        _el(root, "ETag", f'"{etag}"')
         return (200, _xml(root), {"Content-Type": "application/xml"})
 
     def _get_object(self, bucket: str, key: str, headers: dict,
@@ -377,18 +341,29 @@ class S3ApiServer:
                 "%a, %d %b %Y %H:%M:%S GMT",
                 time.gmtime(attrs.get("mtime", 0))),
             "Accept-Ranges": "bytes",
+            "ETag": f'"{self._entry_etag(meta)}"',
         }
         if head:
             base_headers["Content-Length"] = str(size)
             return (200, b"", base_headers)
         rng = headers.get("range", "")
-        with self.filer.get(path, rng) as resp:
-            data = resp.read()
-            if resp.status == 206:
-                base_headers["Content-Range"] = \
-                    resp.headers.get("Content-Range", "")
-                return (206, data, base_headers)
-        return (200, data, base_headers)
+        # Hand the open filer response to the rpc layer, which streams
+        # it to the client — a 10GB GET stays O(1MB) in gateway memory.
+        resp = self.filer.get(path, rng)
+        base_headers["Content-Length"] = \
+            resp.headers.get("Content-Length", str(size))
+        if resp.status == 206:
+            base_headers["Content-Range"] = \
+                resp.headers.get("Content-Range", "")
+            return (206, resp, base_headers)
+        return (200, resp, base_headers)
+
+    @staticmethod
+    def _entry_etag(meta: dict) -> str:
+        from ..filer.entry import FileChunk
+        from ..filer.filechunks import etag as chunks_etag
+        chunks = [FileChunk.from_dict(c) for c in meta.get("chunks", [])]
+        return chunks_etag(chunks)
 
     @staticmethod
     def _visible_sizes(meta: dict) -> list[dict]:
@@ -431,33 +406,48 @@ class S3ApiServer:
 
     # -- listing -------------------------------------------------------------
 
-    def _walk_keys(self, bucket: str, prefix: str):
-        """Yield (key, entry) sorted, depth-first, under prefix."""
+    def _walk_keys(self, bucket: str, prefix: str, after: str = ""):
+        """Yield (key, entry) in S3 key order (lexicographic over full
+        key names), depth-first under prefix, skipping keys <= after.
+
+        Within one directory the filer lists by entry name, but S3 order
+        compares full keys — a subtree under dir `a` sorts as `a/`, which
+        is AFTER file `a.txt` ('.' < '/').  So each directory's entries
+        are re-sorted by their effective key (name + '/' for dirs) before
+        descending.  Subtrees that cannot intersect [prefix, after..) are
+        pruned, so prefix listings don't walk the whole bucket.
+        """
         base = self._bucket_path(bucket)
 
         def rec(dir_rel: str):
             dir_abs = base + ("/" + dir_rel if dir_rel else "")
-            last = ""
-            while True:
-                entries = self.filer.list(dir_abs, last, 1024)
-                if not entries:
-                    return
-                for e in entries:
-                    rel = (dir_rel + "/" if dir_rel else "") + e["name"]
-                    if e.get("is_directory"):
-                        if e["name"] == UPLOADS_DIR and not dir_rel:
-                            continue
-                        yield from rec(rel)
-                    else:
-                        if rel.startswith(prefix):
-                            yield rel, e
-                last = entries[-1]["name"]
-                if len(entries) < 1024:
-                    return
+            entries = self.filer.list_all(dir_abs)
+            entries.sort(key=lambda e: e["name"] +
+                         ("/" if e.get("is_directory") else ""))
+            for e in entries:
+                rel = (dir_rel + "/" if dir_rel else "") + e["name"]
+                if e.get("is_directory"):
+                    if e["name"] == UPLOADS_DIR and not dir_rel:
+                        continue
+                    sub = rel + "/"
+                    # prune: subtree keys all start with `sub`
+                    if prefix and not (sub.startswith(prefix)
+                                       or prefix.startswith(sub)):
+                        continue
+                    if after and after > sub and \
+                            not after.startswith(sub):
+                        continue  # whole subtree sorts <= after
+                    yield from rec(rel)
+                else:
+                    if rel.startswith(prefix) and \
+                            not (after and rel <= after):
+                        yield rel, e
 
-        # Start from the deepest directory fully inside the prefix to
-        # avoid walking the whole bucket.
-        yield from rec("")
+        # Start from the deepest directory fully inside the prefix.
+        start = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
+        if start and self.filer.meta(base + "/" + start) is None:
+            return
+        yield from rec(start)
 
     def _list_objects(self, bucket: str, query: dict):
         self._require_bucket(bucket)
@@ -471,32 +461,40 @@ class S3ApiServer:
         contents, common = [], []
         truncated = False
         seen_prefixes = set()
-        for key, e in self._walk_keys(bucket, prefix):
-            if after and key <= after:
-                continue
+        last_item = ""  # last key or common prefix actually included
+        for key, e in self._walk_keys(bucket, prefix, after):
+            cp = None
             if delimiter:
                 rest = key[len(prefix):]
                 if delimiter in rest:
                     cp = prefix + rest.split(delimiter)[0] + delimiter
-                    if cp not in seen_prefixes:
-                        seen_prefixes.add(cp)
-                        common.append(cp)
-                    continue
-            contents.append((key, e))
+                    if cp in seen_prefixes:
+                        continue
+                    if after and cp <= after:
+                        continue  # prefix already reported on a prior page
+            # CommonPrefixes count toward MaxKeys like Contents do; only
+            # report IsTruncated when a further item actually exists.
             if len(contents) + len(common) >= max_keys:
                 truncated = True
                 break
+            if cp is not None:
+                seen_prefixes.add(cp)
+                common.append(cp)
+                last_item = cp
+            else:
+                contents.append((key, e))
+                last_item = key
         root = ET.Element("ListBucketResult", {"xmlns": XMLNS})
         _el(root, "Name", bucket)
         _el(root, "Prefix", prefix)
         _el(root, "MaxKeys", max_keys)
         _el(root, "IsTruncated", "true" if truncated else "false")
         if v2:
-            _el(root, "KeyCount", len(contents))
-            if truncated and contents:
-                _el(root, "NextContinuationToken", contents[-1][0])
-        elif truncated and contents:
-            _el(root, "NextMarker", contents[-1][0])
+            _el(root, "KeyCount", len(contents) + len(common))
+            if truncated and last_item:
+                _el(root, "NextContinuationToken", last_item)
+        elif truncated and last_item:
+            _el(root, "NextMarker", last_item)
         for key, e in contents:
             c = _el(root, "Contents")
             _el(c, "Key", key)
@@ -535,8 +533,14 @@ class S3ApiServer:
     def _upload_part(self, bucket: str, key: str, query: dict,
                      body: bytes):
         part = int(query["partNumber"])
+        if not 1 <= part <= 10000:
+            raise S3Error(400, "InvalidArgument",
+                          "partNumber must be between 1 and 10000")
         upload_id = query["uploadId"]
-        path = f"{self._uploads_path(bucket, upload_id)}/{part:05d}.part"
+        updir = self._uploads_path(bucket, upload_id)
+        if self.filer.meta(updir + "/.manifest") is None:
+            raise S3Error(404, "NoSuchUpload", upload_id)
+        path = f"{updir}/{part:05d}.part"
         self.filer.put(path, body)
         md5 = hashlib.md5(body).hexdigest()
         return (200, b"", {"ETag": f'"{md5}"'})
@@ -549,7 +553,7 @@ class S3ApiServer:
         if manifest is None:
             raise S3Error(404, "NoSuchUpload", upload_id)
         uploaded = sorted(
-            (e["name"] for e in self.filer.list(updir, limit=10000)
+            (e["name"] for e in self.filer.list_all(updir)
              if e["name"].endswith(".part")))
         # S3 semantics: only the parts listed in the request body are
         # assembled; unlisted uploaded parts are excluded.

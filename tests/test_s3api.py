@@ -173,7 +173,10 @@ def test_object_crud_and_range(stack):
     body = b"0123456789" * 100  # 1000B -> 4 chunks of 256
     with client.request("PUT", "/objbucket/dir/key.bin", body=body) as r:
         etag = r.headers["ETag"]
-    assert etag == f'"{hashlib.md5(body).hexdigest()}"'
+    # PUT's ETag must match what GET/HEAD serve afterwards (sync
+    # clients use it for change detection).
+    with client.request("HEAD", "/objbucket/dir/key.bin") as r:
+        assert r.headers["ETag"] == etag
     with client.request("GET", "/objbucket/dir/key.bin") as r:
         assert r.read() == body
     with client.request("GET", "/objbucket/dir/key.bin",
@@ -413,3 +416,149 @@ def test_readonly_identity(stack):
     with pytest.raises(urllib.error.HTTPError) as ei:
         ro.request("DELETE", "/robucket/data")
     assert ei.value.code == 403
+
+
+# -- regression tests for review findings ------------------------------------
+
+def test_copy_requires_read_on_source_bucket(stack):
+    """Write on the destination must not grant read of the source
+    (s3api_object_copy_handlers.go checks both ends)."""
+    *_rest, s3, client = stack[:4] + (stack[4],)
+    scoped_id = Identity("scoped", "SCOPEDKEY", "scopedsecret",
+                         ["Read:mine", "Write:mine", "List"])
+    s3.iam.identities[scoped_id.access_key] = scoped_id
+    client.request("PUT", "/privatebkt").read()
+    client.request("PUT", "/mine").read()
+    client.request("PUT", "/privatebkt/secret.txt", body=b"top secret").read()
+    scoped = S3Client(s3.url(), "SCOPEDKEY", "scopedsecret")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        scoped.request("PUT", "/mine/stolen",
+                       headers={"x-amz-copy-source": "/privatebkt/secret.txt"})
+    assert ei.value.code == 403
+    # and with read rights on the source it succeeds
+    client.request("PUT", "/mine/ok",
+                   headers={"x-amz-copy-source": "/privatebkt/secret.txt"}
+                   ).read()
+    with client.request("GET", "/mine/ok") as r:
+        assert r.read() == b"top secret"
+
+
+def test_list_pagination_dot_vs_slash_order(stack):
+    """'a.txt' sorts before 'a/x' in S3 key order ('.' < '/'); paginated
+    listing must not skip either."""
+    *_rest, client = stack
+    client.request("PUT", "/orderbkt").read()
+    client.request("PUT", "/orderbkt/a.txt", body=b"1").read()
+    client.request("PUT", "/orderbkt/a/x", body=b"2").read()
+    client.request("PUT", "/orderbkt/b.txt", body=b"3").read()
+    keys, token = [], ""
+    for _ in range(10):
+        q = "list-type=2&max-keys=1"
+        if token:
+            q += f"&continuation-token={urllib.parse.quote(token)}"
+        root = _strip_ns(client.xml("GET", "/orderbkt", query=q))
+        keys += [c.findtext("Key") for c in root.iter("Contents")]
+        if root.findtext("IsTruncated") != "true":
+            break
+        token = root.findtext("NextContinuationToken")
+    assert keys == ["a.txt", "a/x", "b.txt"]
+
+
+def test_upload_part_unknown_upload_id(stack):
+    *_rest, client = stack
+    client.request("PUT", "/mpbkt").read()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        client.request("PUT", "/mpbkt/obj",
+                       query="partNumber=1&uploadId=deadbeef", body=b"x")
+    assert ei.value.code == 404
+
+
+def test_get_and_head_carry_etag(stack):
+    *_rest, client = stack
+    client.request("PUT", "/etagbkt").read()
+    client.request("PUT", "/etagbkt/f.bin", body=b"etag me").read()
+    with client.request("GET", "/etagbkt/f.bin") as r:
+        get_etag = r.headers.get("ETag")
+    with client.request("HEAD", "/etagbkt/f.bin") as r:
+        head_etag = r.headers.get("ETag")
+    assert get_etag and get_etag == head_etag
+
+
+def test_bucket_name_validation(stack):
+    *_rest, client = stack
+    for bad in ("/.uploads", "/UPPER", "/ab", "/-bad", "/bad-"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client.request("PUT", bad)
+        assert ei.value.code == 400, bad
+    client.request("PUT", "/valid-name.ok").read()
+
+
+def test_sigv4_replay_window(stack):
+    """Requests with an x-amz-date outside +/-15min are rejected
+    (RequestTimeTooSkewed, like the reference's auth window)."""
+    *_rest, s3, client = stack[:4] + (stack[4],)
+
+    class StaleClient(S3Client):
+        def request(self, method, path, query="", body=b"",
+                    headers=None):
+            headers = dict(headers or {})
+            stale = time.gmtime(time.time() - 3600)
+            amz_date = time.strftime("%Y%m%dT%H%M%SZ", stale)
+            date = time.strftime("%Y%m%d", stale)
+            scope = f"{date}/us-east-1/s3/aws4_request"
+            payload_hash = hashlib.sha256(body).hexdigest()
+            headers["host"] = self.host
+            headers["x-amz-date"] = amz_date
+            headers["x-amz-content-sha256"] = payload_hash
+            signed = sorted(k.lower() for k in headers)
+            sig = compute_signature_v4(
+                method, path, query,
+                {k.lower(): v for k, v in headers.items()}, signed,
+                payload_hash, amz_date, scope, self.secret)
+            headers["Authorization"] = (
+                "AWS4-HMAC-SHA256 "
+                f"Credential={self.access}/{scope},"
+                f"SignedHeaders={';'.join(signed)},Signature={sig}")
+            req = urllib.request.Request(
+                f"{self.endpoint}{urllib.parse.quote(path)}",
+                data=body or None, method=method, headers=headers)
+            return urllib.request.urlopen(req, timeout=30)
+
+    stale = StaleClient(s3.url(), ACCESS, SECRET)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        stale.request("GET", "/")
+    assert ei.value.code == 403
+    assert b"RequestTimeTooSkewed" in ei.value.read()
+
+
+def test_delimiter_common_prefixes_count_toward_max_keys(stack):
+    *_rest, client = stack
+    client.request("PUT", "/delimbkt").read()
+    for d in ("p1", "p2", "p3"):
+        client.request("PUT", f"/delimbkt/{d}/f", body=b"x").read()
+    root = _strip_ns(client.xml(
+        "GET", "/delimbkt", query="list-type=2&delimiter=/&max-keys=2"))
+    prefixes = [p.findtext("Prefix")
+                for p in root.iter("CommonPrefixes")]
+    assert prefixes == ["p1/", "p2/"]
+    assert root.findtext("IsTruncated") == "true"
+    token = root.findtext("NextContinuationToken")
+    root2 = _strip_ns(client.xml(
+        "GET", "/delimbkt",
+        query="list-type=2&delimiter=/&max-keys=2&continuation-token="
+              + urllib.parse.quote(token)))
+    prefixes2 = [p.findtext("Prefix")
+                 for p in root2.iter("CommonPrefixes")]
+    assert prefixes2 == ["p3/"]
+    assert root2.findtext("IsTruncated") == "false"
+
+
+def test_exactly_full_page_not_truncated(stack):
+    *_rest, client = stack
+    client.request("PUT", "/fullpagebkt").read()
+    for i in range(3):
+        client.request("PUT", f"/fullpagebkt/k{i}", body=b"x").read()
+    root = _strip_ns(client.xml("GET", "/fullpagebkt",
+                                query="list-type=2&max-keys=3"))
+    assert len(list(root.iter("Contents"))) == 3
+    assert root.findtext("IsTruncated") == "false"
