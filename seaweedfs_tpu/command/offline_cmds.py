@@ -68,17 +68,17 @@ def run_export(flags: Flags, args: list[str]) -> int:
             time.strptime(newer_than, "%Y-%m-%d %H:%M:%S"))) * 10**9
     tar = tarfile.open(out_path, "w") if out_path else None
     count = 0
-    deleted: set[int] = set()
-    records = []
+    # Append order is authoritative: the newest record per id wins, and a
+    # tombstone (size<=0) erases any earlier version (same liveness rule
+    # `weed fix` uses to rebuild the .idx).
+    latest: dict[int, tuple] = {}
     for needle, offset, total in scan_volume_file(base + ".dat"):
         if needle.size <= 0:
-            deleted.add(needle.id)
-            continue
-        records.append((needle, offset, total))
+            latest.pop(needle.id, None)
+        else:
+            latest[needle.id] = (needle, offset, total)
     try:
-        for needle, offset, _total in records:
-            if needle.id in deleted:
-                continue
+        for needle, offset, _total in latest.values():
             if newer_ns and needle.append_at_ns < newer_ns:
                 continue
             name = (needle.name.decode("utf-8", "replace")

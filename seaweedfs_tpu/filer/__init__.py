@@ -10,6 +10,8 @@ from .entry import Attributes, Entry, FileChunk  # noqa: F401
 from .filechunks import (ChunkView, VisibleInterval,  # noqa: F401
                          compact_file_chunks, etag, non_overlapping_visible_intervals,
                          read_chunk_views, total_size)
-from .filer import Filer, FilerError  # noqa: F401
+from .filer import Filer, FilerError, MetaEvent  # noqa: F401
 from .filerstore import (FilerStore, MemoryStore,  # noqa: F401
                          SqliteStore, store_for_path)
+from .meta_aggregator import MetaAggregator  # noqa: F401
+from .meta_log import MetaLog  # noqa: F401

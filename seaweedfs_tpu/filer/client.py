@@ -9,6 +9,7 @@ every gateway.
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.parse
 import urllib.request
 
@@ -89,6 +90,39 @@ class FilerProxy:
             raise  # a filer 5xx is not "empty directory"
         assert isinstance(out, dict)
         return out.get("entries", [])
+
+    # -- meta subscription + KV (SubscribeMetadata / KvGet / KvPut) ---------
+
+    def meta_info(self) -> dict:
+        out = rpc.call(self.url + "/.meta/info")
+        assert isinstance(out, dict)
+        return out
+
+    def meta_events(self, since_ns: int = 0, exclude_signature: int = 0,
+                    prefix: str = "", limit: int = 10000) -> dict:
+        q = f"?since_ns={since_ns}&limit={limit}"
+        if exclude_signature:
+            q += f"&exclude_signature={exclude_signature}"
+        if prefix:
+            q += f"&prefix={urllib.parse.quote(prefix, safe='')}"
+        out = rpc.call(self.url + "/.meta/subscribe" + q)
+        assert isinstance(out, dict)
+        return out
+
+    def kv_get(self, key: str) -> bytes | None:
+        req = urllib.request.Request(self.url + "/.kv/" +
+                                     urllib.parse.quote(key, safe=""))
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        rpc.call(self.url + "/.kv/" +
+                 urllib.parse.quote(key, safe=""), "PUT", value)
 
     def list_all(self, path: str) -> list:
         """Paginate until exhausted (for unbounded listings like

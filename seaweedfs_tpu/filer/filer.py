@@ -9,6 +9,7 @@ filer_notify.go (NotifyUpdateEvent meta log), meta_aggregator.go
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable, Iterable
@@ -16,6 +17,7 @@ from typing import Callable, Iterable
 from .entry import Attributes, Entry, FileChunk
 from .filechunks import minus_chunks
 from .filerstore import FilerStore, MemoryStore, NotFound, _norm
+from .meta_log import MetaLog
 
 ROOT = Entry(path="/", is_directory=True,
              attributes=Attributes(mode=0o755))
@@ -26,43 +28,73 @@ class FilerError(Exception):
 
 
 class MetaEvent:
-    """One namespace mutation (filer.proto EventNotification)."""
+    """One namespace mutation (filer.proto EventNotification).
 
-    __slots__ = ("ts_ns", "directory", "old_entry", "new_entry")
+    ``signatures`` lists the filer signatures that have already seen or
+    applied this mutation — the active-active sync loop-breaker
+    (filer.proto EventNotification.signatures; command/filer_sync.go
+    skips events already carrying the target's signature)."""
+
+    __slots__ = ("ts_ns", "directory", "old_entry", "new_entry",
+                 "signatures")
 
     def __init__(self, directory: str, old_entry: Entry | None,
-                 new_entry: Entry | None, ts_ns: int | None = None):
+                 new_entry: Entry | None, ts_ns: int | None = None,
+                 signatures: list[int] | None = None):
         self.ts_ns = ts_ns if ts_ns is not None else time.time_ns()
         self.directory = directory
         self.old_entry = old_entry
         self.new_entry = new_entry
+        self.signatures = signatures or []
 
     def to_dict(self) -> dict:
         return {"ts_ns": self.ts_ns, "directory": self.directory,
                 "old_entry": self.old_entry.to_dict()
                 if self.old_entry else None,
                 "new_entry": self.new_entry.to_dict()
-                if self.new_entry else None}
+                if self.new_entry else None,
+                "signatures": self.signatures}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MetaEvent":
+        return cls(
+            directory=d["directory"],
+            old_entry=Entry.from_dict(d["old_entry"])
+            if d.get("old_entry") else None,
+            new_entry=Entry.from_dict(d["new_entry"])
+            if d.get("new_entry") else None,
+            ts_ns=d["ts_ns"], signatures=list(d.get("signatures", [])))
 
 
 class Filer:
     def __init__(self, store: FilerStore | None = None,
                  delete_file_id_fn: Callable[[list[str]], None]
                  | None = None,
-                 log_capacity: int = 4096):
+                 log_capacity: int = 4096,
+                 meta_log_dir: str | None = None,
+                 signature: int | None = None):
         self.store = store or MemoryStore()
+        # Filer signature: random id stamped on every locally-originated
+        # event — the cross-cluster sync loop-breaker (filer.go filer
+        # Signature field).
+        self.signature = signature if signature is not None \
+            else random.getrandbits(31)
         # Chunk GC: file ids queued here are batch-deleted from the blob
         # store by the deletion pump (filer_deletion.go).
         self._delete_fn = delete_file_id_fn
         self._pending_deletions: list[str] = []
         self._del_lock = threading.Lock()
-        # Meta log ring buffer + live subscribers (log_buffer + notify).
-        # RLock: delivery happens under the lock so one subscriber sees
-        # events strictly in order; replay during subscribe() holds it.
-        self._log: list[MetaEvent] = []
-        self._log_capacity = log_capacity
+        # Meta log: persistent journal + live subscribers (filer_notify
+        # + log_buffer).  RLock: delivery happens under the lock so one
+        # subscriber sees events strictly in order; replay during
+        # subscribe() holds it.
+        self.meta_log = MetaLog(meta_log_dir, capacity=log_capacity)
         self._log_lock = threading.RLock()
         self._subscribers: list[Callable[[MetaEvent], None]] = []
+        # Signatures to attach to the next mutation on this thread
+        # (set by the server when a sync/replication client replays a
+        # remote event carrying prior signatures).
+        self._extra_signatures = threading.local()
         self._stop = threading.Event()
         self._pump = threading.Thread(target=self._deletion_pump,
                                       daemon=True, name="filer-gc")
@@ -260,13 +292,28 @@ class Filer:
 
     # -- meta log / subscriptions -------------------------------------------
 
+    def with_signatures(self, signatures: list[int]):
+        """Context manager: mutations inside carry these extra
+        signatures (sync replay attaching the origin chain)."""
+        filer = self
+
+        class _Ctx:
+            def __enter__(self):
+                filer._extra_signatures.value = list(signatures)
+
+            def __exit__(self, *exc):
+                filer._extra_signatures.value = []
+        return _Ctx()
+
     def _notify(self, directory: str, old: Entry | None,
                 new: Entry | None) -> None:
-        ev = MetaEvent(directory, old, new)
+        sigs = [self.signature]
+        for s in getattr(self._extra_signatures, "value", []):
+            if s not in sigs:
+                sigs.append(s)
+        ev = MetaEvent(directory, old, new, signatures=sigs)
         with self._log_lock:
-            self._log.append(ev)
-            if len(self._log) > self._log_capacity:
-                self._log = self._log[-self._log_capacity:]
+            self.meta_log.append(ev.to_dict())
             # Deliver under the lock: a subscriber mid-replay in
             # subscribe() must not observe newer events first.
             for fn in list(self._subscribers):
@@ -275,15 +322,28 @@ class Filer:
                 except Exception:  # noqa: BLE001 — one bad subscriber
                     pass           # must not break mutations
 
+    def read_meta_events(self, since_ns: int = 0,
+                         limit: int = 10000) -> list[MetaEvent]:
+        """Events newer than since_ns from the persistent journal."""
+        return [MetaEvent.from_dict(d)
+                for d in self.meta_log.read_since(since_ns, limit)]
+
     def subscribe(self, fn: Callable[[MetaEvent], None],
                   since_ns: int = 0) -> Callable[[], None]:
         """Replay events newer than since_ns, then deliver live events
         (SubscribeMetadata: replay-from-log then tail).  Returns an
         unsubscribe function."""
         with self._log_lock:
-            replay = [ev for ev in self._log if ev.ts_ns > since_ns]
-            for ev in replay:
-                fn(ev)
+            # Page until the journal is exhausted — a fixed-limit read
+            # would silently gap the replay on large journals.
+            page_size = 10000
+            while True:
+                page = self.read_meta_events(since_ns, page_size)
+                for ev in page:
+                    fn(ev)
+                if len(page) < page_size:
+                    break
+                since_ns = page[-1].ts_ns
             self._subscribers.append(fn)
 
         def unsubscribe():
@@ -295,4 +355,5 @@ class Filer:
     def close(self) -> None:
         self._stop.set()
         self.flush_deletions()
+        self.meta_log.close()
         self.store.close()

@@ -1,0 +1,87 @@
+"""MetaAggregator: unified metadata change stream across filer peers.
+
+Reference: weed/filer/meta_aggregator.go:31-151 — in a multi-filer
+deployment every filer subscribes to each peer's *local* meta log and
+merges the per-peer streams into one aggregated feed, so any single
+filer can serve a cluster-wide SubscribeMetadata.
+
+Here each peer is tailed by a poll thread against the peer's
+``/.meta/subscribe`` endpoint (our SubscribeLocalMetadata), with
+per-peer resume offsets; merged events are delivered to local
+subscribers tagged with the originating peer URL.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from .client import FilerProxy
+from .filer import MetaEvent
+
+
+class MetaAggregator:
+    def __init__(self, peers: list[str], poll_interval: float = 0.2,
+                 self_signature: int = 0):
+        self.peers = [p.rstrip("/") for p in peers]
+        self.poll_interval = poll_interval
+        self.self_signature = self_signature
+        self._offsets: dict[str, int] = {p: 0 for p in self.peers}
+        self._subscribers: list[Callable[[str, MetaEvent], None]] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def subscribe(self, fn: Callable[[str, MetaEvent], None]) -> None:
+        """fn(peer_url, event) on every aggregated mutation."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def start(self, since_ns: int = 0) -> None:
+        for p in self.peers:
+            self._offsets[p] = since_ns
+            t = threading.Thread(target=self._tail_peer, args=(p,),
+                                 daemon=True,
+                                 name=f"meta-aggregator-{p}")
+            t.start()
+            self._threads.append(t)
+
+    def _tail_peer(self, peer: str) -> None:
+        proxy = FilerProxy(peer)
+        while not self._stop.is_set():
+            try:
+                out = proxy.meta_events(
+                    since_ns=self._offsets[peer],
+                    exclude_signature=self.self_signature)
+                events = out.get("events", [])
+                for d in events:
+                    ev = MetaEvent.from_dict(d)
+                    with self._lock:
+                        subs = list(self._subscribers)
+                    for fn in subs:
+                        try:
+                            fn(peer, ev)
+                        except Exception:  # noqa: BLE001 — a bad
+                            pass           # subscriber can't stall peers
+                self._offsets[peer] = out.get(
+                    "last_ns", self._offsets[peer])
+            except Exception:  # noqa: BLE001 — peer down; retry
+                pass
+            self._stop.wait(self.poll_interval)
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Testing aid: wait until every peer tail is caught up to the
+        peer's current last_ns."""
+        import time
+        deadline = time.monotonic() + timeout
+        for p in self.peers:
+            proxy = FilerProxy(p)
+            target = proxy.meta_info()["last_ns"]
+            while self._offsets[p] < target and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)

@@ -37,12 +37,17 @@ class FilerServer:
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
+        meta_log_dir = store_path + ".metalog" if store_path else None
         self.filer = Filer(store=store_for_path(store_path),
-                           delete_file_id_fn=self._delete_file_ids)
+                           delete_file_id_fn=self._delete_file_ids,
+                           meta_log_dir=meta_log_dir)
         self.streamer = ChunkStreamer(self.client)
         self.server = rpc.JsonHttpServer(host, port)
         s = self.server
         s.route("GET", "/.meta/subscribe", self._meta_subscribe)
+        s.route("GET", "/.meta/info", self._meta_info)
+        s.prefix_route("GET", "/.kv/", self._kv_get)
+        s.prefix_route("PUT", "/.kv/", self._kv_put)
         s.prefix_route("GET", "/", self._get)
         s.prefix_route("HEAD", "/", self._head)
         s.prefix_route("POST", "/", self._post)
@@ -147,6 +152,13 @@ class FilerServer:
 
     # -- write ---------------------------------------------------------------
 
+    @staticmethod
+    def _signatures(query: dict) -> list[int]:
+        """?signatures=1,2,3 — origin chain a sync client replays so the
+        resulting events keep their loop-breaker signatures."""
+        raw = query.get("signatures", "")
+        return [int(s) for s in raw.split(",") if s.strip()]
+
     def _post(self, path: str, query: dict, body: bytes):
         path = urllib.parse.unquote(path).rstrip("/") or "/"
         if query.get("entry") == "true":
@@ -156,7 +168,8 @@ class FilerServer:
             d = json.loads(body)
             d["path"] = path
             try:
-                e = self.filer.create_entry(Entry.from_dict(d))
+                with self.filer.with_signatures(self._signatures(query)):
+                    e = self.filer.create_entry(Entry.from_dict(d))
             except FilerError as err:
                 raise rpc.RpcError(409, str(err)) from None
             return e.to_dict()
@@ -209,8 +222,9 @@ class FilerServer:
         recursive = query.get("recursive") == "true"
         keep_chunks = query.get("skipChunkDeletion") == "true"
         try:
-            self.filer.delete_entry(path, recursive=recursive,
-                                    delete_chunks=not keep_chunks)
+            with self.filer.with_signatures(self._signatures(query)):
+                self.filer.delete_entry(path, recursive=recursive,
+                                        delete_chunks=not keep_chunks)
         except NotFound:
             raise rpc.RpcError(404, f"{path} not found") from None
         except FilerError as e:
@@ -220,14 +234,51 @@ class FilerServer:
     # -- meta subscription ---------------------------------------------------
 
     def _meta_subscribe(self, query: dict, body: bytes) -> dict:
-        """Poll-based metadata tail: events newer than since_ns
-        (SubscribeMetadata's replay half; clients poll to tail)."""
+        """Poll-based metadata tail: events newer than since_ns, replayed
+        from the persistent journal (SubscribeMetadata; clients poll to
+        tail).  ?exclude_signature=N drops events already carrying that
+        signature — the filer.sync loop-breaker; ?prefix=/p filters by
+        directory prefix (SubscribeMetadata PathPrefix)."""
         since = int(query.get("since_ns", 0))
-        with self.filer._log_lock:
-            events = [ev.to_dict() for ev in self.filer._log
-                      if ev.ts_ns > since]
-        return {"events": events,
-                "last_ns": events[-1]["ts_ns"] if events else since}
+        limit = int(query.get("limit", 10000))
+        excl = int(query.get("exclude_signature", 0))
+        prefix = query.get("prefix", "")
+        raw = self.filer.read_meta_events(since, limit)
+        events = []
+        for ev in raw:
+            if excl and excl in ev.signatures:
+                continue
+            if prefix and not (ev.directory + "/").startswith(
+                    prefix.rstrip("/") + "/"):
+                continue
+            events.append(ev.to_dict())
+        # The resume cursor must not jump past unscanned events: when the
+        # raw page is full the journal may hold more, so the cursor stops
+        # at the last *scanned* event even if filters dropped it.
+        if len(raw) >= limit:
+            last = raw[-1].ts_ns
+        else:
+            last = max(since, self.filer.meta_log.last_ts_ns())
+        return {"events": events, "last_ns": last,
+                "signature": self.filer.signature}
+
+    def _meta_info(self, query: dict, body: bytes) -> dict:
+        return {"signature": self.filer.signature,
+                "last_ns": self.filer.meta_log.last_ts_ns()}
+
+    # -- KV (filer.proto KvGet/KvPut — sync offset checkpoints) -------------
+
+    def _kv_get(self, path: str, query: dict, body: bytes):
+        key = path[len("/.kv/"):]
+        v = self.filer.store.kv_get(key)
+        if v is None:
+            raise rpc.RpcError(404, f"kv key {key} not found")
+        return (200, v, {"Content-Type": "application/octet-stream"})
+
+    def _kv_put(self, path: str, query: dict, body: bytes):
+        key = path[len("/.kv/"):]
+        self.filer.store.kv_put(key, body)
+        return {"stored": key}
 
 
 def _ttl_seconds(ttl: str) -> int:

@@ -445,6 +445,10 @@ class S3ApiServer:
 
         # Start from the deepest directory fully inside the prefix.
         start = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
+        if start.split("/", 1)[0] == UPLOADS_DIR:
+            # Starting inside the multipart staging subtree would bypass
+            # rec()'s root-level skip and leak in-progress upload parts.
+            return
         if start and self.filer.meta(base + "/" + start) is None:
             return
         yield from rec(start)

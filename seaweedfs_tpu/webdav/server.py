@@ -48,7 +48,7 @@ class WebDavServer:
                        "LOCK", "UNLOCK"):
             self.server.prefix_route(method, "/", self._route)
         # token -> path of advisory locks (memLS equivalent)
-        self._locks: dict[str, str] = {}
+        self._locks: dict[str, tuple[str, float]] = {}  # token -> (path, expiry)
         self._locks_mu = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
@@ -244,10 +244,22 @@ class WebDavServer:
 
     # -- locks (advisory, in-memory like x/net/webdav memLS) -----------------
 
+    LOCK_TIMEOUT = 3600.0
+
+    def _purge_expired_locks(self) -> None:
+        # Callers hold self._locks_mu.  Enforces the Second-3600 timeout
+        # we advertise — abandoned tokens (crashed clients) must not
+        # accumulate forever.
+        now = time.time()
+        for tok in [t for t, (_p, exp) in self._locks.items()
+                    if exp < now]:
+            del self._locks[tok]
+
     def _lock(self, fpath: str):
         token = f"opaquelocktoken:{uuid.uuid4()}"
         with self._locks_mu:
-            self._locks[token] = fpath
+            self._purge_expired_locks()
+            self._locks[token] = (fpath, time.time() + self.LOCK_TIMEOUT)
         ET.register_namespace("D", DAV_NS)
         root = ET.Element(_dav("prop"))
         ld = ET.SubElement(root, _dav("lockdiscovery"))

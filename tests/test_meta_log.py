@@ -1,0 +1,175 @@
+"""Persistent filer meta log, signatures, KV, and MetaAggregator.
+
+Reference behaviors covered: filer_notify.go (persisted meta log with
+replay), filer.proto EventNotification.signatures (sync loop-breaker),
+filer.proto KvGet/KvPut, meta_aggregator.go (peer stream merging).
+"""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import (Filer, MemoryStore, MetaAggregator,
+                                 MetaLog)
+from seaweedfs_tpu.filer.entry import Attributes, Entry
+
+
+def _touch(filer, path, **kw):
+    filer.create_entry(Entry(path=path,
+                             attributes=Attributes(mtime=time.time())),
+                       **kw)
+
+
+# -- MetaLog ---------------------------------------------------------------
+
+def test_meta_log_memory_ring():
+    log = MetaLog(None, capacity=4)
+    for i in range(10):
+        log.append({"ts_ns": i + 1, "n": i})
+    evs = log.read_since(0)
+    assert [e["n"] for e in evs] == [6, 7, 8, 9]  # capped at capacity
+    assert log.read_since(8) == [{"ts_ns": 9, "n": 8},
+                                 {"ts_ns": 10, "n": 9}]
+
+
+def test_meta_log_persists_and_replays(tmp_path):
+    d = str(tmp_path / "log")
+    log = MetaLog(d, capacity=2)  # tiny ring: force disk replay
+    for i in range(20):
+        log.append({"ts_ns": (i + 1) * 10, "n": i})
+    log.close()
+    # Reopen: ring is empty, everything must come from segments.
+    log2 = MetaLog(d, capacity=2)
+    evs = log2.read_since(0)
+    assert [e["n"] for e in evs] == list(range(20))
+    assert [e["n"] for e in log2.read_since(150)] == list(range(15, 20))
+    assert log2.last_ts_ns() == 200
+    # Appends after reopen land in a new segment and stay ordered.
+    log2.append({"ts_ns": 500, "n": 99})
+    assert log2.read_since(190)[-1]["n"] == 99
+    log2.close()
+
+
+def test_meta_log_segment_rotation(tmp_path):
+    d = str(tmp_path / "rot")
+    log = MetaLog(d, segment_max_bytes=64)  # a couple events per file
+    for i in range(12):
+        log.append({"ts_ns": i + 1, "n": i})
+    assert len(log._segments()) > 2
+    assert [e["n"] for e in log.read_since(0)] == list(range(12))
+    log.close()
+
+
+def test_meta_log_no_duplicates_between_disk_and_ring(tmp_path):
+    log = MetaLog(str(tmp_path / "dup"), capacity=100)
+    for i in range(5):
+        log.append({"ts_ns": i + 1, "n": i})
+    # All 5 are both on disk and in the ring; reader must not repeat.
+    assert [e["n"] for e in log.read_since(0)] == [0, 1, 2, 3, 4]
+
+
+# -- Filer integration -----------------------------------------------------
+
+def test_filer_meta_log_survives_restart(tmp_path):
+    d = str(tmp_path / "filer-log")
+    f = Filer(store=MemoryStore(), meta_log_dir=d)
+    _touch(f, "/a/x.txt")
+    _touch(f, "/a/y.txt")
+    f.delete_entry("/a/x.txt")
+    evs = f.read_meta_events(0)
+    f.close()
+    assert len(evs) >= 4  # mkdir /a + 2 creates + delete
+    f2 = Filer(store=MemoryStore(), meta_log_dir=d)
+    replay = f2.read_meta_events(0)
+    assert [e.ts_ns for e in replay] == [e.ts_ns for e in evs]
+    deletes = [e for e in replay
+               if e.old_entry and not e.new_entry]
+    assert deletes[-1].old_entry.path == "/a/x.txt"
+    f2.close()
+
+
+def test_event_signatures_and_loop_filter():
+    f = Filer(store=MemoryStore(), signature=111)
+    _touch(f, "/plain.txt")
+    with f.with_signatures([222, 333]):
+        _touch(f, "/synced.txt")
+    evs = f.read_meta_events(0)
+    by_path = {e.new_entry.path: e for e in evs if e.new_entry}
+    assert by_path["/plain.txt"].signatures == [111]
+    assert set(by_path["/synced.txt"].signatures) == {111, 222, 333}
+    f.close()
+
+
+def test_subscribe_replays_from_persistent_log(tmp_path):
+    d = str(tmp_path / "sub")
+    f = Filer(store=MemoryStore(), meta_log_dir=d)
+    _touch(f, "/one.txt")
+    f.close()
+    f2 = Filer(store=MemoryStore(), meta_log_dir=d)
+    seen = []
+    f2.subscribe(lambda ev: seen.append(ev))
+    assert any(ev.new_entry and ev.new_entry.path == "/one.txt"
+               for ev in seen)
+    _touch(f2, "/two.txt")
+    assert seen[-1].new_entry.path == "/two.txt"
+    f2.close()
+
+
+# -- FilerServer HTTP surface ---------------------------------------------
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.filer.server import FilerServer
+    tmp = tmp_path_factory.mktemp("metalog-stack")
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp / "vs")], pulse_seconds=60)
+    vs.start()
+    f1 = FilerServer(master.url())
+    f1.start()
+    f2 = FilerServer(master.url())
+    f2.start()
+    yield f1, f2
+    f2.stop()
+    f1.stop()
+    vs.stop()
+    master.stop()
+
+
+def test_http_meta_subscribe_and_kv(stack):
+    from seaweedfs_tpu.filer.client import FilerProxy
+    f1, _ = stack
+    p = FilerProxy(f1.url())
+    info = p.meta_info()
+    assert info["signature"] == f1.filer.signature
+    p.put("/mlog/a.txt", b"hello")
+    out = p.meta_events(0, prefix="/mlog")
+    paths = [e["new_entry"]["path"] for e in out["events"]
+             if e.get("new_entry")]
+    assert "/mlog/a.txt" in paths
+    # exclude_signature filters this filer's own events out entirely
+    out2 = p.meta_events(0, exclude_signature=f1.filer.signature)
+    assert out2["events"] == []
+    # KV round trip
+    assert p.kv_get("sync.offset") is None
+    p.kv_put("sync.offset", b"12345")
+    assert p.kv_get("sync.offset") == b"12345"
+
+
+def test_meta_aggregator_merges_peers(stack):
+    from seaweedfs_tpu.filer.client import FilerProxy
+    f1, f2 = stack
+    agg = MetaAggregator([f1.url(), f2.url()], poll_interval=0.05)
+    got = []
+    agg.subscribe(lambda peer, ev: got.append((peer, ev)))
+    agg.start()
+    FilerProxy(f1.url()).put("/agg/p1.txt", b"one")
+    FilerProxy(f2.url()).put("/agg/p2.txt", b"two")
+    agg.drain()
+    agg.stop()
+    paths = {ev.new_entry.path for _, ev in got if ev.new_entry}
+    assert {"/agg/p1.txt", "/agg/p2.txt"} <= paths
+    peers = {peer for peer, _ in got}
+    assert peers == {f1.url(), f2.url()}
