@@ -89,6 +89,7 @@ def _load_all() -> None:
     # Import for registration side effects.
     from . import client_cmds  # noqa: F401
     from . import offline_cmds  # noqa: F401
+    from . import replication_cmds  # noqa: F401
     from . import servers  # noqa: F401
 
 

@@ -95,6 +95,10 @@ class Filer:
         # (set by the server when a sync/replication client replays a
         # remote event carrying prior signatures).
         self._extra_signatures = threading.local()
+        # Optional notification queue: every event is also published for
+        # `filer.replicate` consumers (weed/notification/configuration.go;
+        # the reference publishes from filer_notify.go:18).
+        self.notification_queue = None
         self._stop = threading.Event()
         self._pump = threading.Thread(target=self._deletion_pump,
                                       daemon=True, name="filer-gc")
@@ -142,6 +146,11 @@ class Filer:
                 raise FilerError(
                     f"{entry.path} exists as a "
                     f"{'directory' if old.is_directory else 'file'}")
+            if old.is_directory:
+                # mkdir on an existing directory is a no-op and emits NO
+                # event (filer.go:163-176) — otherwise two synced filers
+                # ping-pong directory updates forever.
+                return old
             garbage = minus_chunks(old.chunks, entry.chunks)
             self._queue_chunk_deletion(garbage)
         if not entry.attributes.crtime:
@@ -313,6 +322,15 @@ class Filer:
                 sigs.append(s)
         ev = MetaEvent(directory, old, new, signatures=sigs)
         with self._log_lock:
+            # Queue publish rides under the log lock so queue order can
+            # never diverge from meta-log order.
+            if self.notification_queue is not None:
+                try:
+                    self.notification_queue.publish(
+                        (new or old).path if (new or old) else directory,
+                        ev.to_dict())
+                except Exception:  # noqa: BLE001 — a dead queue must
+                    pass           # not block namespace mutations
             self.meta_log.append(ev.to_dict())
             # Deliver under the lock: a subscriber mid-replay in
             # subscribe() must not observe newer events first.

@@ -184,10 +184,12 @@ class FilerServer:
             return {"from": path, "to": dst}
         if query.get("mkdir") == "true":
             try:
-                self.filer.create_entry(Entry(
-                    path=path, is_directory=True,
-                    attributes=Attributes(mtime=time.time(),
-                                          crtime=time.time(), mode=0o775)))
+                with self.filer.with_signatures(self._signatures(query)):
+                    self.filer.create_entry(Entry(
+                        path=path, is_directory=True,
+                        attributes=Attributes(mtime=time.time(),
+                                              crtime=time.time(),
+                                              mode=0o775)))
             except FilerError as e:
                 raise rpc.RpcError(409, str(e)) from None
             return {"path": path, "is_directory": True}
@@ -206,8 +208,9 @@ class FilerServer:
             ttl_sec=_ttl_seconds(ttl), collection=collection,
             replication=self.replication or "")
         try:
-            entry = self.filer.create_entry(
-                Entry(path=path, chunks=chunks, attributes=attr))
+            with self.filer.with_signatures(self._signatures(query)):
+                entry = self.filer.create_entry(
+                    Entry(path=path, chunks=chunks, attributes=attr))
         except FilerError as e:
             # Roll back the uploaded chunks: the entry never existed.
             self._delete_file_ids([c.file_id for c in chunks])
@@ -243,6 +246,10 @@ class FilerServer:
         limit = int(query.get("limit", 10000))
         excl = int(query.get("exclude_signature", 0))
         prefix = query.get("prefix", "")
+        # Snapshot the journal head BEFORE scanning: an event appended
+        # mid-scan must not advance the cursor past itself unseen (it
+        # will be redelivered next poll — duplicates over loss).
+        head = self.filer.meta_log.last_ts_ns()
         raw = self.filer.read_meta_events(since, limit)
         events = []
         for ev in raw:
@@ -252,13 +259,10 @@ class FilerServer:
                     prefix.rstrip("/") + "/"):
                 continue
             events.append(ev.to_dict())
-        # The resume cursor must not jump past unscanned events: when the
-        # raw page is full the journal may hold more, so the cursor stops
-        # at the last *scanned* event even if filters dropped it.
-        if len(raw) >= limit:
-            last = raw[-1].ts_ns
-        else:
-            last = max(since, self.filer.meta_log.last_ts_ns())
+        # The resume cursor must not jump past unscanned events either:
+        # it stops at the last *scanned* event (even if filters dropped
+        # it), or at the pre-scan head when the scan saw nothing.
+        last = raw[-1].ts_ns if raw else max(since, head)
         return {"events": events, "last_ns": last,
                 "signature": self.filer.signature}
 

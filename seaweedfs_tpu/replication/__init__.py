@@ -1,0 +1,13 @@
+"""Cross-cluster replication: sinks, the replicator pump, and sync.
+
+Reference: weed/replication/ (replicator.go:17-72 routing meta events to
+pluggable sinks, sink/{filersink,s3sink,localsink,...}, sub/ notification
+inputs) and command/filer_sync.go:81-320 (active-active two-way sync with
+per-signature offset checkpoints).
+"""
+
+from .notification import (FileQueue, MemoryQueue,  # noqa: F401
+                           NotificationQueue, queue_for_spec)
+from .replicator import Replicator  # noqa: F401
+from .sink import FilerSink, LocalSink, ReplicationSink, S3Sink  # noqa: F401
+from .sync import FilerSyncWorker, sync_once  # noqa: F401

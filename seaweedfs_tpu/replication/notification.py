@@ -1,0 +1,119 @@
+"""Notification queues: the input side of one-way replication.
+
+Reference: weed/notification/ (pluggable message queues — kafka, AWS SQS,
+GCP Pub/Sub, gocdk) feeding weed/replication/sub/.  The filer publishes
+every meta event to the configured queue; `filer.replicate` consumes the
+queue and drives sinks.
+
+Kafka/SQS/PubSub need network egress + SDKs, so here the in-process
+MemoryQueue and the durable FileQueue (JSONL spool, resumable by offset)
+are real, and the cloud queues are registry stubs behind the same
+interface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable
+
+
+class NotificationQueue:
+    def publish(self, key: str, message: dict) -> None:
+        raise NotImplementedError
+
+    def consume(self, fn: Callable[[str, dict], None]) -> None:
+        """Deliver queued messages to fn(key, message); returns when the
+        queue is drained (poll-style consumption)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryQueue(NotificationQueue):
+    def __init__(self) -> None:
+        self._items: list[tuple[str, dict]] = []
+        self._lock = threading.Lock()
+
+    def publish(self, key: str, message: dict) -> None:
+        with self._lock:
+            self._items.append((key, message))
+
+    def consume(self, fn: Callable[[str, dict], None]) -> None:
+        while True:
+            with self._lock:
+                if not self._items:
+                    return
+                key, msg = self._items.pop(0)
+            fn(key, msg)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class FileQueue(NotificationQueue):
+    """Durable JSONL spool with a persisted consumer offset — survives
+    producer/consumer restarts, like an SQS queue with checkpointing."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset_path = path + ".offset"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+
+    def publish(self, key: str, message: dict) -> None:
+        line = json.dumps({"key": key, "message": message},
+                          separators=(",", ":")) + "\n"
+        with self._lock, open(self.path, "a") as f:
+            f.write(line)
+            f.flush()
+
+    def _offset(self) -> int:
+        try:
+            with open(self.offset_path) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def consume(self, fn: Callable[[str, dict], None]) -> None:
+        if not os.path.exists(self.path):
+            return
+        pos = self._offset()
+        with open(self.path) as f:
+            f.seek(pos)
+            while True:
+                line = f.readline()
+                if not line:
+                    break
+                if not line.endswith("\n"):
+                    break  # partial write; retry next consume
+                try:
+                    item = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                fn(item["key"], item["message"])
+                pos = f.tell()
+                # Checkpoint after each successful delivery: at-least-
+                # once on crash, never skipping an undelivered message.
+                with open(self.offset_path, "w") as of:
+                    of.write(str(pos))
+
+
+_STUB_QUEUES = ("kafka", "sqs", "pubsub", "gocdk")
+
+
+def queue_for_spec(spec: str) -> NotificationQueue:
+    """'memory://', 'file:///path/spool.jsonl'."""
+    scheme, _, rest = spec.partition("://")
+    if scheme == "memory":
+        return MemoryQueue()
+    if scheme == "file":
+        return FileQueue("/" + rest.lstrip("/"))
+    if scheme in _STUB_QUEUES:
+        raise NotImplementedError(
+            f"{scheme} queue needs a broker SDK + egress; add it behind "
+            f"NotificationQueue (see weed/notification/{scheme})")
+    raise ValueError(f"unknown queue spec: {spec}")

@@ -1,0 +1,207 @@
+"""Replication sinks: where replicated entries land.
+
+Reference: weed/replication/sink/ — `ReplicationSink` interface
+(replicator consumes CreateEntry/UpdateEntry/DeleteEntry, sink.go), with
+filer (filersink/filer_sink.go), local-FS, and S3 (s3sink/s3_sink.go)
+targets.  Azure/GCS/B2 exist in the reference; they need cloud SDKs with
+network egress, so here they are registry stubs that raise with a clear
+message (the sink interface is the seam to add them).
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.parse
+import urllib.request
+from typing import Callable
+
+from ..filer.client import FilerProxy
+
+
+class ReplicationSink:
+    """One replication target (sink.go ReplicationSink)."""
+
+    def create_entry(self, key: str, entry: dict,
+                     data: bytes | None) -> None:
+        """key is the sink-relative path; entry the source entry dict;
+        data the file content (None for directories)."""
+        raise NotImplementedError
+
+    def update_entry(self, key: str, entry: dict,
+                     data: bytes | None) -> None:
+        self.delete_entry(key, is_directory=False)
+        self.create_entry(key, entry, data)
+
+    def delete_entry(self, key: str, is_directory: bool) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FilerSink(ReplicationSink):
+    """Replicate into another filer cluster (filersink/filer_sink.go).
+
+    Content is re-uploaded through the target filer so chunks get fresh
+    file ids in the target cluster's blob space; `signatures` carries the
+    origin chain so a sync loop in the other direction skips these."""
+
+    def __init__(self, filer_url: str, directory: str = "/",
+                 signatures: list[int] | None = None):
+        self.proxy = FilerProxy(filer_url)
+        self.dir = "/" + directory.strip("/")
+        self.signatures = signatures or []
+
+    def _path(self, key: str) -> str:
+        return (self.dir.rstrip("/") + "/" + key.lstrip("/")) \
+            .replace("//", "/")
+
+    def _sig_q(self) -> str:
+        if not self.signatures:
+            return ""
+        return "?signatures=" + ",".join(str(s) for s in self.signatures)
+
+    def create_entry(self, key: str, entry: dict,
+                     data: bytes | None) -> None:
+        path = self._path(key)
+        if entry.get("is_directory"):
+            url = self.proxy.url + urllib.parse.quote(path) + \
+                "?mkdir=true"
+            if self.signatures:
+                url += "&signatures=" + \
+                    ",".join(str(s) for s in self.signatures)
+            urllib.request.urlopen(urllib.request.Request(
+                url, data=b"", method="POST"), timeout=60).read()
+            return
+        mime = entry.get("attributes", {}).get("mime", "")
+        url = self.proxy.url + urllib.parse.quote(path) + self._sig_q()
+        req = urllib.request.Request(url, data=data or b"",
+                                     method="POST")
+        if mime:
+            req.add_header("Content-Type", mime)
+        urllib.request.urlopen(req, timeout=600).read()
+
+    def delete_entry(self, key: str, is_directory: bool) -> None:
+        path = self._path(key)
+        url = self.proxy.url + urllib.parse.quote(path) + \
+            ("?recursive=true" if is_directory else "")
+        if self.signatures:
+            sep = "&" if "?" in url else "?"
+            url += sep + "signatures=" + \
+                ",".join(str(s) for s in self.signatures)
+        req = urllib.request.Request(url, method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=60).read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+class LocalSink(ReplicationSink):
+    """Replicate to a local directory tree (localsink/local_sink.go)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        p = os.path.join(self.dir, key.lstrip("/"))
+        # A replicated key must stay inside the sink root.
+        root = os.path.realpath(self.dir)
+        real = os.path.realpath(p)
+        if not (real + os.sep).startswith(root + os.sep) and real != root:
+            raise ValueError(f"replication key escapes sink root: {key}")
+        return p
+
+    def create_entry(self, key: str, entry: dict,
+                     data: bytes | None) -> None:
+        p = self._path(key)
+        if entry.get("is_directory"):
+            os.makedirs(p, exist_ok=True)
+            return
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data or b"")
+
+    def delete_entry(self, key: str, is_directory: bool) -> None:
+        p = self._path(key)
+        try:
+            if is_directory:
+                import shutil
+                shutil.rmtree(p)
+            else:
+                os.remove(p)
+        except FileNotFoundError:
+            pass
+
+
+class S3Sink(ReplicationSink):
+    """Replicate into an S3-compatible endpoint (s3sink/s3_sink.go) —
+    works against our own S3 gateway (seaweedfs_tpu/s3api)."""
+
+    def __init__(self, endpoint: str, bucket: str, directory: str = "/",
+                 access_key: str = "", secret_key: str = ""):
+        from ..s3api.sigv4 import sign_request
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.dir = directory.strip("/")
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self._sign: Callable = sign_request
+
+    def _url(self, key: str) -> str:
+        k = (self.dir + "/" + key.lstrip("/")).lstrip("/")
+        return f"{self.endpoint}/{self.bucket}/" + \
+            urllib.parse.quote(k)
+
+    def _request(self, url: str, method: str, data: bytes = b"",
+                 content_type: str = "") -> None:
+        headers = {}
+        if content_type:
+            headers["Content-Type"] = content_type
+        if self.access_key:
+            headers = self._sign(method, url, headers, data,
+                                 self.access_key, self.secret_key)
+        req = urllib.request.Request(url, data=data if method != "DELETE"
+                                     else None, method=method,
+                                     headers=headers)
+        try:
+            urllib.request.urlopen(req, timeout=600).read()
+        except urllib.error.HTTPError as e:
+            if not (method == "DELETE" and e.code == 404):
+                raise
+
+    def create_entry(self, key: str, entry: dict,
+                     data: bytes | None) -> None:
+        if entry.get("is_directory"):
+            return  # S3 has no directories
+        mime = entry.get("attributes", {}).get(
+            "mime", "application/octet-stream")
+        self._request(self._url(key), "PUT", data or b"", mime)
+
+    def delete_entry(self, key: str, is_directory: bool) -> None:
+        if is_directory:
+            return
+        self._request(self._url(key), "DELETE")
+
+
+_STUB_SINKS = ("gcs", "azure", "b2")
+
+
+def sink_for_spec(spec: str, **kw) -> ReplicationSink:
+    """'filer://host:port/dir', 'local:///path', 's3://endpoint/bucket'."""
+    scheme, _, rest = spec.partition("://")
+    if scheme == "filer":
+        host, _, d = rest.partition("/")
+        return FilerSink("http://" + host, "/" + d, **kw)
+    if scheme == "local":
+        return LocalSink("/" + rest.lstrip("/"))
+    if scheme == "s3":
+        host, _, rest2 = rest.partition("/")
+        bucket, _, d = rest2.partition("/")
+        return S3Sink("http://" + host, bucket, "/" + d, **kw)
+    if scheme in _STUB_SINKS:
+        raise NotImplementedError(
+            f"{scheme} sink needs a cloud SDK + egress; add it behind "
+            f"ReplicationSink (see weed/replication/sink/{scheme}sink)")
+    raise ValueError(f"unknown sink spec: {spec}")

@@ -1,0 +1,92 @@
+"""filer.sync: continuous active-active synchronization of two filers.
+
+Reference: command/filer_sync.go:81-320 — two independent directions
+(A→B and B→A), each tailing the source filer's meta stream and replaying
+mutations on the target.  Loop prevention: every replayed mutation
+carries the origin chain of filer signatures, and each direction asks
+the source to exclude events already signed by the target
+(`exclude_signature`).  Resume: the per-direction offset checkpoint is
+persisted in the *target* filer's KV keyed by the source's signature
+(filer_sync.go:285-320 getOffset/setOffset), so a restarted syncer
+continues where it left off.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..filer.client import FilerProxy
+from .replicator import Replicator
+from .sink import FilerSink
+
+
+def _offset_key(source_signature: int) -> str:
+    return f"sync.offset.{source_signature:x}"
+
+
+def sync_once(source_url: str, target_url: str,
+              source_dir: str = "/", target_dir: str = "/") -> int:
+    """Drain one direction until caught up; returns events applied."""
+    source = FilerProxy(source_url)
+    target = FilerProxy(target_url)
+    src_sig = source.meta_info()["signature"]
+    tgt_sig = target.meta_info()["signature"]
+    raw = target.kv_get(_offset_key(src_sig))
+    offset = int(raw) if raw else 0
+    sink = FilerSink(target_url, target_dir)
+    repl = Replicator(source_url, source_dir, sink)
+    applied = 0
+    while True:
+        out = source.meta_events(since_ns=offset,
+                                 exclude_signature=tgt_sig,
+                                 prefix=source_dir)
+        for ev in out["events"]:
+            # The replayed mutation carries every signature already on
+            # the event plus the source's — the other direction's
+            # exclude_signature then skips it, breaking the loop.
+            sigs = list(ev.get("signatures", []))
+            if src_sig not in sigs:
+                sigs.append(src_sig)
+            sink.signatures = sigs
+            if repl.replicate(ev):
+                applied += 1
+        new_offset = out["last_ns"]
+        if new_offset <= offset:
+            break
+        offset = new_offset
+        target.kv_put(_offset_key(src_sig), str(offset).encode())
+    return applied
+
+
+class FilerSyncWorker:
+    """Bidirectional continuous sync (the `weed filer.sync` daemon)."""
+
+    def __init__(self, filer_a: str, filer_b: str,
+                 dir_a: str = "/", dir_b: str = "/",
+                 interval: float = 0.5):
+        self.a, self.b = filer_a, filer_b
+        self.dir_a, self.dir_b = dir_a, dir_b
+        self.interval = interval
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def _loop(self, src: str, dst: str, sdir: str, ddir: str) -> None:
+        while not self._stop.is_set():
+            try:
+                sync_once(src, dst, sdir, ddir)
+            except Exception:  # noqa: BLE001 — peer down; retry
+                pass
+            self._stop.wait(self.interval)
+
+    def start(self) -> None:
+        for args in ((self.a, self.b, self.dir_a, self.dir_b),
+                     (self.b, self.a, self.dir_b, self.dir_a)):
+            t = threading.Thread(target=self._loop, args=args,
+                                 daemon=True, name="filer-sync")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
