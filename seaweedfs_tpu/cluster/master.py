@@ -58,7 +58,8 @@ class MasterServer:
                  default_replication: str = "000",
                  pulse_seconds: int = 5,
                  garbage_threshold: float = 0.3,
-                 meta_dir: str | None = None):
+                 meta_dir: str | None = None,
+                 peers: list[str] | None = None):
         seq_path = f"{meta_dir}/seq.dat" if meta_dir else None
         from ..topology.sequence import MemorySequencer
         self.topo = Topology(
@@ -84,6 +85,7 @@ class MasterServer:
         s.route("POST", "/admin/lease", self._admin_lease)
         s.route("POST", "/admin/release", self._admin_release)
         self._grow_lock = threading.Lock()
+        self._hb_apply_lock = threading.Lock()
         # Exclusive admin lock (wdclient/exclusive_locks): one shell at a
         # time may run mutating maintenance commands.
         self._admin_lock = threading.Lock()
@@ -94,15 +96,88 @@ class MasterServer:
         self._stop = threading.Event()
         self._sweeper = threading.Thread(target=self._sweep_loop,
                                          daemon=True, name="master-sweep")
+        # Multi-master HA: a raft node rides on this HTTP server; the
+        # leader owns id issuance, followers proxy mutating requests
+        # (server/raft_server.go, master_server.go:155).
+        self.raft = None
+        self._id_lock = threading.Lock()
+        if peers:
+            from .raft import RaftNode
+            norm = [p if p.startswith("http") else f"http://{p}"
+                    for p in peers]
+            me = self.url()
+            if me not in norm:
+                # A textual alias of this node left in the peer list
+                # would grant phantom self-votes (split brain) and
+                # self-deposing heartbeats — refuse instead of guessing.
+                raise ValueError(
+                    f"-peers must include this master's advertised "
+                    f"address {me} (got {norm}); set -ip/-port to match")
+            self.raft = RaftNode(
+                me, norm, apply_fn=self._raft_apply,
+                state_path=f"{meta_dir}/raft.json" if meta_dir else None)
+            self.raft.mount(self.server)
+            self.topo.next_volume_id_hook = self._next_volume_id_raft
+
+    # -- raft ----------------------------------------------------------------
+
+    def _raft_apply(self, cmd: dict) -> None:
+        if cmd.get("op") == "max_volume_id":
+            self.topo.set_max_volume_id(cmd["value"])
+
+    def _next_volume_id_raft(self) -> int:
+        from .raft import NotLeader
+        with self._id_lock:
+            if not self.raft.is_leader():
+                raise NotLeader(self.raft.leader())
+            # Read-your-own-log fence: a freshly elected leader must
+            # apply inherited entries before computing the next id, or
+            # it could re-issue the previous leader's last volume id.
+            self.raft.barrier()
+            with self.topo._lock:
+                target = max(self.topo._max_volume_id,
+                             self.topo.max_volume_id) + 1
+            self.raft.propose({"op": "max_volume_id", "value": target})
+            return target
+
+    def is_leader(self) -> bool:
+        return self.raft is None or self.raft.is_leader()
+
+    def leader_url(self) -> str:
+        if self.raft is None or self.raft.is_leader():
+            return self.url()
+        return self.raft.leader() or self.url()
+
+    def _proxy_to_leader(self, path: str, query: dict, body: bytes,
+                         method: str = "POST"):
+        """Forward a mutating request to the current leader
+        (master_server.go proxyToLeader)."""
+        leader = self.raft.leader() if self.raft else None
+        if not leader or leader == self.url():
+            raise rpc.RpcError(503, "no leader elected yet; retry")
+        if query.get("proxied"):
+            # Stale mutual leader hints during an election would bounce
+            # the request in a cycle of nested blocking calls.
+            raise rpc.RpcError(503, "no stable leader yet; retry")
+        import urllib.parse
+        fwd = {k: v for k, v in query.items() if not k.startswith("_")}
+        fwd["proxied"] = "1"
+        qs = urllib.parse.urlencode(fwd)
+        url = leader + path + (f"?{qs}" if qs else "")
+        return rpc.call(url, method, body if method != "GET" else None)
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
         self.server.start()
         self._sweeper.start()
+        if self.raft is not None:
+            self.raft.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self.raft is not None:
+            self.raft.stop()
         self.server.stop()
 
     def url(self) -> str:
@@ -111,24 +186,42 @@ class MasterServer:
     # -- handlers -----------------------------------------------------------
 
     def _heartbeat(self, query: dict, body: bytes) -> dict:
+        if not self.is_leader():
+            # Volume servers register with the leader only; hand back the
+            # hint so they redial (volume_grpc_client_to_master.go:60-85).
+            # No self-referential fallback: an unknown leader stays None
+            # so the volume server rotates seeds instead of spinning here.
+            return {"leader": self.raft.leader(), "is_leader": False}
         hb = json.loads(body)
-        dn = self.topo.register_data_node(
-            hb.get("data_center", "DefaultDataCenter"),
-            hb.get("rack", "DefaultRack"),
-            hb["ip"], hb["port"], hb.get("public_url", ""),
-            hb.get("max_volume_count", 7))
-        if "volumes" in hb:  # full sync
-            volumes = [_vinfo_from_dict(v) for v in hb["volumes"]]
-            self.topo.sync_data_node_registration(volumes, dn)
-        else:  # delta
-            self.topo.incremental_sync(
-                [_vinfo_from_dict(v) for v in hb.get("new_volumes", [])],
-                [_vinfo_from_dict(v) for v in hb.get("deleted_volumes", [])],
-                dn)
-        if "ec_shards" in hb:
-            self.topo.sync_data_node_ec_shards(
-                [(e["id"], e.get("collection", ""), e["shard_bits"])
-                 for e in hb["ec_shards"]], dn)
+        # Serialize heartbeat application and drop out-of-order arrivals
+        # (per-node seq): concurrent POSTs from one volume server must
+        # not let a stale full snapshot erase a just-grown volume.
+        with self._hb_apply_lock:
+            dn = self.topo.register_data_node(
+                hb.get("data_center", "DefaultDataCenter"),
+                hb.get("rack", "DefaultRack"),
+                hb["ip"], hb["port"], hb.get("public_url", ""),
+                hb.get("max_volume_count", 7))
+            seq = hb.get("seq")
+            if seq is not None:
+                if seq <= getattr(dn, "last_heartbeat_seq", 0):
+                    return {"volume_size_limit":
+                            self.topo.volume_size_limit}
+                dn.last_heartbeat_seq = seq
+            if "volumes" in hb:  # full sync
+                volumes = [_vinfo_from_dict(v) for v in hb["volumes"]]
+                self.topo.sync_data_node_registration(volumes, dn)
+            else:  # delta
+                self.topo.incremental_sync(
+                    [_vinfo_from_dict(v)
+                     for v in hb.get("new_volumes", [])],
+                    [_vinfo_from_dict(v)
+                     for v in hb.get("deleted_volumes", [])],
+                    dn)
+            if "ec_shards" in hb:
+                self.topo.sync_data_node_ec_shards(
+                    [(e["id"], e.get("collection", ""), e["shard_bits"])
+                     for e in hb["ec_shards"]], dn)
         return {"volume_size_limit": self.topo.volume_size_limit}
 
     def _option_from_query(self, query: dict) -> VolumeGrowOption:
@@ -142,13 +235,21 @@ class MasterServer:
             data_node=query.get("dataNode", ""))
 
     def _assign(self, query: dict, body: bytes) -> dict:
+        if not self.is_leader():
+            return self._proxy_to_leader("/dir/assign", query, body)
+        from .raft import NotLeader
         option = self._option_from_query(query)
         count = int(query.get("count", 1))
         if not self.topo.has_writable_volume(option):
             with self._grow_lock:
                 if not self.topo.has_writable_volume(option):
-                    grown = self.vg.grow_by_type(self.topo, option,
-                                                 self._allocate_volume)
+                    try:
+                        grown = self.vg.grow_by_type(
+                            self.topo, option, self._allocate_volume)
+                    except NotLeader:
+                        # Lost leadership mid-grow; hand the request on.
+                        return self._proxy_to_leader("/dir/assign",
+                                                     query, body)
                     if grown == 0:
                         raise rpc.RpcError(
                             406, "no free volumes and cannot grow")
@@ -176,6 +277,11 @@ class MasterServer:
             compact_revision=0), server)
 
     def _lookup(self, query: dict, body: bytes) -> dict:
+        if not self.is_leader():
+            # Volume state lives on the leader (heartbeats go there);
+            # followers proxy reads too (master_server.go:155).
+            return self._proxy_to_leader("/dir/lookup", query, body,
+                                         "GET")
         vid_str = query.get("volumeId", "")
         if "," in vid_str:
             vid_str = vid_str.split(",")[0]
@@ -195,6 +301,9 @@ class MasterServer:
         raise rpc.RpcError(404, f"volume {vid} not found")
 
     def _status(self, query: dict, body: bytes) -> dict:
+        if not self.is_leader() and self.raft.leader():
+            return self._proxy_to_leader("/dir/status", query, body,
+                                         "GET")
         def node_dict(n):
             out = {"id": n.id, "volumes": n.volume_count,
                    "max": n.max_volume_count, "free": n.free_space(),
@@ -207,6 +316,8 @@ class MasterServer:
                 "max_volume_id": self.topo.max_volume_id}
 
     def _grow(self, query: dict, body: bytes) -> dict:
+        if not self.is_leader():
+            return self._proxy_to_leader("/vol/grow", query, body)
         option = self._option_from_query(query)
         count = int(query.get("count", 0)) or None
         with self._grow_lock:
@@ -234,9 +345,13 @@ class MasterServer:
         return grown
 
     def _col_list(self, query: dict, body: bytes) -> dict:
+        if not self.is_leader():
+            return self._proxy_to_leader("/col/list", query, body, "GET")
         return {"collections": sorted(self.topo.collections)}
 
     def _col_delete(self, query: dict, body: bytes) -> dict:
+        if not self.is_leader():
+            return self._proxy_to_leader("/col/delete", query, body)
         name = query.get("collection", "")
         col = self.topo.collections.get(name)
         if col is None:
@@ -257,12 +372,21 @@ class MasterServer:
         return {"deleted_replicas": deleted}
 
     def _cluster_status(self, query: dict, body: bytes) -> dict:
-        return {"leader": self.url(), "is_leader": True,
-                "volume_size_limit": self.topo.volume_size_limit}
+        out = {"leader": self.leader_url(),
+               "is_leader": self.is_leader(),
+               "volume_size_limit": self.topo.volume_size_limit}
+        if self.raft is not None:
+            out["peers"] = [self.url()] + self.raft.peers
+            out["raft"] = {"state": self.raft.state,
+                           "term": self.raft.current_term,
+                           "commit_index": self.raft.commit_index}
+        return out
 
     def _vol_list(self, query: dict, body: bytes) -> dict:
         """Detailed topology dump (master VolumeList RPC): every node with
         its full per-volume info and EC shard bits — the shell's view."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/vol/list", query, body, "GET")
         dcs = []
         with self.topo._lock:  # heartbeats mutate these dicts concurrently
             for dc in list(self.topo.children.values()):
@@ -287,6 +411,8 @@ class MasterServer:
 
     def _admin_lease(self, query: dict, body: bytes) -> dict:
         """LeaseAdminToken: grant/renew the exclusive maintenance lock."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/admin/lease", query, body)
         req = json.loads(body) if body else {}
         name = req.get("name", "shell")
         prev = req.get("token")
@@ -304,6 +430,8 @@ class MasterServer:
                     "ttl": self._admin_lock_ttl}
 
     def _admin_release(self, query: dict, body: bytes) -> dict:
+        if not self.is_leader():
+            return self._proxy_to_leader("/admin/release", query, body)
         req = json.loads(body) if body else {}
         with self._admin_lock:
             if self._admin_token == req.get("token"):

@@ -1,0 +1,498 @@
+"""Raft consensus for master HA.
+
+Reference: the master embeds a raft node (weed/server/raft_server.go,
+github.com/chrislusf/raft) whose single state-machine command is
+MaxVolumeId (weed/topology/cluster_commands.go) — the leader owns volume
+id assignment, followers proxy mutating requests to the leader
+(master_server.go:155).
+
+This is a from-scratch Raft (election + log replication + persistence),
+not a port: RPCs ride the same JSON/HTTP plane as the rest of the
+cluster (mounted on the master's own server), and the state machine is a
+callback so the master wires MaxVolumeId (or anything else) in.
+
+Scope notes: log compaction/snapshotting is not implemented (the log
+holds tiny id-bump commands; millions of entries fit in memory), and
+membership is static from `-peers` like the reference's default
+deployment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Callable
+
+from . import rpc
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class NotLeader(Exception):
+    def __init__(self, leader: str | None):
+        super().__init__(f"not the leader (leader={leader})")
+        self.leader = leader
+
+
+class RaftNode:
+    """One consensus participant.
+
+    `node_id` / `peers` are base URLs (http://host:port) whose HTTP
+    servers route /raft/* to this node via `mount()`.  `apply_fn(cmd)`
+    is invoked exactly once per committed entry, in log order, on every
+    node.
+    """
+
+    def __init__(self, node_id: str, peers: list[str],
+                 apply_fn: Callable[[dict], None],
+                 state_path: str | None = None,
+                 election_timeout: tuple[float, float] = (0.6, 1.2),
+                 heartbeat_interval: float = 0.15):
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.apply_fn = apply_fn
+        self.state_path = state_path
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+
+        # Persistent state (term, vote, log).
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[dict] = []  # {"term": int, "cmd": dict}
+        self._load_state()
+
+        # Volatile state.
+        self.state = FOLLOWER
+        self.leader_id: str | None = None
+        self.commit_index = 0   # 1-based index of last committed entry
+        self.last_applied = 0
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+
+        self._lock = threading.RLock()
+        self._commit_cv = threading.Condition(self._lock)
+        self._last_heartbeat = time.monotonic()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._wake_events: dict[str, threading.Event] = {}
+
+    # -- persistence ---------------------------------------------------------
+    # Meta (term/vote) is a tiny JSON rewritten on change; the log is an
+    # append-only JSONL journal — appending an entry is O(1), not a
+    # rewrite of history.  Conflict truncation (rare) rewrites the
+    # journal.
+
+    def _log_path(self) -> str | None:
+        return self.state_path + ".log" if self.state_path else None
+
+    def _load_state(self) -> None:
+        if not self.state_path:
+            return
+        embedded = False
+        try:
+            with open(self.state_path) as f:
+                d = json.load(f)
+            self.current_term = d.get("term", 0)
+            self.voted_for = d.get("voted_for")
+            # Migration: early versions embedded the log in the meta file.
+            self.log = d.get("log", [])
+            embedded = bool(self.log)
+        except (OSError, json.JSONDecodeError):
+            pass
+        try:
+            with open(self._log_path()) as f:
+                for line in f:
+                    if line.strip():
+                        self.log.append(json.loads(line))
+        except (OSError, json.JSONDecodeError):
+            pass
+        if embedded:  # move embedded entries into the journal once
+            self._rewrite_log()
+            self._save_meta()
+
+    def _save_meta(self) -> None:
+        if not self.state_path:
+            return
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.current_term,
+                       "voted_for": self.voted_for}, f)
+        os.replace(tmp, self.state_path)
+
+    def _append_log(self, entries: list[dict]) -> None:
+        path = self._log_path()
+        if not path or not entries:
+            return
+        with open(path, "a") as f:
+            for e in entries:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+            f.flush()
+
+    def _rewrite_log(self) -> None:
+        path = self._log_path()
+        if not path:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for e in self.log:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+        os.replace(tmp, path)
+
+    def _save_state(self) -> None:  # kept for vote/term call sites
+        self._save_meta()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def mount(self, server: rpc.JsonHttpServer) -> None:
+        server.route("POST", "/raft/request_vote", self._h_request_vote)
+        server.route("POST", "/raft/append_entries",
+                     self._h_append_entries)
+        server.route("GET", "/raft/status", self._h_status)
+
+    def start(self) -> None:
+        for target, name in ((self._election_loop, "raft-election"),
+                             (self._apply_loop, "raft-apply")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._commit_cv:
+            self._commit_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- log helpers (1-based indices; index 0 = empty sentinel) -------------
+
+    def _last_log_index(self) -> int:
+        return len(self.log)
+
+    def _term_at(self, index: int) -> int:
+        return self.log[index - 1]["term"] if 1 <= index <= len(self.log) \
+            else 0
+
+    # -- RPC handlers --------------------------------------------------------
+
+    def _h_request_vote(self, query: dict, body: bytes) -> dict:
+        req = json.loads(body)
+        with self._lock:
+            if req["term"] > self.current_term:
+                self._become_follower(req["term"], None)
+            granted = False
+            if req["term"] == self.current_term and \
+                    self.voted_for in (None, req["candidate_id"]):
+                # §5.4.1: candidate's log must be at least as up-to-date.
+                my_last_term = self._term_at(self._last_log_index())
+                up_to_date = (
+                    req["last_log_term"] > my_last_term
+                    or (req["last_log_term"] == my_last_term
+                        and req["last_log_index"] >=
+                        self._last_log_index()))
+                if up_to_date:
+                    granted = True
+                    self.voted_for = req["candidate_id"]
+                    self._last_heartbeat = time.monotonic()
+                    self._save_state()
+            return {"term": self.current_term, "vote_granted": granted}
+
+    def _h_append_entries(self, query: dict, body: bytes) -> dict:
+        req = json.loads(body)
+        with self._lock:
+            if req["term"] > self.current_term or \
+                    (req["term"] == self.current_term
+                     and self.state != FOLLOWER):
+                self._become_follower(req["term"], req["leader_id"])
+            if req["term"] < self.current_term:
+                return {"term": self.current_term, "success": False}
+            self.leader_id = req["leader_id"]
+            self._last_heartbeat = time.monotonic()
+            prev_idx = req["prev_log_index"]
+            if prev_idx > self._last_log_index() or \
+                    self._term_at(prev_idx) != req["prev_log_term"]:
+                return {"term": self.current_term, "success": False,
+                        "hint_index": min(prev_idx,
+                                          self._last_log_index())}
+            # Append/overwrite conflicting suffix.
+            entries = req.get("entries", [])
+            idx = prev_idx
+            truncated = False
+            appended: list[dict] = []
+            for e in entries:
+                idx += 1
+                if idx <= self._last_log_index():
+                    if self._term_at(idx) != e["term"]:
+                        del self.log[idx - 1:]
+                        truncated = True
+                        self.log.append(e)
+                        appended.append(e)
+                else:
+                    self.log.append(e)
+                    appended.append(e)
+            if truncated:
+                self._rewrite_log()
+            elif appended:
+                self._append_log(appended)
+            if req["leader_commit"] > self.commit_index:
+                self.commit_index = min(req["leader_commit"],
+                                        self._last_log_index())
+                self._commit_cv.notify_all()
+            return {"term": self.current_term, "success": True,
+                    "match_index": prev_idx + len(entries)}
+
+    def _h_status(self, query: dict, body: bytes) -> dict:
+        with self._lock:
+            return {"id": self.id, "state": self.state,
+                    "term": self.current_term, "leader": self.leader_id,
+                    "commit_index": self.commit_index,
+                    "log_length": len(self.log)}
+
+    # -- state transitions ---------------------------------------------------
+
+    def _become_follower(self, term: int, leader: str | None) -> None:
+        self.current_term = term
+        self.state = FOLLOWER
+        self.voted_for = None
+        if leader is not None:
+            self.leader_id = leader
+        self._save_state()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.id
+        # Barrier no-op (§8): entries inherited from prior terms can't
+        # be count-committed; committing a current-term entry commits
+        # them transitively, so the new leader's state machine catches
+        # up before it serves any read-modify-write (id issuance).
+        entry = {"term": self.current_term, "cmd": {"op": "noop"}}
+        self.log.append(entry)
+        self._append_log([entry])
+        nxt = self._last_log_index() + 1
+        self.next_index = {p: nxt for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        if not self.peers:
+            self.commit_index = self._last_log_index()
+            self._commit_cv.notify_all()
+        # One long-lived replicator per peer for this term; each paces
+        # itself at heartbeat_interval and is woken early by propose().
+        term = self.current_term
+        self._wake_events = {p: threading.Event() for p in self.peers}
+        for peer in self.peers:
+            threading.Thread(target=self._peer_loop, args=(peer, term),
+                             daemon=True,
+                             name=f"raft-repl-{peer}").start()
+
+    def barrier(self, timeout: float = 5.0) -> None:
+        """Wait until this node has applied every entry currently in its
+        log — the leader's read-your-own-writes fence."""
+        with self._lock:
+            target = self._last_log_index()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.last_applied >= target:
+                    return
+                if self.state != LEADER:
+                    raise NotLeader(self.leader_id)
+            time.sleep(0.01)
+        raise TimeoutError(f"barrier at index {target} not reached")
+
+    # -- election ------------------------------------------------------------
+
+    def _election_loop(self) -> None:
+        while not self._stop.is_set():
+            timeout = random.uniform(*self.election_timeout)
+            self._stop.wait(self.heartbeat_interval / 2)
+            with self._lock:
+                if self.state == LEADER:
+                    continue
+                elapsed = time.monotonic() - self._last_heartbeat
+                if elapsed < timeout:
+                    continue
+                # Start an election.
+                self.state = CANDIDATE
+                self.current_term += 1
+                self.voted_for = self.id
+                self._save_state()
+                term = self.current_term
+                last_idx = self._last_log_index()
+                last_term = self._term_at(last_idx)
+                self._last_heartbeat = time.monotonic()
+            if not self.peers:  # single-node cluster
+                with self._lock:
+                    if self.state == CANDIDATE and \
+                            self.current_term == term:
+                        self._become_leader()
+                continue
+            votes = [1]  # self-vote
+            votes_lock = threading.Lock()
+
+            def ask(peer: str) -> None:
+                try:
+                    out = rpc.call_json(
+                        peer + "/raft/request_vote",
+                        payload={"term": term, "candidate_id": self.id,
+                                 "last_log_index": last_idx,
+                                 "last_log_term": last_term},
+                        timeout=0.5)
+                except Exception:  # noqa: BLE001 — unreachable peer
+                    return
+                with self._lock:
+                    if out["term"] > self.current_term:
+                        self._become_follower(out["term"], None)
+                        return
+                if out.get("vote_granted"):
+                    with votes_lock:
+                        votes[0] += 1
+
+            threads = [threading.Thread(target=ask, args=(p,),
+                                        daemon=True) for p in self.peers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=0.6)
+            with self._lock:
+                if self.state == CANDIDATE and \
+                        self.current_term == term and \
+                        votes[0] * 2 > len(self.peers) + 1:
+                    self._become_leader()
+
+    # -- leader replication --------------------------------------------------
+
+    def _peer_loop(self, peer: str, term: int) -> None:
+        """Replicate to one peer until this term's leadership ends: one
+        in-flight AppendEntries at a time, paced at heartbeat_interval,
+        woken early when propose() appends."""
+        ev = self._wake_events.get(peer)
+        while not self._stop.is_set():
+            with self._lock:
+                if self.state != LEADER or self.current_term != term:
+                    return
+            self._replicate_to(peer, term)
+            if ev is not None:
+                ev.wait(self.heartbeat_interval)
+                ev.clear()
+            else:
+                self._stop.wait(self.heartbeat_interval)
+
+    def _replicate_to(self, peer: str, term: int) -> None:
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                return
+            nxt = self.next_index.get(peer, self._last_log_index() + 1)
+            prev_idx = nxt - 1
+            prev_term = self._term_at(prev_idx)
+            entries = self.log[nxt - 1:]
+            commit = self.commit_index
+        try:
+            out = rpc.call_json(
+                peer + "/raft/append_entries",
+                payload={"term": term, "leader_id": self.id,
+                         "prev_log_index": prev_idx,
+                         "prev_log_term": prev_term,
+                         "entries": entries, "leader_commit": commit},
+                timeout=0.5)
+        except Exception:  # noqa: BLE001 — peer down; retried next beat
+            return
+        with self._lock:
+            if out["term"] > self.current_term:
+                self._become_follower(out["term"], None)
+                return
+            if self.state != LEADER or self.current_term != term:
+                return
+            if out.get("success"):
+                self.match_index[peer] = out.get("match_index", prev_idx)
+                self.next_index[peer] = self.match_index[peer] + 1
+            else:
+                # Back off (use follower's hint when present).
+                self.next_index[peer] = max(
+                    1, out.get("hint_index", nxt - 1))
+        self._maybe_advance_commit()
+
+    def _maybe_advance_commit(self) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            for n in range(self._last_log_index(), self.commit_index, -1):
+                # §5.4.2: only commit entries from the current term by
+                # counting; older ones commit transitively.
+                if self._term_at(n) != self.current_term:
+                    break
+                replicas = 1 + sum(
+                    1 for p in self.peers if self.match_index.get(p, 0)
+                    >= n)
+                if replicas * 2 > len(self.peers) + 1:
+                    self.commit_index = n
+                    self._commit_cv.notify_all()
+                    break
+
+    # -- apply ---------------------------------------------------------------
+
+    def _apply_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._commit_cv:
+                while self.last_applied >= self.commit_index and \
+                        not self._stop.is_set():
+                    self._commit_cv.wait(timeout=0.5)
+                if self._stop.is_set():
+                    return
+                start = self.last_applied + 1
+                end = self.commit_index
+                entries = self.log[start - 1:end]
+                self.last_applied = end
+            for e in entries:
+                if e["cmd"].get("op") == "noop":
+                    continue  # leadership barrier, not state
+                try:
+                    self.apply_fn(e["cmd"])
+                except Exception:  # noqa: BLE001 — state machine bug
+                    pass           # must not kill consensus
+
+    # -- client API ----------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def leader(self) -> str | None:
+        with self._lock:
+            return self.leader_id
+
+    def propose(self, cmd: dict, timeout: float = 5.0) -> int:
+        """Append a command, wait for commit; returns its log index.
+        Raises NotLeader on followers (caller proxies to .leader())."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeader(self.leader_id)
+            entry = {"term": self.current_term, "cmd": cmd}
+            self.log.append(entry)
+            self._append_log([entry])
+            index = self._last_log_index()
+        if not self.peers:
+            with self._lock:
+                self.commit_index = max(self.commit_index, index)
+                self._commit_cv.notify_all()
+        else:
+            for ev in self._wake_events.values():
+                ev.set()  # wake the replicators now, not next beat
+        deadline = time.monotonic() + timeout
+        with self._commit_cv:
+            while self.commit_index < index:
+                if self._stop.is_set() or \
+                        time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"entry {index} not committed in {timeout}s")
+                if self.state != LEADER:
+                    raise NotLeader(self.leader_id)
+                self._commit_cv.wait(timeout=0.1)
+        # Wait until locally applied so the caller observes the effect.
+        deadline2 = time.monotonic() + timeout
+        while time.monotonic() < deadline2:
+            with self._lock:
+                if self.last_applied >= index:
+                    return index
+            time.sleep(0.005)
+        return index

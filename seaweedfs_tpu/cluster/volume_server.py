@@ -35,13 +35,21 @@ from . import rpc
 
 
 class VolumeServer:
-    def __init__(self, master_url: str, directories: list[str],
+    def __init__(self, master_url: str | list[str],
+                 directories: list[str],
                  host: str = "127.0.0.1", port: int = 0,
                  max_volume_counts: list[int] | None = None,
                  data_center: str = "DefaultDataCenter",
                  rack: str = "DefaultRack",
                  pulse_seconds: int = 2):
-        self.master_url = master_url
+        # Seed master list; heartbeats follow leader hints and rotate
+        # seeds on failure (volume_grpc_client_to_master.go:60-85).
+        self.masters = list(master_url) if isinstance(master_url, list) \
+            else [master_url]
+        self.master_url = self.masters[0]
+        self._master_idx = 0
+        self._hb_seq = 0
+        self._hb_lock = threading.Lock()
         self.data_center = data_center
         self.rack = rack
         self.pulse_seconds = pulse_seconds
@@ -109,31 +117,70 @@ class VolumeServer:
                         "shard_bits": int(bits)})
         return out
 
-    def _send_heartbeat(self, full: bool = False) -> None:
+    def _send_heartbeat(self, full: bool = False,
+                        _hops: int = 0) -> None:
         from .master import vinfo_to_dict
-        hb: dict = {
-            "ip": self.server.host, "port": self.server.port,
-            "public_url": self.store.public_url,
-            "data_center": self.data_center, "rack": self.rack,
-            "max_volume_count": sum(l.max_volume_count
-                                    for l in self.store.locations),
-            "ec_shards": self._ec_shard_infos(),
-        }
-        if full:
-            hb["volumes"] = [vinfo_to_dict(v) for v in
-                             self.store.collect_heartbeat()["volumes"]]
-        else:
-            new, deleted = self.store.drain_deltas()
-            if not new and not deleted:
-                hb["new_volumes"], hb["deleted_volumes"] = [], []
+        # A master we haven't registered with yet (leader switch / seed
+        # rotation) needs the full picture, not a delta.
+        full = full or getattr(self, "_need_full", False)
+        # Heartbeats are POSTed from two threads (pulse loop + the
+        # post-allocate beat); the sequence number lets the master drop
+        # any snapshot that arrives after a newer one, or a stale full
+        # sync would erase a just-allocated volume from the topology.
+        # Snapshot collection rides under the same lock so seq order
+        # matches content order (the reference gets this for free from
+        # its single bidi heartbeat stream, volume_grpc_client_to_master).
+        with self._hb_lock:
+            self._hb_seq += 1
+            hb: dict = {
+                "ip": self.server.host, "port": self.server.port,
+                "public_url": self.store.public_url,
+                "data_center": self.data_center, "rack": self.rack,
+                "seq": self._hb_seq,
+                "max_volume_count": sum(l.max_volume_count
+                                        for l in self.store.locations),
+                "ec_shards": self._ec_shard_infos(),
+            }
+            if full:
+                hb["volumes"] = [
+                    vinfo_to_dict(v) for v in
+                    self.store.collect_heartbeat()["volumes"]]
             else:
-                hb["new_volumes"] = [vinfo_to_dict(v) for v in new]
-                hb["deleted_volumes"] = [vinfo_to_dict(v) for v in deleted]
+                new, deleted = self.store.drain_deltas()
+                if not new and not deleted:
+                    hb["new_volumes"], hb["deleted_volumes"] = [], []
+                else:
+                    hb["new_volumes"] = [vinfo_to_dict(v) for v in new]
+                    hb["deleted_volumes"] = [vinfo_to_dict(v)
+                                             for v in deleted]
         try:
-            rpc.call(f"{self.master_url}/heartbeat", "POST",
-                     json.dumps(hb).encode())
-        except Exception:  # noqa: BLE001 — master may be down; retry next tick
-            pass
+            out = rpc.call(f"{self.master_url}/heartbeat", "POST",
+                           json.dumps(hb).encode())
+            if isinstance(out, dict) and out.get("is_leader") is False:
+                hint = out.get("leader")
+                self._need_full = True
+                if hint and hint != self.master_url:
+                    # Redial the leader and re-register there.
+                    self.master_url = hint
+                    if _hops < 2:  # election churn: retry next tick
+                        self._send_heartbeat(_hops=_hops + 1)
+                else:
+                    # Leaderless (or self-referential) answer: this
+                    # master may be partitioned from the quorum — try
+                    # the next seed rather than spinning here.
+                    self._rotate_master()
+            elif full:
+                self._need_full = False
+        except Exception:  # noqa: BLE001 — master down: rotate to the
+            # next seed and re-register on the next tick.
+            self._need_full = True
+            self._rotate_master()
+
+    def _rotate_master(self) -> None:
+        if len(self.masters) > 1:
+            self._master_idx = (self._master_idx + 1) % \
+                len(self.masters)
+            self.master_url = self.masters[self._master_idx]
 
     def _heartbeat_loop(self) -> None:
         ticks = 0

@@ -33,13 +33,17 @@ def _wait_forever(servers: list) -> int:
 
 def run_master(flags: Flags, args: list[str]) -> int:
     from ..cluster.master import MasterServer as Master
+    # -peers=host1:9333,host2:9333 turns on raft HA (raft_server.go).
+    peers = [p if p.startswith("http") else f"http://{p}"
+             for p in flags.get("peers", "").split(",") if p]
     m = Master(
         host=flags.get("ip", "127.0.0.1"),
         port=flags.get_int("port", 9333),
         meta_dir=flags.get("mdir") or None,
         volume_size_limit_mb=flags.get_int("volumeSizeLimitMB", 30 * 1024),
         default_replication=flags.get("defaultReplication", "000"),
-        garbage_threshold=flags.get_float("garbageThreshold", 0.3))
+        garbage_threshold=flags.get_float("garbageThreshold", 0.3),
+        peers=peers or None)
     m.start()
     glog.infof("master serving at %s", m.server.url())
     return _wait_forever([m])
@@ -52,7 +56,8 @@ def run_volume(flags: Flags, args: list[str]) -> int:
     if len(maxes) == 1:
         maxes = maxes * len(dirs)
     vs = VolumeServer(
-        master_url=_norm_master(flags.get("mserver", "127.0.0.1:9333")),
+        master_url=[_norm_master(u) for u in
+                    flags.get("mserver", "127.0.0.1:9333").split(",")],
         directories=dirs,
         host=flags.get("ip", "127.0.0.1"),
         port=flags.get_int("port", 8080),

@@ -94,12 +94,25 @@ class Topology(Node):
 
     # -- id issuance ---------------------------------------------------------
 
+    # Set by a clustered master: volume-id issuance goes through raft
+    # (topology/cluster_commands.go MaxVolumeIdCommand) so every master
+    # agrees on the high-water mark.
+    next_volume_id_hook = None
+
     def next_volume_id(self) -> int:
+        if self.next_volume_id_hook is not None:
+            return self.next_volume_id_hook()
         with self._lock:
             self._max_volume_id = max(self._max_volume_id,
                                       self.max_volume_id) + 1
             self.up_adjust_max_volume_id(self._max_volume_id)
             return self._max_volume_id
+
+    def set_max_volume_id(self, vid: int) -> None:
+        """Raft state-machine apply: raise the cluster-wide max."""
+        with self._lock:
+            self._max_volume_id = max(self._max_volume_id, vid)
+            self.up_adjust_max_volume_id(self._max_volume_id)
 
     def next_file_key(self, count: int = 1) -> int:
         return self.sequencer.next_file_id(count)

@@ -1,0 +1,210 @@
+"""Raft consensus + multi-master HA.
+
+Reference behaviors: weed/server/raft_server.go (leader election among
+masters), topology/cluster_commands.go (MaxVolumeId state machine),
+master_server.go:155 (proxy-to-leader), volume server leader-following
+(volume_grpc_client_to_master.go:60-85).
+"""
+
+import json
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.raft import LEADER, NotLeader, RaftNode
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+
+def _mk_raft_cluster(n, tmp_path, apply_sink):
+    servers = [rpc.JsonHttpServer() for _ in range(n)]
+    urls = [s.url() for s in servers]
+    nodes = []
+    for i, s in enumerate(servers):
+        node = RaftNode(
+            urls[i], urls,
+            apply_fn=lambda cmd, i=i: apply_sink[i].append(cmd),
+            state_path=str(tmp_path / f"raft{i}.json"),
+            election_timeout=(0.2, 0.4), heartbeat_interval=0.05)
+        node.mount(s)
+        s.start()
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    return servers, nodes
+
+
+def _wait_leader(nodes, timeout=10.0, exclude=()):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [x for x in nodes
+                   if x.state == LEADER and x not in exclude]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no single leader elected")
+
+
+def test_raft_elects_single_leader_and_replicates(tmp_path):
+    sink = [[], [], []]
+    servers, nodes = _mk_raft_cluster(3, tmp_path, sink)
+    try:
+        leader = _wait_leader(nodes)
+        for i in range(5):
+            leader.propose({"op": "max_volume_id", "value": i + 1})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                not all(len(s) == 5 for s in sink):
+            time.sleep(0.05)
+        assert all([c["value"] for c in s] == [1, 2, 3, 4, 5]
+                   for s in sink), sink
+        # Followers refuse proposals and name the leader.
+        follower = next(x for x in nodes if x is not leader)
+        with pytest.raises(NotLeader) as ei:
+            follower.propose({"op": "x"})
+        assert ei.value.leader == leader.id
+    finally:
+        for x in nodes:
+            x.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_raft_leader_failover_preserves_log(tmp_path):
+    sink = [[], [], []]
+    servers, nodes = _mk_raft_cluster(3, tmp_path, sink)
+    try:
+        leader = _wait_leader(nodes)
+        for i in range(3):
+            leader.propose({"v": i})
+        # Kill the leader (stop its raft loops AND its HTTP server).
+        dead_i = nodes.index(leader)
+        leader.stop()
+        servers[dead_i].stop()
+        survivors = [x for x in nodes if x is not leader]
+        new_leader = _wait_leader(survivors, timeout=15)
+        assert new_leader is not leader
+        # The new leader still has the committed log and extends it.
+        new_leader.propose({"v": 99}, timeout=10)
+        live_sinks = [sink[nodes.index(x)] for x in survivors]
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                not all(len(s) == 4 for s in live_sinks):
+            time.sleep(0.05)
+        for s in live_sinks:
+            assert [c.get("v") for c in s] == [0, 1, 2, 99]
+    finally:
+        for x in nodes:
+            x.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_raft_state_persistence(tmp_path):
+    server = rpc.JsonHttpServer()
+    applied = []
+    node = RaftNode(server.url(), [server.url()], applied.append,
+                    state_path=str(tmp_path / "solo.json"),
+                    election_timeout=(0.1, 0.2), heartbeat_interval=0.05)
+    node.mount(server)
+    server.start()
+    node.start()
+    _wait_leader([node])
+    node.propose({"v": 1})
+    node.propose({"v": 2})
+    node.stop()
+    server.stop()
+    # Restarted node recovers term and log from disk.
+    node2 = RaftNode("http://x", ["http://x"], applied.append,
+                     state_path=str(tmp_path / "solo.json"))
+    assert [e["cmd"]["v"] for e in node2.log
+            if e["cmd"].get("op") != "noop"] == [1, 2]
+    assert node2.current_term >= 1
+
+
+# -- multi-master HA -------------------------------------------------------
+
+
+@pytest.fixture
+def ha_cluster(tmp_path):
+    ports = [rpc.free_port() for _ in range(3)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    masters = []
+    for i, p in enumerate(ports):
+        d = tmp_path / f"m{i}"
+        d.mkdir()
+        m = MasterServer(port=p, volume_size_limit_mb=64,
+                         meta_dir=str(d), peers=urls, pulse_seconds=60)
+        m.raft.election_timeout = (0.2, 0.4)
+        m.raft.heartbeat_interval = 0.05
+        m.start()
+        masters.append(m)
+    vs = VolumeServer(urls, [str(tmp_path / "vs")], pulse_seconds=1)
+    vs.start()
+    yield masters, vs
+    vs.stop()
+    for m in masters:
+        try:
+            m.stop()
+        except Exception:  # noqa: BLE001 — some already stopped in-test
+            pass
+
+
+def _wait_master_leader(masters, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [m for m in masters if m.raft.state == LEADER]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no master leader")
+
+
+def test_master_ha_assign_via_follower(ha_cluster):
+    masters, vs = ha_cluster
+    leader = _wait_master_leader(masters)
+    follower = next(m for m in masters if m is not leader)
+    # Wait until the volume server has registered with the leader.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            not list(leader.topo.leaves()):
+        time.sleep(0.1)
+    assert list(leader.topo.leaves()), "volume server never registered"
+    # Assign through the FOLLOWER: proxied to the leader transparently.
+    out = rpc.call(follower.url() + "/dir/assign?count=1")
+    assert "fid" in out and out["url"]
+    # Cluster status from any node names the same leader.
+    s1 = rpc.call(leader.url() + "/cluster/status")
+    s2 = rpc.call(follower.url() + "/cluster/status")
+    assert s1["leader"] == s2["leader"] == leader.url()
+    assert s1["is_leader"] and not s2["is_leader"]
+
+
+def test_master_ha_volume_id_consensus_across_failover(ha_cluster):
+    masters, vs = ha_cluster
+    leader = _wait_master_leader(masters)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            not list(leader.topo.leaves()):
+        time.sleep(0.1)
+    out1 = rpc.call(leader.url() + "/dir/assign?count=1")
+    vid1 = int(out1["fid"].split(",")[0])
+    # Kill the leader; a survivor takes over with the id high-water mark.
+    leader.stop()
+    survivors = [m for m in masters if m is not leader]
+    new_leader = _wait_master_leader(survivors, timeout=15)
+    # Volume server redials the new leader and re-registers (full beat).
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and \
+            not list(new_leader.topo.leaves()):
+        time.sleep(0.2)
+    assert list(new_leader.topo.leaves()), \
+        "volume server did not follow the new leader"
+    rpc.call(new_leader.url() + "/dir/assign?count=1")
+    # Consensus guarantees no id reuse after failover: the new leader's
+    # high-water mark covers every id the old leader issued, and a
+    # forced grow issues a strictly greater id.
+    assert new_leader.topo._max_volume_id >= vid1
+    grown_vid = new_leader.topo.next_volume_id()
+    assert grown_vid > vid1
