@@ -47,9 +47,37 @@ class VidCache:
 
 
 class WeedClient:
-    def __init__(self, master_url: str):
-        self.master_url = master_url.rstrip("/")
+    """Accepts one master URL or an HA seed list; master calls fail
+    over across seeds like the reference's MasterClient
+    (wdclient/masterclient.go tryAllMasters)."""
+
+    def __init__(self, master_url: str | list[str]):
+        urls = master_url if isinstance(master_url, list) \
+            else [master_url]
+        self.masters = [u.rstrip("/") for u in urls]
+        self._master_idx = 0
         self.cache = VidCache()
+
+    @property
+    def master_url(self) -> str:
+        return self.masters[self._master_idx]
+
+    def _master_call(self, path_qs: str):
+        """Try each master seed once; rotate past dead/leaderless ones
+        so the winner stays current for subsequent calls."""
+        last_err: Exception | None = None
+        for _ in range(len(self.masters)):
+            try:
+                return rpc.call(self.master_url + path_qs)
+            except rpc.RpcError as e:
+                if e.status != 503:  # a real answer, not "no leader"
+                    raise
+                last_err = e
+            except OSError as e:
+                last_err = e
+            self._master_idx = (self._master_idx + 1) % \
+                len(self.masters)
+        raise last_err or rpc.RpcError(503, "no master reachable")
 
     # -- master ops ----------------------------------------------------------
 
@@ -65,13 +93,13 @@ class WeedClient:
             q.append(f"ttl={ttl}")
         if data_center:
             q.append(f"dataCenter={data_center}")
-        return rpc.call(f"{self.master_url}/dir/assign?" + "&".join(q))
+        return self._master_call("/dir/assign?" + "&".join(q))
 
     def lookup(self, vid: int) -> list[dict]:
         cached = self.cache.get(vid)
         if cached is not None:
             return cached
-        resp = rpc.call(f"{self.master_url}/dir/lookup?volumeId={vid}")
+        resp = self._master_call(f"/dir/lookup?volumeId={vid}")
         locs = resp.get("locations", [])
         if locs:
             self.cache.put(vid, locs)

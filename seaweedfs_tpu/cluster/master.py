@@ -60,6 +60,9 @@ class MasterServer:
                  garbage_threshold: float = 0.3,
                  meta_dir: str | None = None,
                  peers: list[str] | None = None):
+        if meta_dir:
+            import os
+            os.makedirs(meta_dir, exist_ok=True)
         seq_path = f"{meta_dir}/seq.dat" if meta_dir else None
         from ..topology.sequence import MemorySequencer
         self.topo = Topology(
@@ -85,7 +88,8 @@ class MasterServer:
         s.route("POST", "/admin/lease", self._admin_lease)
         s.route("POST", "/admin/release", self._admin_release)
         self._grow_lock = threading.Lock()
-        self._hb_apply_lock = threading.Lock()
+        self._hb_apply_lock = threading.Lock()  # guards the lock table
+        self._hb_node_locks: dict[str, threading.Lock] = {}
         # Exclusive admin lock (wdclient/exclusive_locks): one shell at a
         # time may run mutating maintenance commands.
         self._admin_lock = threading.Lock()
@@ -193,10 +197,14 @@ class MasterServer:
             # so the volume server rotates seeds instead of spinning here.
             return {"leader": self.raft.leader(), "is_leader": False}
         hb = json.loads(body)
-        # Serialize heartbeat application and drop out-of-order arrivals
-        # (per-node seq): concurrent POSTs from one volume server must
-        # not let a stale full snapshot erase a just-grown volume.
+        # Per-node serialization + ordering: concurrent POSTs from one
+        # volume server must not let a stale full snapshot erase a
+        # just-grown volume, but nodes must not serialize each other.
+        node_key = f"{hb['ip']}:{hb['port']}"
         with self._hb_apply_lock:
+            node_lock = self._hb_node_locks.setdefault(
+                node_key, threading.Lock())
+        with node_lock:
             dn = self.topo.register_data_node(
                 hb.get("data_center", "DefaultDataCenter"),
                 hb.get("rack", "DefaultRack"),
@@ -204,6 +212,12 @@ class MasterServer:
                 hb.get("max_volume_count", 7))
             seq = hb.get("seq")
             if seq is not None:
+                # The epoch changes when the volume server restarts, so
+                # a fresh process's seq=1 isn't mistaken for stale.
+                epoch = hb.get("seq_epoch", 0)
+                if epoch != getattr(dn, "heartbeat_epoch", None):
+                    dn.heartbeat_epoch = epoch
+                    dn.last_heartbeat_seq = 0
                 if seq <= getattr(dn, "last_heartbeat_seq", 0):
                     return {"volume_size_limit":
                             self.topo.volume_size_limit}

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 import urllib.parse
@@ -49,6 +50,9 @@ class VolumeServer:
         self.master_url = self.masters[0]
         self._master_idx = 0
         self._hb_seq = 0
+        # Process generation: lets the master distinguish a restarted
+        # volume server (seq starts over) from out-of-order arrivals.
+        self._hb_epoch = random.getrandbits(63)
         self._hb_lock = threading.Lock()
         self.data_center = data_center
         self.rack = rack
@@ -136,7 +140,7 @@ class VolumeServer:
                 "ip": self.server.host, "port": self.server.port,
                 "public_url": self.store.public_url,
                 "data_center": self.data_center, "rack": self.rack,
-                "seq": self._hb_seq,
+                "seq": self._hb_seq, "seq_epoch": self._hb_epoch,
                 "max_volume_count": sum(l.max_volume_count
                                         for l in self.store.locations),
                 "ec_shards": self._ec_shard_infos(),

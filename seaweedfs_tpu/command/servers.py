@@ -73,7 +73,8 @@ def run_volume(flags: Flags, args: list[str]) -> int:
 def run_filer(flags: Flags, args: list[str]) -> int:
     from ..filer.server import FilerServer
     fs = FilerServer(
-        master_url=_norm_master(flags.get("master", "127.0.0.1:9333")),
+        master_url=[_norm_master(u) for u in
+                    flags.get("master", "127.0.0.1:9333").split(",")],
         host=flags.get("ip", "127.0.0.1"),
         port=flags.get_int("port", 8888),
         store_path=flags.get("dir") or None,

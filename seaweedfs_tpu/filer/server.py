@@ -28,12 +28,15 @@ from .stream import ChunkedWriter, ChunkStreamer
 
 
 class FilerServer:
-    def __init__(self, master_url: str, host: str = "127.0.0.1",
+    def __init__(self, master_url: str | list[str],
+                 host: str = "127.0.0.1",
                  port: int = 0, store_path: str | None = None,
                  chunk_size: int = 4 * 1024 * 1024,
                  collection: str = "", replication: str | None = None):
-        self.master_url = master_url
+        # Accepts an HA seed list; all master traffic (including the
+        # /dir/* proxies mounts rely on) fails over via WeedClient.
         self.client = WeedClient(master_url)
+        self.master_url = self.client.master_url
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
@@ -46,6 +49,12 @@ class FilerServer:
         s = self.server
         s.route("GET", "/.meta/subscribe", self._meta_subscribe)
         s.route("GET", "/.meta/info", self._meta_info)
+        # Master proxies: mounts and other filer-only clients assign
+        # file ids and resolve volumes through the filer (the filer
+        # gRPC AssignVolume/LookupVolume surface, filer.proto:30-33).
+        s.route("GET", "/dir/assign", self._proxy_assign)
+        s.route("POST", "/dir/assign", self._proxy_assign)
+        s.route("GET", "/dir/lookup", self._proxy_lookup)
         s.prefix_route("GET", "/.kv/", self._kv_get)
         s.prefix_route("PUT", "/.kv/", self._kv_put)
         s.prefix_route("GET", "/", self._get)
@@ -269,6 +278,20 @@ class FilerServer:
     def _meta_info(self, query: dict, body: bytes) -> dict:
         return {"signature": self.filer.signature,
                 "last_ns": self.filer.meta_log.last_ts_ns()}
+
+    def _proxy_assign(self, query: dict, body: bytes):
+        import urllib.parse
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in query.items() if not k.startswith("_")})
+        return self.client._master_call(
+            "/dir/assign" + (f"?{qs}" if qs else ""))
+
+    def _proxy_lookup(self, query: dict, body: bytes):
+        import urllib.parse
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in query.items() if not k.startswith("_")})
+        return self.client._master_call(
+            "/dir/lookup" + (f"?{qs}" if qs else ""))
 
     # -- KV (filer.proto KvGet/KvPut — sync offset checkpoints) -------------
 

@@ -1,0 +1,238 @@
+"""Mount layer: dirty-page intervals, meta cache, WFS, real FUSE mount.
+
+Reference behaviors: weed/filesys/dirty_page_interval.go (interval
+algebra), meta_cache/ (cache + subscription invalidation), wfs.go /
+file.go / dir.go (node ops).  The kernel FUSE test runs only where
+/dev/fuse is usable.
+"""
+
+import errno
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.mount import ContinuousIntervals, WFS
+from seaweedfs_tpu.mount.vfs import FuseError
+
+
+# -- dirty page intervals --------------------------------------------------
+
+def test_intervals_basic_merge():
+    iv = ContinuousIntervals()
+    iv.add(0, b"aaaa")
+    iv.add(4, b"bbbb")
+    assert iv.pop_all() == [(0, b"aaaabbbb")]
+
+
+def test_intervals_overwrite_newest_wins():
+    iv = ContinuousIntervals()
+    iv.add(0, b"aaaaaaaaaa")
+    iv.add(3, b"BBB")
+    assert iv.pop_all() == [(0, b"aaaBBBaaaa")]
+
+
+def test_intervals_split_and_partial_overlap():
+    iv = ContinuousIntervals()
+    iv.add(0, b"xxxx")        # 0-4
+    iv.add(8, b"yyyy")        # 8-12
+    iv.add(2, b"ZZZZZZZZ")    # 2-10 covers the gap + both edges
+    assert iv.pop_all() == [(0, b"xxZZZZZZZZyy")]
+
+
+def test_intervals_read_overlay():
+    iv = ContinuousIntervals()
+    iv.add(5, b"hello")
+    assert iv.read(0, 20) == [(5, b"hello")]
+    assert iv.read(6, 2) == [(6, b"el")]
+    assert iv.read(10, 5) == []
+    assert iv.max_end() == 10
+
+
+def test_intervals_disjoint_stay_separate():
+    iv = ContinuousIntervals()
+    iv.add(0, b"aa")
+    iv.add(10, b"bb")
+    assert iv.pop_all() == [(0, b"aa"), (10, b"bb")]
+
+
+# -- WFS over a live stack -------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.filer.server import FilerServer
+    tmp = tmp_path_factory.mktemp("mount-stack")
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp / "vs")], pulse_seconds=60)
+    vs.start()
+    filer = FilerServer(master.url())
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def wfs(stack):
+    _m, _vs, filer = stack
+    w = WFS(filer.url(), chunk_size=64)  # tiny chunks: force multi-chunk
+    w.start()
+    yield w
+    w.stop()
+
+
+def test_wfs_create_write_read(wfs):
+    fh = wfs.create("/hello.txt")
+    data = b"hello mounted world " * 20  # 400B -> several 64B chunks
+    assert wfs.write(fh, data, 0) == len(data)
+    # Read-your-writes before flush (dirty overlay).
+    assert wfs.read(fh, len(data), 0) == data
+    wfs.release(fh)
+    # Reopen: content came back from the blob store.
+    fh2 = wfs.open("/hello.txt")
+    assert wfs.read(fh2, 4096, 0) == data
+    st = wfs.getattr("/hello.txt")
+    assert st["st_size"] == len(data)
+    wfs.release(fh2)
+
+
+def test_wfs_random_overwrite_and_sparse(wfs):
+    fh = wfs.create("/rw.bin")
+    wfs.write(fh, b"A" * 100, 0)
+    wfs.release(fh)
+    fh = wfs.open("/rw.bin")
+    wfs.write(fh, b"B" * 10, 45)  # overwrite the middle
+    wfs.write(fh, b"C" * 5, 200)  # sparse extension
+    wfs.release(fh)
+    fh = wfs.open("/rw.bin")
+    got = wfs.read(fh, 4096, 0)
+    wfs.release(fh)
+    assert got[:45] == b"A" * 45
+    assert got[45:55] == b"B" * 10
+    assert got[55:100] == b"A" * 45
+    assert got[100:200] == b"\0" * 100  # hole reads as zeros
+    assert got[200:205] == b"C" * 5
+    assert len(got) == 205
+
+
+def test_wfs_truncate(wfs):
+    fh = wfs.create("/trunc.txt")
+    wfs.write(fh, b"0123456789", 0)
+    wfs.release(fh)
+    wfs.truncate("/trunc.txt", 4)
+    fh = wfs.open("/trunc.txt")
+    assert wfs.read(fh, 100, 0) == b"0123"
+    wfs.release(fh)
+    wfs.truncate("/trunc.txt", 8)  # grow with zeros
+    fh = wfs.open("/trunc.txt")
+    assert wfs.read(fh, 100, 0) == b"0123\0\0\0\0"
+    wfs.release(fh)
+
+
+def test_wfs_dirs_and_rename(wfs):
+    wfs.mkdir("/d1")
+    wfs.mkdir("/d1/d2")
+    fh = wfs.create("/d1/d2/f.txt")
+    wfs.write(fh, b"content", 0)
+    wfs.release(fh)
+    assert "d2" in wfs.readdir("/d1")
+    assert wfs.readdir("/d1/d2") == ["f.txt"]
+    with pytest.raises(FuseError) as ei:
+        wfs.rmdir("/d1")
+    assert ei.value.errno == errno.ENOTEMPTY
+    wfs.rename("/d1/d2/f.txt", "/d1/g.txt")
+    assert wfs.readdir("/d1/d2") == []
+    fh = wfs.open("/d1/g.txt")
+    assert wfs.read(fh, 100, 0) == b"content"
+    wfs.release(fh)
+    wfs.rmdir("/d1/d2")
+    with pytest.raises(FuseError):
+        wfs.readdir("/d1/d2")
+
+
+def test_wfs_unlink_and_enoent(wfs):
+    fh = wfs.create("/gone.txt")
+    wfs.release(fh)
+    wfs.unlink("/gone.txt")
+    with pytest.raises(FuseError) as ei:
+        wfs.open("/gone.txt")
+    assert ei.value.errno == errno.ENOENT
+
+
+def test_wfs_symlink_xattr_chmod(wfs):
+    fh = wfs.create("/target.txt")
+    wfs.release(fh)
+    wfs.symlink("/target.txt", "/link")
+    assert wfs.readlink("/link") == "/target.txt"
+    wfs.chmod("/target.txt", 0o600)
+    assert wfs.getattr("/target.txt")["st_mode"] & 0o777 == 0o600
+    wfs.setxattr("/target.txt", "user.tag", b"v1")
+    assert wfs.getxattr("/target.txt", "user.tag") == b"v1"
+    assert wfs.listxattr("/target.txt") == ["user.tag"]
+    wfs.removexattr("/target.txt", "user.tag")
+    with pytest.raises(FuseError):
+        wfs.getxattr("/target.txt", "user.tag")
+
+
+def test_wfs_meta_cache_sees_external_changes(stack, wfs):
+    """A file written through the filer HTTP API (not the mount) shows
+    up via the subscription-fed meta cache."""
+    _m, _vs, filer = stack
+    from seaweedfs_tpu.filer.client import FilerProxy
+    FilerProxy(filer.url()).put("/external.txt", b"outside write")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            fh = wfs.open("/external.txt")
+            break
+        except FuseError:
+            time.sleep(0.1)
+    else:
+        pytest.fail("external file never appeared through meta cache")
+    assert wfs.read(fh, 100, 0) == b"outside write"
+    wfs.release(fh)
+
+
+# -- real kernel mount (gated) ---------------------------------------------
+
+def _fuse_usable():
+    try:
+        return os.access("/dev/fuse", os.R_OK | os.W_OK)
+    except OSError:
+        return False
+
+
+@pytest.mark.skipif(not _fuse_usable(), reason="/dev/fuse not usable")
+def test_real_fuse_mount(stack, tmp_path):
+    from seaweedfs_tpu.mount.fuse_ll import FuseMount
+    _m, _vs, filer = stack
+    mp = tmp_path / "mnt"
+    mp.mkdir()
+    w = WFS(filer.url(), filer_dir="/fusetest", chunk_size=256)
+    fm = FuseMount(w, str(mp))
+    fm.mount_background()
+    try:
+        # Plain POSIX IO through the kernel.
+        p = mp / "kernel.txt"
+        body = b"written through the kernel\n" * 50
+        with open(p, "wb") as f:
+            f.write(body)
+        assert p.read_bytes() == body
+        assert p.stat().st_size == len(body)
+        (mp / "subdir").mkdir()
+        os.rename(p, mp / "subdir" / "moved.txt")
+        assert sorted(os.listdir(mp)) == ["subdir"]
+        assert (mp / "subdir" / "moved.txt").read_bytes() == body
+        # The file exists in the filer namespace under /fusetest.
+        from seaweedfs_tpu.filer.client import FilerProxy
+        meta = FilerProxy(filer.url()).meta("/fusetest/subdir/moved.txt")
+        assert meta is not None
+        os.remove(mp / "subdir" / "moved.txt")
+        os.rmdir(mp / "subdir")
+        assert os.listdir(mp) == []
+    finally:
+        fm.unmount()
