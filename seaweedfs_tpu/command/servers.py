@@ -70,6 +70,18 @@ def run_volume(flags: Flags, args: list[str]) -> int:
     return _wait_forever([vs])
 
 
+def run_msg_broker(flags: Flags, args: list[str]) -> int:
+    from ..messaging.broker import MessageBroker
+    filer = flags.get("filer", "127.0.0.1:8888")
+    mb = MessageBroker(
+        filer if filer.startswith("http") else f"http://{filer}",
+        host=flags.get("ip", "127.0.0.1"),
+        port=flags.get_int("port", 17777))
+    mb.start()
+    glog.infof("message broker serving at %s", mb.url())
+    return _wait_forever([mb])
+
+
 def run_filer(flags: Flags, args: list[str]) -> int:
     from ..filer.server import FilerServer
     fs = FilerServer(
@@ -192,6 +204,8 @@ register(Command("volume",
                  "start a volume server", run_volume))
 register(Command("filer", "filer -port=8888 -master=host:9333",
                  "start a filer server", run_filer))
+register(Command("msg.broker", "msg.broker -port=17777 -filer=host:8888",
+                 "start a pub/sub message broker", run_msg_broker))
 register(Command("s3", "s3 -port=8333 -filer=host:8888",
                  "start an S3-compatible gateway", run_s3))
 register(Command("webdav", "webdav -port=7333 -filer=host:8888",

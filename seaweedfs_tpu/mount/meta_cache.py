@@ -48,6 +48,11 @@ class MetaCache:
                 return self._entries[path]
         entry = self.proxy.meta(path)
         with self._lock:
+            # A subscription event that landed during the fetch is newer
+            # than what we just read — never clobber it with the stale
+            # fetch result.
+            if path in self._entries:
+                return self._entries[path]
             self._entries[path] = entry
         return entry
 

@@ -204,8 +204,12 @@ class WFS:
 
     def readdir(self, path: str) -> list[str]:
         full = self._full(path)
+        if full in ("/", self.root):
+            # The mount root always lists (it may not exist in the filer
+            # yet when -filer.path points at a fresh directory).
+            return [d["name"] for d in self.meta_cache.list_dir(full)]
         e = self.meta_cache.lookup(full)
-        if full != "/" and (e is None or not e.get("is_directory")):
+        if e is None or not e.get("is_directory"):
             raise FuseError(errno.ENOTDIR if e else errno.ENOENT, path)
         return [d["name"] for d in self.meta_cache.list_dir(full)]
 
@@ -232,9 +236,21 @@ class WFS:
         self.meta_cache.upsert(self._full(path), None)
 
     def rename(self, old: str, new: str) -> None:
-        self._entry(old)
-        if self.meta_cache.lookup(self._full(new)) is not None:
-            self.proxy.delete(self._full(new), recursive=True)
+        src = self._entry(old)
+        dst = self.meta_cache.lookup(self._full(new))
+        if dst is not None:
+            # POSIX rename-over-existing rules — never silently destroy
+            # a directory tree.
+            if dst.get("is_directory"):
+                if not src.get("is_directory"):
+                    raise FuseError(errno.EISDIR, new)
+                if self.proxy.list(self._full(new), limit=1):
+                    raise FuseError(errno.ENOTEMPTY, new)
+                self.proxy.delete(self._full(new))
+            elif src.get("is_directory"):
+                raise FuseError(errno.ENOTDIR, new)
+            else:
+                self.proxy.delete(self._full(new))
         self.proxy.rename(self._full(old), self._full(new))
         self.meta_cache.invalidate(self._full(old))
         self.meta_cache.invalidate(self._full(new))

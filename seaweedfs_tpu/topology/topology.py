@@ -153,39 +153,45 @@ class Topology(Node):
         return self.get_or_create_layout(v.collection, rp, ttl)
 
     def register_volume(self, v, dn: DataNode) -> None:
-        self.sequencer.set_max(v.max_file_key)
-        if dn.add_or_update_volume(v):
-            pass
-        self._layout_for(v).register_volume(v, dn)
+        # Tree counters (up_adjust_counts walks shared ancestors) need
+        # the topology lock: heartbeats from different volume servers
+        # apply concurrently and '+=' would lose updates.
+        with self._lock:
+            self.sequencer.set_max(v.max_file_key)
+            dn.add_or_update_volume(v)
+            self._layout_for(v).register_volume(v, dn)
 
     def unregister_volume(self, v, dn: DataNode) -> None:
-        self._layout_for(v).unregister_volume(v, dn)
-        dn.delete_volume(v.id)
+        with self._lock:
+            self._layout_for(v).unregister_volume(v, dn)
+            dn.delete_volume(v.id)
 
     def sync_data_node_registration(self, volumes: list,
                                     dn: DataNode) -> tuple[list, list]:
         """Full-state heartbeat: returns (new, deleted) volume infos."""
-        incoming = {v.id: v for v in volumes}
-        existing = dict(dn.volumes)
-        new, deleted = [], []
-        for vid, v in incoming.items():
-            self.register_volume(v, dn)
-            if vid not in existing:
-                new.append(v)
-        for vid, v in existing.items():
-            if vid not in incoming:
-                self.unregister_volume(v, dn)
-                deleted.append(v)
-        dn.last_seen = time.time()
-        return new, deleted
+        with self._lock:
+            incoming = {v.id: v for v in volumes}
+            existing = dict(dn.volumes)
+            new, deleted = [], []
+            for vid, v in incoming.items():
+                self.register_volume(v, dn)
+                if vid not in existing:
+                    new.append(v)
+            for vid, v in existing.items():
+                if vid not in incoming:
+                    self.unregister_volume(v, dn)
+                    deleted.append(v)
+            dn.last_seen = time.time()
+            return new, deleted
 
     def incremental_sync(self, new_volumes: list, deleted_volumes: list,
                          dn: DataNode) -> None:
-        for v in new_volumes:
-            self.register_volume(v, dn)
-        for v in deleted_volumes:
-            self.unregister_volume(v, dn)
-        dn.last_seen = time.time()
+        with self._lock:
+            for v in new_volumes:
+                self.register_volume(v, dn)
+            for v in deleted_volumes:
+                self.unregister_volume(v, dn)
+            dn.last_seen = time.time()
 
     # -- EC shards -----------------------------------------------------------
 
@@ -233,10 +239,11 @@ class Topology(Node):
     def register_data_node(self, dc: str, rack: str, ip: str, port: int,
                            public_url: str = "",
                            max_volume_count: int = 7) -> DataNode:
-        dc_node = self.get_or_create_data_center(dc)
-        rack_node = dc_node.get_or_create_rack(rack)
-        dn = rack_node.get_or_create_data_node(
-            f"{ip}:{port}", ip, port, public_url, max_volume_count)
+        with self._lock:
+            dc_node = self.get_or_create_data_center(dc)
+            rack_node = dc_node.get_or_create_rack(rack)
+            dn = rack_node.get_or_create_data_node(
+                f"{ip}:{port}", ip, port, public_url, max_volume_count)
         dn.last_seen = time.time()
         return dn
 

@@ -1,0 +1,177 @@
+"""Messaging broker: topics, publish/subscribe, placement, durability.
+
+Reference behaviors: weed/messaging/broker/ (partitioned topic logs on
+filer files, replay-then-tail subscribe, consistent-hash placement,
+redirects), pb/messaging.proto's 6 RPC shapes.
+"""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.messaging import HashRing, MessagingClient
+from seaweedfs_tpu.messaging.broker import MessageBroker
+
+
+# -- hash ring --------------------------------------------------------------
+
+def test_hash_ring_stability():
+    ring = HashRing(["a", "b", "c"])
+    keys = [f"t/{i}" for i in range(200)]
+    before = {k: ring.locate(k) for k in keys}
+    ring.add("d")
+    after = {k: ring.locate(k) for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    # Adding one of four members moves roughly 1/4 of keys, not all.
+    assert 0 < moved < 120
+    # Keys that moved went to the new member.
+    assert all(after[k] == "d" for k in keys if before[k] != after[k])
+    ring.remove("d")
+    assert {k: ring.locate(k) for k in keys} == before
+
+
+# -- broker stack -----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.filer.server import FilerServer
+    tmp = tmp_path_factory.mktemp("msg-stack")
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp / "vs")], pulse_seconds=60)
+    vs.start()
+    filer = FilerServer(master.url())
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture
+def broker(stack):
+    _m, _vs, filer = stack
+    b = MessageBroker(filer.url())
+    b.start()
+    yield b
+    b.stop()
+
+
+def test_configure_publish_fetch_roundtrip(broker):
+    c = MessagingClient(broker.url())
+    cfg = c.configure_topic("chat", "room1", partition_count=2)
+    assert cfg["partition_count"] == 2
+    assert c.topic_config("chat", "room1")["partition_count"] == 2
+    out = c.publish("chat", "room1", b"hello world", key="user-1")
+    p = out["partition"]
+    msgs = c.fetch("chat", "room1", p)["messages"]
+    assert [m["value"] for m in msgs] == [b"hello world"]
+    assert msgs[0]["key"] == "user-1"
+    # Same key -> same partition (ordering per key).
+    out2 = c.publish("chat", "room1", b"second", key="user-1")
+    assert out2["partition"] == p
+    msgs = c.fetch("chat", "room1", p)["messages"]
+    assert [m["value"] for m in msgs] == [b"hello world", b"second"]
+
+
+def test_fetch_since_offset_tailing(broker):
+    c = MessagingClient(broker.url())
+    c.configure_topic("chat", "tail", partition_count=1)
+    ts = []
+    for i in range(5):
+        ts.append(c.publish("chat", "tail", f"m{i}", key="k")["ts_ns"])
+    out = c.fetch("chat", "tail", 0, since_ns=ts[2])
+    assert [m["value"] for m in out["messages"]] == ["m3", "m4"]
+    assert out["last_ns"] == ts[4]
+    # Nothing new: empty page, offset stable.
+    out2 = c.fetch("chat", "tail", 0, since_ns=out["last_ns"])
+    assert out2["messages"] == []
+
+
+def test_messages_survive_broker_restart(stack):
+    """Messages are durable in the filer: a new broker replays them
+    (the filer IS the log)."""
+    _m, _vs, filer = stack
+    b1 = MessageBroker(filer.url())
+    b1.start()
+    c1 = MessagingClient(b1.url())
+    c1.configure_topic("dur", "events", partition_count=1)
+    for i in range(3):
+        c1.publish("dur", "events", f"e{i}", key="k")
+    b1.stop()  # flushes tail segments to the filer
+    b2 = MessageBroker(filer.url())
+    b2.start()
+    try:
+        msgs = MessagingClient(b2.url()).fetch("dur", "events", 0)
+        assert [m["value"] for m in msgs["messages"]] == \
+            ["e0", "e1", "e2"]
+    finally:
+        b2.stop()
+
+
+def test_two_brokers_placement_and_redirect(stack):
+    _m, _vs, filer = stack
+    b1 = MessageBroker(filer.url())
+    b2 = MessageBroker(filer.url())
+    b1.start()
+    b2.start()
+    try:
+        c = MessagingClient(b1.url())
+        c.configure_topic("multi", "t", partition_count=8)
+        # Both brokers agree on placement for every partition.
+        for p in range(8):
+            o1 = b1._owner_of("multi", "t", p)
+            o2 = b2._owner_of("multi", "t", p)
+            assert o1 == o2
+        owners = {b1._owner_of("multi", "t", p) for p in range(8)}
+        assert owners == {b1.url(), b2.url()}  # spread over both
+        # Publishing through the "wrong" broker redirects transparently.
+        for i in range(16):
+            c.publish("multi", "t", f"m{i}", key=f"k{i}")
+        total = 0
+        for p in range(8):
+            total += len(c.fetch("multi", "t", p)["messages"])
+        assert total == 16
+        # find_broker agrees with where messages actually landed.
+        from seaweedfs_tpu.cluster import rpc
+        fb = rpc.call(b2.url() + "/find_broker?namespace=multi&topic=t"
+                      "&partition=3")
+        assert fb["broker"] in (b1.url(), b2.url())
+    finally:
+        b1.stop()
+        b2.stop()
+
+
+def test_streaming_subscribe_tail(broker):
+    c = MessagingClient(broker.url())
+    c.configure_topic("live", "s", partition_count=1)
+    got = []
+    stop = threading.Event()
+    t = threading.Thread(
+        target=lambda: c.subscribe(
+            "live", "s", 0, got.append, poll_interval=0.05,
+            stop_check=stop.is_set),
+        daemon=True)
+    t.start()
+    for i in range(4):
+        c.publish("live", "s", f"ev{i}", key="k")
+        time.sleep(0.05)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(got) < 4:
+        time.sleep(0.05)
+    stop.set()
+    t.join(timeout=3)
+    assert [m["value"] for m in got] == ["ev0", "ev1", "ev2", "ev3"]
+
+
+def test_delete_topic(broker):
+    c = MessagingClient(broker.url())
+    c.configure_topic("gone", "t", partition_count=1)
+    c.publish("gone", "t", "x", key="k")
+    c.delete_topic("gone", "t")
+    from seaweedfs_tpu.cluster import rpc
+    with pytest.raises(rpc.RpcError):
+        c.topic_config("gone", "t")
