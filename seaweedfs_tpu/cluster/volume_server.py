@@ -79,6 +79,7 @@ class VolumeServer:
         s.route("GET", "/admin/ec/shard_file", self._ec_shard_file)
         s.route("POST", "/admin/ec/copy_shard", self._ec_copy_shard)
         s.route("POST", "/admin/ec/to_volume", self._ec_to_volume)
+        s.route("POST", "/query", self._query)
         s.route("GET", "/admin/volume_file", self._volume_file)
         s.route("POST", "/admin/copy_volume", self._copy_volume)
         s.route("POST", "/admin/mount", self._admin_mount)
@@ -606,6 +607,33 @@ class VolumeServer:
         v = self.store.mount_volume(vid)
         self._send_heartbeat(full=True)
         return {"volume": vid, "size": v.dat_size()}
+
+    def _query(self, query: dict, body: bytes):
+        """The volume Query RPC (pb/volume_server.proto:92,
+        server/volume_grpc_query.go): run a SELECT over one stored
+        object's bytes.  Body: {fid, query, input_format, csv_header,
+        csv_delimiter, output_format}."""
+        from ..query import run_query
+        from ..query.sql import SqlError
+        req = json.loads(body)
+        vid, key, cookie = t.parse_file_id(req["fid"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise rpc.RpcError(404, f"volume {vid} not on this server")
+        try:
+            n = self.store.read_needle(vid, key, cookie)
+        except NotFoundError as e:
+            raise rpc.RpcError(404, str(e)) from None
+        try:
+            out = run_query(
+                n.data, req["query"],
+                input_format=req.get("input_format", "json"),
+                csv_header=req.get("csv_header", True),
+                csv_delimiter=req.get("csv_delimiter", ","),
+                output_format=req.get("output_format", "json"))
+        except (SqlError, ValueError) as e:
+            raise rpc.RpcError(400, str(e)) from None
+        return (200, out, {"Content-Type": "application/octet-stream"})
 
     def _volume_file(self, query: dict, body: bytes):
         """Stream a whole .dat/.idx/.vif file — the VolumeCopy/CopyFile RPC

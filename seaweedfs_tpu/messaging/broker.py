@@ -145,6 +145,8 @@ class MessageBroker:
                "partition_count": int(req.get("partition_count", 4))}
         self.filer.put(self._config_path(ns, topic),
                        json.dumps(cfg).encode(), "application/json")
+        with self._lock:
+            self._config_cache.pop((ns, topic), None)
         return cfg
 
     def _load_config(self, ns: str, topic: str) -> dict:

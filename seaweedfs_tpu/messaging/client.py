@@ -60,6 +60,10 @@ class MessagingClient:
                     f"retry after placement settles") from None
             if "redirect" not in out:
                 return out
+            # Pin the partition the redirecting broker chose: keyless
+            # publishes roll a random partition per broker, so without
+            # this the next hop can re-roll and bounce us back.
+            payload["partition"] = out["partition"]
             url = out["redirect"].rstrip("/")
         raise rpc.RpcError(503, "publish redirect loop")
 

@@ -55,6 +55,7 @@ class TopicPartitionLog:
         self._last_flush = time.monotonic()
         self._lock = threading.RLock()
         self._last_ts = 0
+        self._history_scanned = False
 
     # -- write ---------------------------------------------------------------
 
@@ -141,12 +142,14 @@ class TopicPartitionLog:
 
     def last_ts_ns(self) -> int:
         with self._lock:
-            if self._last_ts:
+            if self._last_ts or self._history_scanned:
                 return self._last_ts
         # Cold partition (fresh broker): one full replay, memoized so
-        # subscriber polls don't rescan every segment per request.
+        # subscriber polls don't rescan every segment per request —
+        # the scanned flag also memoizes the empty-partition answer.
         msgs = self.read_since(0, limit=1 << 30)
         last = msgs[-1]["ts_ns"] if msgs else 0
         with self._lock:
+            self._history_scanned = True
             self._last_ts = max(self._last_ts, last)
             return self._last_ts

@@ -179,6 +179,9 @@ class S3ApiServer:
             raise S3Error(405, "MethodNotAllowed", method)
 
         # object level
+        if method == "POST" and "select" in query:
+            auth(ACTION_READ)
+            return self._select_object_content(bucket, key, body)
         if method == "POST" and "uploads" in query:
             auth(ACTION_WRITE)
             return self._initiate_multipart(bucket, key, headers)
@@ -325,6 +328,39 @@ class S3ApiServer:
         _el(root, "LastModified", _iso(time.time()))
         _el(root, "ETag", f'"{etag}"')
         return (200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _select_object_content(self, bucket: str, key: str,
+                               body: bytes):
+        """SelectObjectContent: run a SELECT over one object and stream
+        the result as AWS event-stream frames (volume Query RPC
+        analog at the S3 surface)."""
+        from ..query import run_query
+        from ..query.sql import SqlError
+        from .select import event_stream, parse_select_request
+        try:
+            req = parse_select_request(body)
+        except Exception as e:  # noqa: BLE001 — malformed XML
+            raise S3Error(400, "MalformedXML", str(e)) from None
+        path = self._obj_path(bucket, key)
+        meta = self.filer.meta(path)
+        if meta is None or meta.get("is_directory"):
+            raise S3Error(404, "NoSuchKey", f"{key} not found")
+        with self.filer.get(path) as resp:
+            data = resp.read()
+        try:
+            records = run_query(
+                data, req["expression"],
+                input_format=req["input_format"],
+                csv_header=req["csv_header"],
+                csv_delimiter=req["csv_delimiter"],
+                output_format=req["output_format"])
+        except (SqlError, ValueError) as e:
+            raise S3Error(400, "InvalidTextEncoding"
+                          if "format" in str(e) else
+                          "InvalidExpression", str(e)) from None
+        payload = event_stream(records, len(data), len(records))
+        return (200, payload,
+                {"Content-Type": "application/octet-stream"})
 
     def _get_object(self, bucket: str, key: str, headers: dict,
                     head: bool = False):
