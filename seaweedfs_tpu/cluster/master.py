@@ -87,6 +87,21 @@ class MasterServer:
         s.route("GET", "/vol/list", self._vol_list)
         s.route("POST", "/admin/lease", self._admin_lease)
         s.route("POST", "/admin/release", self._admin_release)
+        reg = s.enable_metrics("master")
+        reg.gauge("SeaweedFS_master_volume_count",
+                  "registered volume replicas cluster-wide",
+                  callback=lambda: float(self.topo.volume_count))
+        reg.gauge("SeaweedFS_master_ec_shard_count",
+                  "registered EC shards cluster-wide",
+                  callback=lambda: float(self.topo.ec_shard_count))
+        reg.gauge("SeaweedFS_master_data_node_count",
+                  "live data nodes",
+                  callback=lambda: float(len(list(self.topo.leaves()))))
+        reg.gauge("SeaweedFS_master_max_volume_id",
+                  "volume id high-water mark",
+                  callback=lambda: float(self.topo.max_volume_id))
+        reg.gauge("SeaweedFS_master_is_leader", "1 on the raft leader",
+                  callback=lambda: 1.0 if self.is_leader() else 0.0)
         self._grow_lock = threading.Lock()
         self._hb_apply_lock = threading.Lock()  # guards the lock table
         self._hb_node_locks: dict[str, threading.Lock] = {}

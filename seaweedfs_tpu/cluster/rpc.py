@@ -15,6 +15,7 @@ import json
 import os
 import socket
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -52,6 +53,35 @@ class JsonHttpServer:
         self.prefix_routes: list[tuple[str, str, Callable]] = []
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        self.metrics = None  # (Registry, Counter, Histogram) when on
+        self._metrics_route = False
+
+    def serve_metrics_route(self, registry) -> None:
+        """Route GET /metrics -> the registry's text exposition."""
+        self._metrics_route = True
+        self.route("GET", "/metrics", lambda q, b: (
+            200, registry.expose().encode(),
+            {"Content-Type": "text/plain; version=0.0.4"}))
+
+    def enable_metrics(self, subsystem: str, registry=None,
+                       serve_route: bool = True):
+        """Record per-request count + latency (stats/metrics.go request
+        vectors) and, unless serve_route=False (gateways whose URL
+        namespace is user-controlled serve /metrics on a separate
+        port, like the reference's metricsHttpPort), expose /metrics.
+        Returns the Registry for the caller to add its own gauges."""
+        from ..stats.metrics import Registry
+        reg = registry or Registry()
+        counter = reg.counter(
+            f"SeaweedFS_{subsystem}_request_total",
+            f"{subsystem} request count", ("type",))
+        hist = reg.histogram(
+            f"SeaweedFS_{subsystem}_request_seconds",
+            f"{subsystem} request latency", ("type",))
+        self.metrics = (reg, counter, hist)
+        if serve_route:
+            self.serve_metrics_route(reg)
+        return reg
 
     def route(self, method: str, path: str, fn: Callable) -> None:
         self.routes[(method, path)] = fn
@@ -107,6 +137,8 @@ class JsonHttpServer:
                     self._send(404, {"error": f"no route {method} "
                                               f"{parsed.path}"})
                     return
+                metrics = server.metrics
+                t0 = time.perf_counter() if metrics else 0.0
                 try:
                     result = fn(*args)
                 except RpcError as e:
@@ -115,6 +147,15 @@ class JsonHttpServer:
                 except Exception as e:  # noqa: BLE001
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
                     return
+                finally:
+                    # Exclude /metrics only where it IS the scrape
+                    # endpoint; on gateways it's a user path to count.
+                    if metrics and not (server._metrics_route
+                                        and parsed.path == "/metrics"):
+                        _reg, counter, hist = metrics
+                        counter.inc(type=method)
+                        hist.observe(time.perf_counter() - t0,
+                                     type=method)
                 extra = None
                 if isinstance(result, tuple):
                     if len(result) == 3:

@@ -80,6 +80,7 @@ class VolumeServer:
         s.route("POST", "/admin/ec/copy_shard", self._ec_copy_shard)
         s.route("POST", "/admin/ec/to_volume", self._ec_to_volume)
         s.route("POST", "/query", self._query)
+        self._setup_metrics()
         s.route("GET", "/admin/volume_file", self._volume_file)
         s.route("POST", "/admin/copy_volume", self._copy_volume)
         s.route("POST", "/admin/mount", self._admin_mount)
@@ -109,6 +110,50 @@ class VolumeServer:
 
     def url(self) -> str:
         return f"{self.server.host}:{self.server.port}"
+
+    # -- metrics (stats/metrics.go volume-server vectors) --------------------
+
+    def _setup_metrics(self) -> None:
+        from ..stats.sysstats import disk_status, memory_status
+        reg = self.server.enable_metrics("volumeServer")
+
+        def _iter_volumes():
+            for loc in self.store.locations:
+                yield from list(loc.volumes.values())
+
+        def volumes_by_collection() -> dict:
+            out: dict[tuple, float] = {}
+            for v in _iter_volumes():
+                k = (v.collection or "default", "volume")
+                out[k] = out.get(k, 0) + 1
+            if self.ec_volumes:
+                out[("default", "ec_shard_volume")] = \
+                    float(len(self.ec_volumes))
+            return out
+
+        def disk_sizes() -> dict:
+            out: dict[tuple, float] = {}
+            for v in _iter_volumes():
+                k = (v.collection or "default", "normal")
+                out[k] = out.get(k, 0) + v.content_size()
+            return out
+
+        reg.gauge("SeaweedFS_volumeServer_volumes",
+                  "volumes managed by this server",
+                  ("collection", "type"), callback=volumes_by_collection)
+        reg.gauge("SeaweedFS_volumeServer_max_volumes",
+                  "maximum volume slots",
+                  callback=lambda: float(sum(
+                      l.max_volume_count for l in self.store.locations)))
+        reg.gauge("SeaweedFS_volumeServer_total_disk_size",
+                  "stored bytes by collection",
+                  ("collection", "type"), callback=disk_sizes)
+        reg.gauge("SeaweedFS_disk_free_bytes", "free disk bytes",
+                  ("dir",), callback=lambda: {
+                      (l.directory,): disk_status(l.directory)["free"]
+                      for l in self.store.locations})
+        reg.gauge("SeaweedFS_memory_rss_bytes", "resident set size",
+                  callback=lambda: float(memory_status()["rss"]))
 
     # -- heartbeats ---------------------------------------------------------
 

@@ -87,6 +87,7 @@ def register(cmd: Command) -> None:
 
 def _load_all() -> None:
     # Import for registration side effects.
+    from . import benchmark_cmd  # noqa: F401
     from . import client_cmds  # noqa: F401
     from . import mount_cmd  # noqa: F401
     from . import offline_cmds  # noqa: F401

@@ -1,0 +1,136 @@
+"""weed benchmark: write/read load generator with latency stats.
+
+Reference: weed/command/benchmark.go:26-141 (write then random read
+via assign+upload against a live master, concurrency workers,
+latency percentiles printed by printStats :434, synthetic payloads
+:523).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from . import Command, Flags, register
+
+
+class _Stats:
+    def __init__(self) -> None:
+        self.latencies_ms: list[float] = []
+        self.bytes = 0
+        self.errors = 0
+        self.lock = threading.Lock()
+
+    def add(self, seconds: float, nbytes: int) -> None:
+        with self.lock:
+            self.latencies_ms.append(seconds * 1000.0)
+            self.bytes += nbytes
+
+    def error(self) -> None:
+        with self.lock:
+            self.errors += 1
+
+    def report(self, title: str, wall: float) -> dict:
+        lat = sorted(self.latencies_ms)
+        n = len(lat)
+
+        def pct(p: float) -> float:
+            return lat[min(n - 1, int(n * p))] if n else 0.0
+        out = {
+            "title": title, "requests": n, "errors": self.errors,
+            "seconds": round(wall, 3),
+            "req_per_sec": round(n / wall, 2) if wall else 0.0,
+            "mb_per_sec": round(self.bytes / wall / 1e6, 2)
+            if wall else 0.0,
+            "latency_ms": {
+                "avg": round(sum(lat) / n, 2) if n else 0.0,
+                "p50": round(pct(0.50), 2), "p90": round(pct(0.90), 2),
+                "p99": round(pct(0.99), 2),
+                "max": round(lat[-1], 2) if n else 0.0,
+            },
+        }
+        print(f"\n--- {title} ---")
+        print(f"requests      {n}  (errors {self.errors})")
+        print(f"time          {out['seconds']} s")
+        print(f"throughput    {out['req_per_sec']} req/s, "
+              f"{out['mb_per_sec']} MB/s")
+        lm = out["latency_ms"]
+        print(f"latency ms    avg {lm['avg']}  p50 {lm['p50']}  "
+              f"p90 {lm['p90']}  p99 {lm['p99']}  max {lm['max']}")
+        return out
+
+
+def run_benchmark(flags: Flags, args: list[str]) -> int:
+    from ..cluster.client import WeedClient
+    master = flags.get("master", "127.0.0.1:9333")
+    master = master if master.startswith("http") else f"http://{master}"
+    n = flags.get_int("n", 1024)
+    size = flags.get_int("size", 1024)
+    concurrency = flags.get_int("c", 16)
+    do_write = flags.get("write", "true").lower() != "false"
+    do_read = flags.get("read", "true").lower() != "false"
+    collection = flags.get("collection", "")
+    client = WeedClient(master)
+    payload = random.Random(7).randbytes(size)
+    fids: list[str] = []
+    fid_lock = threading.Lock()
+
+    def worker_write(count: int, stats: _Stats) -> None:
+        for _ in range(count):
+            t0 = time.perf_counter()
+            try:
+                fid = client.upload_data(payload,
+                                         collection=collection)
+            except Exception:  # noqa: BLE001 — count, keep loading
+                stats.error()
+                continue
+            stats.add(time.perf_counter() - t0, size)
+            with fid_lock:
+                fids.append(fid)
+
+    def worker_read(count: int, stats: _Stats,
+                    local_rng: random.Random) -> None:
+        for _ in range(count):
+            with fid_lock:
+                fid = local_rng.choice(fids)
+            t0 = time.perf_counter()
+            try:
+                data = client.download(fid)
+            except Exception:  # noqa: BLE001
+                stats.error()
+                continue
+            stats.add(time.perf_counter() - t0, len(data))
+
+    def run_phase(fn, title: str, extra_args=()) -> None:
+        stats = _Stats()
+        per = n // concurrency
+        counts = [per + (1 if i < n % concurrency else 0)
+                  for i in range(concurrency)]
+        threads = [threading.Thread(
+            target=fn, args=(c, stats, *extra_args), daemon=True)
+            for c in counts if c]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats.report(title, time.perf_counter() - t0)
+
+    print(f"benchmarking {master}: n={n} size={size}B "
+          f"concurrency={concurrency}")
+    if do_write:
+        run_phase(lambda c, s: worker_write(c, s), "write")
+    if do_read:
+        if not fids:
+            print("nothing to read (write phase skipped/failed)")
+            return 1
+        run_phase(lambda c, s: worker_read(c, s, random.Random()),
+                  "random read")
+    return 0
+
+
+register(Command(
+    "benchmark",
+    "benchmark -master=host:9333 -n=1024 -size=1024 -c=16",
+    "write/read load test against a cluster", run_benchmark))

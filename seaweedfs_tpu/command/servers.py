@@ -91,7 +91,8 @@ def run_filer(flags: Flags, args: list[str]) -> int:
         port=flags.get_int("port", 8888),
         store_path=flags.get("dir") or None,
         collection=flags.get("collection", ""),
-        replication=flags.get("defaultReplicaPlacement") or None)
+        replication=flags.get("defaultReplicaPlacement") or None,
+        metrics_port=flags.get_int("metricsPort", 0) or None)
     fs.start()
     glog.infof("filer serving at %s", fs.server.url())
     return _wait_forever([fs])
@@ -124,7 +125,8 @@ def run_s3(flags: Flags, args: list[str]) -> int:
         filer_url=_norm_master(flags.get("filer", "127.0.0.1:8888")),
         host=flags.get("ip", "127.0.0.1"),
         port=flags.get_int("port", 8333),
-        identities=_s3_identities(flags.get("config")))
+        identities=_s3_identities(flags.get("config")),
+        metrics_port=flags.get_int("metricsPort", 0) or None)
     s3.start()
     glog.infof("s3 gateway serving at %s", s3.server.url())
     return _wait_forever([s3])
@@ -135,7 +137,8 @@ def run_webdav(flags: Flags, args: list[str]) -> int:
     dav = WebDavServer(
         filer_url=_norm_master(flags.get("filer", "127.0.0.1:8888")),
         host=flags.get("ip", "127.0.0.1"),
-        port=flags.get_int("port", 7333))
+        port=flags.get_int("port", 7333),
+        metrics_port=flags.get_int("metricsPort", 0) or None)
     dav.start()
     glog.infof("webdav serving at %s", dav.server.url())
     return _wait_forever([dav])

@@ -32,7 +32,8 @@ class FilerServer:
                  host: str = "127.0.0.1",
                  port: int = 0, store_path: str | None = None,
                  chunk_size: int = 4 * 1024 * 1024,
-                 collection: str = "", replication: str | None = None):
+                 collection: str = "", replication: str | None = None,
+                 metrics_port: int | None = None):
         # Accepts an HA seed list; all master traffic (including the
         # /dir/* proxies mounts rely on) fails over via WeedClient.
         self.client = WeedClient(master_url)
@@ -57,6 +58,15 @@ class FilerServer:
         s.route("GET", "/dir/lookup", self._proxy_lookup)
         s.prefix_route("GET", "/.kv/", self._kv_get)
         s.prefix_route("PUT", "/.kv/", self._kv_put)
+        # The filer's / namespace is user paths; /metrics rides its own
+        # port like the other gateways (the reference's -metricsPort).
+        self.metrics_registry = s.enable_metrics(
+            "filer", serve_route=False)
+        self.metrics_server = None
+        if metrics_port is not None:
+            self.metrics_server = rpc.JsonHttpServer(host, metrics_port)
+            self.metrics_server.serve_metrics_route(
+                self.metrics_registry)
         s.prefix_route("GET", "/", self._get)
         s.prefix_route("HEAD", "/", self._head)
         s.prefix_route("POST", "/", self._post)
@@ -67,8 +77,12 @@ class FilerServer:
 
     def start(self) -> None:
         self.server.start()
+        if self.metrics_server is not None:
+            self.metrics_server.start()
 
     def stop(self) -> None:
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         self.server.stop()
         self.filer.close()
 

@@ -102,12 +102,22 @@ class S3Error(Exception):
 class S3ApiServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
                  port: int = 0,
-                 identities: list[Identity] | None = None):
+                 identities: list[Identity] | None = None,
+                 metrics_port: int | None = None):
         self.filer = FilerProxy(filer_url)
         self.iam = IdentityAccessManagement(identities)
         self.server = rpc.JsonHttpServer(host, port, pass_headers=True)
         for method in ("GET", "HEAD", "PUT", "POST", "DELETE"):
             self.server.prefix_route(method, "/", self._route)
+        # Bucket names own the URL namespace, so /metrics lives on its
+        # own port (the reference's -metricsPort behaves the same).
+        self.metrics_registry = self.server.enable_metrics(
+            "s3", serve_route=False)
+        self.metrics_server = None
+        if metrics_port is not None:
+            self.metrics_server = rpc.JsonHttpServer(host, metrics_port)
+            self.metrics_server.serve_metrics_route(
+                self.metrics_registry)
         try:
             self.filer.mkdir(BUCKETS_PATH)
         except Exception:  # noqa: BLE001 — filer may not be up yet
@@ -115,8 +125,12 @@ class S3ApiServer:
 
     def start(self) -> None:
         self.server.start()
+        if self.metrics_server is not None:
+            self.metrics_server.start()
 
     def stop(self) -> None:
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         self.server.stop()
 
     def url(self) -> str:

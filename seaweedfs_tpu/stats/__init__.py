@@ -1,0 +1,10 @@
+"""Metrics & system stats (reference: weed/stats/).
+
+metrics.py is a from-scratch Prometheus client (counters, gauges,
+histograms, text exposition, push-gateway loop — stats/metrics.go);
+sysstats.py reads disk/memory figures (stats/disk.go, memory.go).
+"""
+
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsPusher, Registry, global_registry)
+from .sysstats import disk_status, memory_status  # noqa: F401

@@ -1,0 +1,281 @@
+"""Prometheus-style metrics: counters, gauges, histograms + exposition.
+
+Reference: weed/stats/metrics.go:21-118 — request counters and latency
+histograms for filer/volume/S3, volume-count and disk-size gauges, and
+LoopPushingMetric (:140) which POSTs to a push gateway whose address is
+distributed from master configuration.  No prometheus_client package in
+the image, so the exposition format is emitted directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from typing import Callable
+
+DEFAULT_BUCKETS = (0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                   0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_num(v: float) -> str:
+    """Full-precision exposition: %g would truncate counters past 1e6
+    (a stuck-looking counter) and byte gauges past ~6 digits."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 2**63:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, help_: str,
+                 label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict[str, str]) -> tuple:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.label_names)}")
+        return tuple(str(labels[k]) for k in self.label_names)
+
+    def _labels_of(self, key: tuple) -> dict[str, str]:
+        return dict(zip(self.label_names, key))
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: tuple[str, ...] = ()):
+        super().__init__(name, help_, label_names)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, v in items:
+            out.append(f"{self.name}"
+                       f"{_fmt_labels(self._labels_of(key))} "
+                       f"{_fmt_num(v)}")
+        return out
+
+
+class Gauge(_Metric):
+    """A settable value, or a callback sampled at scrape time (the
+    reference computes volume counts/disk sizes on collect)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: tuple[str, ...] = (),
+                 callback: Callable[[], float | dict] | None = None):
+        super().__init__(name, help_, label_names)
+        self._values: dict[tuple, float] = {}
+        self.callback = callback
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        if self.callback is not None:
+            sampled = self.callback()
+            if isinstance(sampled, dict):
+                # {labels-tuple-or-dict: value}
+                for labels, v in sorted(
+                        sampled.items(), key=lambda kv: str(kv[0])):
+                    if isinstance(labels, tuple):
+                        labels = dict(zip(self.label_names, labels))
+                    out.append(f"{self.name}{_fmt_labels(labels)} "
+                               f"{_fmt_num(v)}")
+            else:
+                out.append(f"{self.name} {_fmt_num(sampled)}")
+            return out
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, v in items:
+            out.append(f"{self.name}"
+                       f"{_fmt_labels(self._labels_of(key))} "
+                       f"{_fmt_num(v)}")
+        return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: tuple[str, ...] = (),
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(
+                k, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def time(self, **labels):
+        """Context manager: observe elapsed seconds."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0, **labels)
+        return _Timer()
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            keys = sorted(self._counts)
+            counts = {k: list(v) for k, v in self._counts.items()}
+            sums = dict(self._sums)
+            totals = dict(self._totals)
+        for key in keys:
+            labels = self._labels_of(key)
+            for i, b in enumerate(self.buckets):
+                lb = dict(labels)
+                lb["le"] = f"{b:g}"
+                out.append(f"{self.name}_bucket{_fmt_labels(lb)} "
+                           f"{counts[key][i]}")
+            lb = dict(labels)
+            lb["le"] = "+Inf"
+            out.append(f"{self.name}_bucket{_fmt_labels(lb)} "
+                       f"{totals[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} "
+                       f"{_fmt_num(sums[key])}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} "
+                       f"{totals[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_: str,
+                label_names: tuple[str, ...] = ()) -> Counter:
+        return self.register(Counter(name, help_, label_names))
+
+    def gauge(self, name: str, help_: str,
+              label_names: tuple[str, ...] = (),
+              callback=None) -> Gauge:
+        return self.register(Gauge(name, help_, label_names, callback))
+
+    def histogram(self, name: str, help_: str,
+                  label_names: tuple[str, ...] = (),
+                  buckets: tuple[float, ...] = DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self.register(Histogram(name, help_, label_names,
+                                       buckets))
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            try:
+                lines.extend(m.expose())
+            except Exception:  # noqa: BLE001 — one broken callback
+                continue       # must not kill the whole scrape
+        return "\n".join(lines) + "\n"
+
+
+global_registry = Registry()
+
+
+class MetricsPusher:
+    """LoopPushingMetric (stats/metrics.go:140): periodically POST the
+    exposition text to a push gateway."""
+
+    def __init__(self, registry: Registry, gateway_url: str, job: str,
+                 instance: str, interval_seconds: float = 15.0):
+        self.registry = registry
+        self.url = (f"{gateway_url.rstrip('/')}/metrics/job/{job}"
+                    f"/instance/{instance}")
+        self.interval = interval_seconds
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="metrics-push")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def push_once(self) -> None:
+        body = self.registry.expose().encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "text/plain"})
+        urllib.request.urlopen(req, timeout=10).read()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.push_once()
+            except Exception:  # noqa: BLE001 — gateway down; retry
+                pass

@@ -1,0 +1,33 @@
+"""Disk and memory statistics (reference: weed/stats/disk.go, memory.go).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def disk_status(path: str) -> dict:
+    """Filesystem usage for the volume holding `path`
+    (disk.go fillInDiskStatus via syscall.Statfs)."""
+    st = os.statvfs(path)
+    total = st.f_blocks * st.f_frsize
+    free = st.f_bavail * st.f_frsize
+    used = total - st.f_bfree * st.f_frsize
+    return {"dir": path, "all": total, "used": used, "free": free,
+            "percent_free": (free / total * 100.0) if total else 0.0,
+            "percent_used": (used / total * 100.0) if total else 0.0}
+
+
+def memory_status() -> dict:
+    """Process memory from /proc/self/status (memory.go)."""
+    out = {"rss": 0, "vms": 0}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["rss"] = int(line.split()[1]) * 1024
+                elif line.startswith("VmSize:"):
+                    out["vms"] = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return out

@@ -39,7 +39,8 @@ def _http_date(ts: float) -> str:
 
 class WebDavServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
-                 port: int = 0, root: str = "/"):
+                 port: int = 0, root: str = "/",
+                 metrics_port: int | None = None):
         self.filer = FilerProxy(filer_url)
         self.root = "/" + root.strip("/") if root.strip("/") else ""
         self.server = rpc.JsonHttpServer(host, port, pass_headers=True)
@@ -50,13 +51,26 @@ class WebDavServer:
         # token -> path of advisory locks (memLS equivalent)
         self._locks: dict[str, tuple[str, float]] = {}  # token -> (path, expiry)
         self._locks_mu = threading.Lock()
+        # WebDAV paths own the URL namespace; /metrics rides its own
+        # port like the other gateways.
+        self.metrics_registry = self.server.enable_metrics(
+            "webdav", serve_route=False)
+        self.metrics_server = None
+        if metrics_port is not None:
+            self.metrics_server = rpc.JsonHttpServer(host, metrics_port)
+            self.metrics_server.serve_metrics_route(
+                self.metrics_registry)
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
         self.server.start()
+        if self.metrics_server is not None:
+            self.metrics_server.start()
 
     def stop(self) -> None:
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         self.server.stop()
 
     def url(self) -> str:

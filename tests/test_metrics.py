@@ -1,0 +1,142 @@
+"""Metrics: Prometheus exposition, request instrumentation, gauges,
+push loop, sys stats.
+
+Reference behaviors: weed/stats/metrics.go (request vectors, volume
+gauges, LoopPushingMetric), disk.go, memory.go.
+"""
+
+import threading
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.stats import (MetricsPusher, Registry, disk_status,
+                                 memory_status)
+
+
+# -- primitives ------------------------------------------------------------
+
+def test_counter_exposition():
+    reg = Registry()
+    c = reg.counter("test_total", "a counter", ("op",))
+    c.inc(op="read")
+    c.inc(2, op="write")
+    text = reg.expose()
+    assert "# TYPE test_total counter" in text
+    assert 'test_total{op="read"} 1' in text
+    assert 'test_total{op="write"} 2' in text
+
+
+def test_gauge_set_and_callback():
+    reg = Registry()
+    g = reg.gauge("depth", "queue depth")
+    g.set(7)
+    assert "depth 7" in reg.expose()
+    reg2 = Registry()
+    reg2.gauge("cb", "sampled", ("kind",),
+               callback=lambda: {("a",): 1.5, ("b",): 2.0})
+    text = reg2.expose()
+    assert 'cb{kind="a"} 1.5' in text and 'cb{kind="b"} 2' in text
+
+
+def test_histogram_buckets_and_sum():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "latency", ("op",),
+                      buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v, op="get")
+    text = reg.expose()
+    assert 'lat_seconds_bucket{le="0.01",op="get"} 1' in text
+    assert 'lat_seconds_bucket{le="0.1",op="get"} 2' in text
+    assert 'lat_seconds_bucket{le="1",op="get"} 3' in text
+    assert 'lat_seconds_bucket{le="+Inf",op="get"} 4' in text
+    assert 'lat_seconds_count{op="get"} 4' in text
+    assert 'lat_seconds_sum{op="get"} 5.555' in text
+
+
+def test_broken_callback_does_not_kill_scrape():
+    reg = Registry()
+    reg.gauge("bad", "boom", callback=lambda: 1 / 0)
+    reg.counter("good_total", "fine").inc()
+    assert "good_total 1" in reg.expose()
+
+
+def test_sysstats(tmp_path):
+    d = disk_status(str(tmp_path))
+    assert d["all"] > 0 and 0 <= d["percent_used"] <= 100
+    m = memory_status()
+    assert m["rss"] > 0
+
+
+# -- server integration ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.cluster.client import WeedClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    tmp = tmp_path_factory.mktemp("metrics-stack")
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp / "vs")], pulse_seconds=60)
+    vs.start()
+    yield master, vs, WeedClient(master.url())
+    vs.stop()
+    master.stop()
+
+
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(url + "/metrics") as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        return r.read().decode()
+
+
+def test_master_and_volume_metrics_endpoints(stack):
+    master, vs, client = stack
+    fid = client.upload_data(b"metrics payload")
+    client.download(fid)
+    mtext = _scrape(master.url())
+    assert "SeaweedFS_master_request_total" in mtext
+    assert "SeaweedFS_master_volume_count" in mtext
+    assert "SeaweedFS_master_is_leader 1" in mtext
+    assert "SeaweedFS_master_data_node_count 1" in mtext
+    vtext = _scrape(vs.server.url())
+    assert 'SeaweedFS_volumeServer_request_total{type="POST"}' in vtext
+    assert "SeaweedFS_volumeServer_request_seconds_bucket" in vtext
+    assert 'SeaweedFS_volumeServer_volumes{collection="default",' \
+           'type="volume"}' in vtext
+    assert "SeaweedFS_disk_free_bytes" in vtext
+    assert "SeaweedFS_memory_rss_bytes" in vtext
+
+
+def test_metrics_pusher(stack):
+    from seaweedfs_tpu.cluster import rpc
+    # Fake push gateway capturing POSTs.
+    received = []
+    gw = rpc.JsonHttpServer()
+    gw.prefix_route("POST", "/metrics/", lambda p, q, b: (
+        received.append((p, b)), {"ok": True})[-1])
+    gw.start()
+    try:
+        reg = Registry()
+        reg.counter("pushed_total", "x").inc(5)
+        pusher = MetricsPusher(reg, gw.url(), job="volumeServer",
+                               instance="vs-1")
+        pusher.push_once()
+        assert received
+        path, body = received[0]
+        assert path == "/metrics/job/volumeServer/instance/vs-1"
+        assert b"pushed_total 5" in body
+    finally:
+        gw.stop()
+
+
+def test_benchmark_command(stack):
+    """weed benchmark against the live stack (command/benchmark.go)."""
+    from seaweedfs_tpu.command import COMMANDS, _load_all, parse_flags
+    master, _vs, _c = stack
+    _load_all()
+    host = master.url().replace("http://", "")
+    flags, rest = parse_flags(
+        [f"-master={host}", "-n=32", "-size=256", "-c=4"])
+    assert COMMANDS["benchmark"].run(flags, rest) == 0
