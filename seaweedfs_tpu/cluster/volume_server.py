@@ -80,6 +80,8 @@ class VolumeServer:
         s.route("POST", "/admin/ec/copy_shard", self._ec_copy_shard)
         s.route("POST", "/admin/ec/to_volume", self._ec_to_volume)
         s.route("POST", "/query", self._query)
+        s.route("POST", "/admin/tier_upload", self._tier_upload)
+        s.route("POST", "/admin/tier_download", self._tier_download)
         self._setup_metrics()
         s.route("GET", "/admin/volume_file", self._volume_file)
         s.route("POST", "/admin/copy_volume", self._copy_volume)
@@ -259,6 +261,17 @@ class VolumeServer:
             raise rpc.RpcError(404, str(e)) from None
         except VolumeError as e:
             raise rpc.RpcError(403, str(e)) from None
+        if "width" in query or "height" in query:
+            # On-the-fly resize for image reads
+            # (volume_server_handlers_read.go:219-243).
+            from ..images import resized
+            data, mime = resized(
+                n.data, int(query.get("width", 0) or 0),
+                int(query.get("height", 0) or 0),
+                query.get("mode", ""))
+            if mime:
+                return (200, data, {"Content-Type": mime})
+            return data
         return n.data
 
     def _ec_read(self, ev: EcVolume, key: int, cookie: int):
@@ -384,6 +397,12 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             raise rpc.RpcError(404, f"volume {vid} not on this server")
+        mime = query.get("mime", query.get("_content_type", ""))
+        if mime == "image/jpeg" and query.get("type") != "replicate":
+            # EXIF auto-orientation on JPEG upload (needle.go:100-105);
+            # replicas receive the already-fixed bytes.
+            from ..images import fix_jpeg_orientation
+            body = fix_jpeg_orientation(body)
         n = Needle(cookie=cookie, id=key, data=body)
         if "name" in query:
             n.set_name(query["name"].encode())
@@ -652,6 +671,39 @@ class VolumeServer:
         v = self.store.mount_volume(vid)
         self._send_heartbeat(full=True)
         return {"volume": vid, "size": v.dat_size()}
+
+    def _tier_upload(self, query: dict, body: bytes) -> dict:
+        """VolumeTierMoveDatToRemote (volume_grpc_tier_upload.go): the
+        volume must be readonly; its .dat moves to the backend spec."""
+        from ..storage.tier import move_dat_to_remote
+        req = json.loads(body)
+        vid = int(req["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise rpc.RpcError(404, f"volume {vid} not on this server")
+        try:
+            info = move_dat_to_remote(
+                v, req["dest"], keep_local=req.get("keep_local", False),
+                access_key=req.get("access_key", ""),
+                secret_key=req.get("secret_key", ""))
+        except VolumeError as e:
+            raise rpc.RpcError(400, str(e)) from None
+        return {"volume": vid, "remote": info["files"][0]}
+
+    def _tier_download(self, query: dict, body: bytes) -> dict:
+        """VolumeTierMoveDatFromRemote: bring the .dat back local."""
+        from ..storage.tier import move_dat_from_remote
+        req = json.loads(body)
+        vid = int(req["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise rpc.RpcError(404, f"volume {vid} not on this server")
+        try:
+            move_dat_from_remote(
+                v, keep_remote=req.get("keep_remote", False))
+        except VolumeError as e:
+            raise rpc.RpcError(400, str(e)) from None
+        return {"volume": vid, "local": True}
 
     def _query(self, query: dict, body: bytes):
         """The volume Query RPC (pb/volume_server.proto:92,

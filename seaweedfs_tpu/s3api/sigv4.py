@@ -17,13 +17,17 @@ from .auth import compute_signature_v4
 
 def sign_request(method: str, url: str, headers: dict[str, str],
                  payload: bytes, access_key: str, secret_key: str,
-                 region: str = "us-east-1") -> dict[str, str]:
-    """Returns headers + the sig v4 Authorization set for this request."""
+                 region: str = "us-east-1",
+                 payload_hash: str | None = None) -> dict[str, str]:
+    """Returns headers + the sig v4 Authorization set for this request.
+    Pass a precomputed payload_hash to sign a streamed body without
+    materializing it."""
     parsed = urllib.parse.urlparse(url)
     amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     date = amz_date[:8]
     scope = f"{date}/{region}/s3/aws4_request"
-    payload_hash = hashlib.sha256(payload).hexdigest()
+    if payload_hash is None:
+        payload_hash = hashlib.sha256(payload).hexdigest()
     out = dict(headers)
     out["Host"] = parsed.netloc
     out["x-amz-date"] = amz_date

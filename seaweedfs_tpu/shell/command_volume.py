@@ -150,6 +150,46 @@ class VolumeUnmount(Command):
 
 
 @register
+class VolumeTierUpload(Command):
+    name = "volume.tier.upload"
+    help = ("volume.tier.upload -volumeId <id> -node <host:port> "
+            "-dest <s3://host/bucket | local:///dir> [-keepLocal] "
+            "(shell/command_volume_tier_upload.go: marks the volume "
+            "readonly, then moves its .dat to the backend)")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        vid = int(flags["volumeId"])
+        node = flags["node"]
+        env.vs_call(node, "/admin/readonly",
+                    {"volume": vid, "readonly": True})
+        out = env.vs_call(node, "/admin/tier_upload", {
+            "volume": vid, "dest": flags["dest"],
+            "keep_local": "keepLocal" in flags,
+            "access_key": flags.get("accessKey", ""),
+            "secret_key": flags.get("secretKey", "")})
+        r = out["remote"]
+        return (f"volume {vid} tiered to {r['backend_spec']} "
+                f"({r['file_size']} bytes)")
+
+
+@register
+class VolumeTierDownload(Command):
+    name = "volume.tier.download"
+    help = ("volume.tier.download -volumeId <id> -node <host:port> "
+            "[-keepRemote] (command_volume_tier_download.go)")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        vid = int(flags["volumeId"])
+        env.vs_call(flags["node"], "/admin/tier_download", {
+            "volume": vid, "keep_remote": "keepRemote" in flags})
+        return f"volume {vid} downloaded back to local storage"
+
+
+@register
 class VolumeBalance(Command):
     name = "volume.balance"
     help = ("volume.balance [-collection <name>] — move volumes so every "

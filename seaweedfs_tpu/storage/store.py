@@ -20,7 +20,8 @@ from ..core.replica_placement import ReplicaPlacement
 from ..core.ttl import TTL
 from .volume import NotFoundError, Volume, VolumeError
 
-_VOLUME_RE = re.compile(r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.dat$")
+_VOLUME_RE = re.compile(
+    r"^(?:(?P<collection>.+)_)?(?P<vid>\d+)\.(?:dat|vif)$")
 
 
 @dataclass
@@ -53,6 +54,26 @@ class DiskLocation:
     def load_existing_volumes(self) -> int:
         count = 0
         with self._lock:
+            # Tiered volumes FIRST (volume_tier.go maybeLoadVolumeInfo):
+            # a .vif marks the remote copy as authoritative, so even a
+            # keep_local .dat must not be opened writable — writes to it
+            # would silently diverge from (and later lose to) the tier.
+            for path in sorted(glob.glob(os.path.join(self.directory,
+                                                      "*.vif"))):
+                m = _VOLUME_RE.match(os.path.basename(path))
+                if not m:
+                    continue
+                vid = int(m.group("vid"))
+                if vid in self.volumes:
+                    continue
+                collection = m.group("collection") or ""
+                try:
+                    from .tier import open_remote_volume
+                    self.volumes[vid] = open_remote_volume(
+                        self.directory, collection, vid)
+                    count += 1
+                except Exception:  # noqa: BLE001 — unreachable backend
+                    continue       # must not block the store
             for path in sorted(glob.glob(os.path.join(self.directory,
                                                       "*.dat"))):
                 m = _VOLUME_RE.match(os.path.basename(path))

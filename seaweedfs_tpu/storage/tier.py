@@ -1,0 +1,129 @@
+"""Volume tiering: move a volume's .dat to a remote backend.
+
+Reference: weed/storage/volume_tier.go:11-32 (the `.vif` VolumeInfo
+sidecar + maybeLoadVolumeInfo/LoadRemoteFile),
+server/volume_grpc_tier_upload.go (VolumeTierMoveDatToRemote) and
+_download.go (back).  The `.idx` stays local; needle reads proxy
+through ranged reads against the backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .backend import backend_for_spec
+from .volume import Volume, VolumeError
+
+
+def vif_path(base: str) -> str:
+    return base + ".vif"
+
+
+def save_vif(base: str, info: dict) -> None:
+    tmp = vif_path(base) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(info, f, indent=1)
+    os.replace(tmp, vif_path(base))
+
+
+def load_vif(base: str) -> dict | None:
+    try:
+        with open(vif_path(base)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def tier_key(collection: str, vid: int) -> str:
+    name = f"{collection}_{vid}" if collection else str(vid)
+    return f"{name}.dat"
+
+
+def move_dat_to_remote(volume: Volume, dest_spec: str,
+                       keep_local: bool = False,
+                       access_key: str = "",
+                       secret_key: str = "") -> dict:
+    """Upload the .dat, write the .vif sidecar, switch the volume to
+    remote reads.  The volume must be readonly (the reference requires
+    the same)."""
+    if volume.remote_file is not None:
+        raise VolumeError(f"volume {volume.vid} is already remote")
+    if not volume.readonly:
+        raise VolumeError(
+            f"volume {volume.vid} must be readonly before tiering")
+    backend = backend_for_spec(dest_spec, access_key, secret_key)
+    base = volume.file_name()
+    key = tier_key(volume.collection, volume.vid)
+    volume.sync()
+    size = backend.upload_file(key, base + ".dat")
+    # No credentials in the sidecar: the .vif sits on the data dir and
+    # must never leak keys (the reference keeps backend credentials in
+    # centrally-distributed config) — they come from server config/env
+    # at open time.
+    info = {"volume_id": volume.vid, "version": volume.version,
+            "collection": volume.collection,
+            "files": [{"backend_spec": dest_spec, "key": key,
+                       "file_size": size,
+                       "modified_at": int(time.time())}]}
+    save_vif(base, info)
+    # The fd swap rides the same write lock vacuum uses, so a reader
+    # mid-pread can never observe a closed fd.
+    with volume._file_lock.write():
+        volume.remote_file = backend.open_file(key, size)
+        dat = volume._dat
+        volume._dat = None
+    if dat is not None:
+        dat.close()
+    if not keep_local:
+        os.remove(base + ".dat")
+    return info
+
+
+def _tier_credentials() -> tuple[str, str]:
+    """Backend credentials from server-level config (env), NOT from the
+    .vif (which must stay secret-free)."""
+    return (os.environ.get("WEED_TIER_ACCESS_KEY", ""),
+            os.environ.get("WEED_TIER_SECRET_KEY", ""))
+
+
+def move_dat_from_remote(volume: Volume, keep_remote: bool = False,
+                         access_key: str = "",
+                         secret_key: str = "") -> None:
+    """Download the .dat back and resume local reads
+    (VolumeTierMoveDatFromRemote)."""
+    base = volume.file_name()
+    info = load_vif(base)
+    if info is None or volume.remote_file is None:
+        raise VolumeError(f"volume {volume.vid} is not tiered")
+    fdesc = info["files"][0]
+    if not access_key:
+        access_key, secret_key = _tier_credentials()
+    backend = backend_for_spec(fdesc["backend_spec"],
+                               access_key, secret_key)
+    backend.download_file(fdesc["key"], base + ".dat")
+    with volume._file_lock.write():
+        remote = volume.remote_file
+        volume._dat = open(base + ".dat", "r+b")
+        volume.remote_file = None
+    remote.close()
+    os.remove(vif_path(base))
+    if not keep_remote:
+        backend.delete(fdesc["key"])
+
+
+def open_remote_volume(dir_: str, collection: str, vid: int) -> Volume:
+    """Open a tiered volume from its .vif + local .idx (the startup
+    path when the .dat is absent — maybeLoadVolumeInfo)."""
+    name = f"{collection}_{vid}" if collection else str(vid)
+    base = os.path.join(dir_, name)
+    info = load_vif(base)
+    if info is None:
+        raise VolumeError(f"no .vif for volume {vid} at {base}")
+    fdesc = info["files"][0]
+    ak, sk = _tier_credentials()
+    backend = backend_for_spec(fdesc["backend_spec"], ak, sk)
+    remote = backend.open_file(fdesc["key"], fdesc["file_size"])
+    return Volume(dir_, collection, vid, create=False,
+                  remote_file=remote)

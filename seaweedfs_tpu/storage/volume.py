@@ -56,7 +56,8 @@ class Volume:
     def __init__(self, dir_: str, collection: str, vid: int,
                  replica_placement: ReplicaPlacement | None = None,
                  ttl: TTL | None = None, create: bool = True,
-                 version: int = CURRENT_VERSION, use_worker: bool = True):
+                 version: int = CURRENT_VERSION, use_worker: bool = True,
+                 remote_file=None):
         self.dir = dir_
         self.collection = collection
         self.vid = vid
@@ -67,6 +68,24 @@ class Volume:
         # file swap.
         self._file_lock = RWLock()
         base = self.file_name()
+        # Tiered volume: the .dat lives on a remote BackendStorage
+        # (storage/volume_tier.go); reads proxy through remote_file,
+        # writes are forbidden, the .idx stays local.
+        self.remote_file = remote_file
+        if remote_file is not None:
+            self._dat = None
+            self.readonly = True
+            use_worker = False
+            self.super_block = SuperBlock.from_bytes(
+                remote_file.pread(SUPER_BLOCK_SIZE + 64 * 1024, 0))
+            self.nm = MemoryNeedleMap.load(base + ".idx")
+            self._append_at = remote_file.size()
+            self.last_modified = time.time()
+            self._closed = False
+            self._use_worker = False
+            self._queue = queue.Queue(maxsize=1)
+            self._worker = None
+            return
         exists = os.path.exists(base + ".dat")
         if not exists and not create:
             raise VolumeError(f"volume file {base}.dat not found")
@@ -255,7 +274,10 @@ class Volume:
             if not t.size_is_valid(size):
                 raise NotFoundError(f"needle {needle_id:x} deleted")
             total = get_actual_size(size, self.version)
-            blob = os.pread(self._dat.fileno(), total, offset)
+            if self.remote_file is not None:
+                blob = self.remote_file.pread(total, offset)
+            else:
+                blob = os.pread(self._dat.fileno(), total, offset)
         n = Needle.from_bytes(blob, self.version)
         if cookie is not None and n.cookie != cookie:
             raise VolumeError(
@@ -295,8 +317,9 @@ class Volume:
 
     def sync(self) -> None:
         with self._lock:
-            self._dat.flush()
-            os.fsync(self._dat.fileno())
+            if self._dat is not None:
+                self._dat.flush()
+                os.fsync(self._dat.fileno())
             self.nm.flush()
 
     def close(self) -> None:
@@ -316,8 +339,11 @@ class Volume:
                 req.done.set()
         with self._lock:
             try:
-                self._dat.flush()
-                self._dat.close()
+                if self._dat is not None:
+                    self._dat.flush()
+                    self._dat.close()
+                elif self.remote_file is not None:
+                    self.remote_file.close()
             except ValueError:
                 pass
             self.nm.close()
