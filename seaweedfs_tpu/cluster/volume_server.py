@@ -80,6 +80,7 @@ class VolumeServer:
         s.route("POST", "/admin/ec/copy_shard", self._ec_copy_shard)
         s.route("POST", "/admin/ec/to_volume", self._ec_to_volume)
         s.route("POST", "/query", self._query)
+        s.route("GET", "/admin/volume_tail", self._volume_tail)
         s.route("POST", "/admin/tier_upload", self._tier_upload)
         s.route("POST", "/admin/tier_download", self._tier_download)
         self._setup_metrics()
@@ -263,12 +264,18 @@ class VolumeServer:
             raise rpc.RpcError(403, str(e)) from None
         if "width" in query or "height" in query:
             # On-the-fly resize for image reads
-            # (volume_server_handlers_read.go:219-243).
+            # (volume_server_handlers_read.go:219-243).  Malformed
+            # dimensions degrade to 0 = unresized, like the reference's
+            # atoi — never a 500 on a valid needle read.
             from ..images import resized
-            data, mime = resized(
-                n.data, int(query.get("width", 0) or 0),
-                int(query.get("height", 0) or 0),
-                query.get("mode", ""))
+
+            def _dim(name: str) -> int:
+                try:
+                    return max(0, int(query.get(name, 0) or 0))
+                except ValueError:
+                    return 0
+            data, mime = resized(n.data, _dim("width"), _dim("height"),
+                                 query.get("mode", ""))
             if mime:
                 return (200, data, {"Content-Type": mime})
             return data
@@ -672,6 +679,25 @@ class VolumeServer:
         self._send_heartbeat(full=True)
         return {"volume": vid, "size": v.dat_size()}
 
+    def _volume_tail(self, query: dict, body: bytes):
+        """VolumeTailSender (volume_server.proto, volume_backup.go): raw
+        .dat bytes of records appended after ?since_ns=, capped at
+        ?max_bytes=.  The X-Last-Append-Ns header carries the newest
+        timestamp in the returned window for resuming."""
+        from ..storage.volume_backup import (last_append_in_blob,
+                                             read_incremental)
+        vid = int(query["volume"])
+        since = int(query.get("since_ns", 0))
+        max_bytes = int(query.get("max_bytes", 64 * 1024 * 1024))
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise rpc.RpcError(404, f"volume {vid} not on this server")
+        delta = read_incremental(v, since, max_bytes)
+        last = last_append_in_blob(delta, v.version) if delta else since
+        return (200, delta, {"Content-Type": "application/octet-stream",
+                             "X-Volume-Version": str(v.version),
+                             "X-Last-Append-Ns": str(last)})
+
     def _tier_upload(self, query: dict, body: bytes) -> dict:
         """VolumeTierMoveDatToRemote (volume_grpc_tier_upload.go): the
         volume must be readonly; its .dat moves to the backend spec."""
@@ -700,7 +726,9 @@ class VolumeServer:
             raise rpc.RpcError(404, f"volume {vid} not on this server")
         try:
             move_dat_from_remote(
-                v, keep_remote=req.get("keep_remote", False))
+                v, keep_remote=req.get("keep_remote", False),
+                access_key=req.get("access_key", ""),
+                secret_key=req.get("secret_key", ""))
         except VolumeError as e:
             raise rpc.RpcError(400, str(e)) from None
         return {"volume": vid, "local": True}

@@ -185,7 +185,9 @@ class VolumeTierDownload(Command):
         flags, _ = self.parse_flags(args)
         vid = int(flags["volumeId"])
         env.vs_call(flags["node"], "/admin/tier_download", {
-            "volume": vid, "keep_remote": "keepRemote" in flags})
+            "volume": vid, "keep_remote": "keepRemote" in flags,
+            "access_key": flags.get("accessKey", ""),
+            "secret_key": flags.get("secretKey", "")})
         return f"volume {vid} downloaded back to local storage"
 
 

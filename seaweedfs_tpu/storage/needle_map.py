@@ -90,6 +90,13 @@ class MemoryNeedleMap:
                                  t.TOMBSTONE_FILE_SIZE)
         return prev[1]
 
+    def ordered_offsets(self) -> list[int]:
+        """Live-needle .dat offsets in append (= offset) order — the
+        probe set for BinarySearchByAppendAtNs (append-only volumes are
+        time-ordered by offset)."""
+        return sorted(off for off, size in self._m.values()
+                      if t.size_is_valid(size))
+
     def ascending_visit(self, fn) -> None:
         for key in sorted(self._m):
             off, size = self._m[key]

@@ -58,12 +58,18 @@ class DiskLocation:
             # a .vif marks the remote copy as authoritative, so even a
             # keep_local .dat must not be opened writable — writes to it
             # would silently diverge from (and later lose to) the tier.
+            tiered: set[int] = set()
             for path in sorted(glob.glob(os.path.join(self.directory,
                                                       "*.vif"))):
                 m = _VOLUME_RE.match(os.path.basename(path))
                 if not m:
                     continue
+                from .tier import load_vif
+                info = load_vif(path[:-4])
+                if not info or not info.get("files"):
+                    continue  # EC/version metadata, not a tier marker
                 vid = int(m.group("vid"))
+                tiered.add(vid)
                 if vid in self.volumes:
                     continue
                 collection = m.group("collection") or ""
@@ -80,7 +86,9 @@ class DiskLocation:
                 if not m:
                     continue
                 vid = int(m.group("vid"))
-                if vid in self.volumes:
+                if vid in self.volumes or vid in tiered:
+                    # A .vif whose backend was unreachable must NOT
+                    # fall back to a writable stale local .dat.
                     continue
                 collection = m.group("collection") or ""
                 try:
@@ -186,6 +194,22 @@ class Store:
             if v is not None:
                 return v
             for loc in self.locations:
+                # A .vif marks the remote copy authoritative — remount
+                # must not reopen a keep_local .dat writable.
+                for path in glob.glob(os.path.join(loc.directory,
+                                                   "*.vif")):
+                    m = _VOLUME_RE.match(os.path.basename(path))
+                    if not m or int(m.group("vid")) != vid:
+                        continue
+                    from .tier import load_vif, open_remote_volume
+                    info = load_vif(path[:-4])
+                    if not info or not info.get("files"):
+                        continue  # EC metadata .vif, not a tier marker
+                    v = open_remote_volume(
+                        loc.directory, m.group("collection") or "", vid)
+                    loc.volumes[vid] = v
+                    self.new_volumes.append(self._volume_info(v))
+                    return v
                 for path in glob.glob(os.path.join(loc.directory, "*.dat")):
                     m = _VOLUME_RE.match(os.path.basename(path))
                     if not m or int(m.group("vid")) != vid:

@@ -119,8 +119,10 @@ def open_remote_volume(dir_: str, collection: str, vid: int) -> Volume:
     name = f"{collection}_{vid}" if collection else str(vid)
     base = os.path.join(dir_, name)
     info = load_vif(base)
-    if info is None:
-        raise VolumeError(f"no .vif for volume {vid} at {base}")
+    if info is None or not info.get("files"):
+        # A files-less .vif is EC/version metadata (ec/volume_info.py),
+        # not a tier marker.
+        raise VolumeError(f"volume {vid} at {base} is not tiered")
     fdesc = info["files"][0]
     ak, sk = _tier_credentials()
     backend = backend_for_spec(fdesc["backend_spec"], ak, sk)

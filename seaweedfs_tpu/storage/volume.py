@@ -287,6 +287,14 @@ class Volume:
                 raise NotFoundError(f"needle {needle_id:x} expired")
         return n
 
+    def pread(self, size: int, offset: int) -> bytes:
+        """Raw .dat range read under the read lock (local or remote) —
+        the tail/backup scanners' access path."""
+        with self._file_lock.read():
+            if self.remote_file is not None:
+                return self.remote_file.pread(size, offset)
+            return os.pread(self._dat.fileno(), size, offset)
+
     # -- stats / lifecycle --------------------------------------------------
 
     def content_size(self) -> int:

@@ -1,0 +1,197 @@
+"""Incremental volume backup / tail.
+
+Reference behaviors: storage/volume_backup.go (BinarySearchByAppendAtNs
+:170, IncrementalBackup :65), the VolumeTail RPCs, command/backup.go.
+"""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.core.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.storage.volume_backup import (
+    apply_incremental, binary_search_by_append_at_ns,
+    last_append_at_ns, read_incremental)
+
+
+def _vol(tmp_path, vid=3) -> Volume:
+    return Volume(str(tmp_path), "", vid, use_worker=False)
+
+
+def _write(v, nid, data):
+    n = Needle(id=nid, cookie=0x99, data=data)
+    v.write_needle(n)
+    return v.nm.get(nid)
+
+
+def test_binary_search_cut_offset(tmp_path):
+    v = _vol(tmp_path)
+    stamps = []
+    for i in range(10):
+        _write(v, i + 1, f"rec-{i}".encode())
+        stamps.append(v.read_needle(i + 1).append_at_ns)
+    # Cut strictly after the 5th record.
+    cut = binary_search_by_append_at_ns(v, stamps[4])
+    off6, _ = v.nm.get(6)
+    assert cut == off6
+    # Nothing newer -> end of volume.
+    assert binary_search_by_append_at_ns(v, stamps[-1]) == v.dat_size()
+    # Everything newer -> first record.
+    off1, _ = v.nm.get(1)
+    assert binary_search_by_append_at_ns(v, 0) == off1
+    v.close()
+
+
+def test_incremental_roundtrip_with_deletes(tmp_path):
+    src_dir = tmp_path / "src"
+    dst_dir = tmp_path / "dst"
+    src_dir.mkdir()
+    dst_dir.mkdir()
+    v = _vol(src_dir)
+    for i in range(5):
+        _write(v, i + 1, f"first-{i}".encode())
+    v.sync()
+    # Seed the backup with a straight file copy (first `weed backup`).
+    import shutil
+    shutil.copyfile(v.file_name() + ".dat",
+                    str(dst_dir / "3.dat"))
+    shutil.copyfile(v.file_name() + ".idx",
+                    str(dst_dir / "3.idx"))
+    since = last_append_at_ns(str(dst_dir / "3.dat"))
+    # More writes + a delete on the source.
+    for i in range(5, 8):
+        _write(v, i + 1, f"second-{i}".encode())
+    v.delete_needle(2)
+    delta = read_incremental(v, since)
+    assert delta
+    applied = apply_incremental(str(dst_dir / "3.dat"),
+                                str(dst_dir / "3.idx"), delta,
+                                v.version)
+    assert applied >= 4  # 3 appends + 1 tombstone
+    # The backup copy opens as a volume equal to the source.
+    b = Volume(str(dst_dir), "", 3, create=False, use_worker=False)
+    for i in list(range(5, 8)) + [0, 3, 4]:
+        assert b.read_needle(i + 1).data == \
+            v.read_needle(i + 1).data
+    from seaweedfs_tpu.storage.volume import NotFoundError
+    with pytest.raises(NotFoundError):
+        b.read_needle(2)  # delete replayed
+    # Re-sync with no changes is a no-op.
+    since2 = last_append_at_ns(str(dst_dir / "3.dat"))
+    assert read_incremental(v, since2) == b""
+    b.close()
+    v.close()
+
+
+def test_backup_command_end_to_end(tmp_path):
+    """weed backup: full copy then incremental tail via the RPCs."""
+    from seaweedfs_tpu.cluster.client import WeedClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.command import COMMANDS, _load_all, parse_flags
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path / "m"))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "v")],
+                      pulse_seconds=60)
+    vs.start()
+    try:
+        client = WeedClient(master.url())
+        fid1 = client.upload_data(b"backup me first")
+        vid = int(fid1.split(",")[0])
+        _load_all()
+        host = master.url().replace("http://", "")
+        bdir = str(tmp_path / "backup")
+        flags, rest = parse_flags([f"-master={host}",
+                                   f"-volumeId={vid}",
+                                   f"-dir={bdir}"])
+        assert COMMANDS["backup"].run(flags, rest) == 0
+        assert os.path.exists(os.path.join(bdir, f"{vid}.dat"))
+        # New uploads to the SAME volume, then an incremental run.
+        fids = [fid1]
+        for i in range(5):
+            a = client.assign()
+            if int(a["fid"].split(",")[0]) == vid:
+                import urllib.request
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://{a['url']}/{a['fid']}",
+                    data=f"extra-{i}".encode(),
+                    method="POST")).read()
+                fids.append(a["fid"])
+        assert COMMANDS["backup"].run(flags, rest) == 0
+        # The local copy serves every fid that landed on this volume.
+        b = Volume(bdir, "", vid, create=False, use_worker=False)
+        from seaweedfs_tpu.core import types as t
+        for fid in fids:
+            _vid, key, cookie = t.parse_file_id(fid)
+            assert b.read_needle(key, cookie).data
+        b.close()
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_delete_before_later_write_replays(tmp_path):
+    """Regression: a tombstone appended BEFORE a later live write must
+    ride the delta (live-offset binary search alone would cut past it
+    and resurrect the deleted needle in the backup)."""
+    import shutil
+    src_dir = tmp_path / "s"
+    dst_dir = tmp_path / "d"
+    src_dir.mkdir()
+    dst_dir.mkdir()
+    v = _vol(src_dir)
+    for i in range(4):
+        _write(v, i + 1, f"x-{i}".encode())
+    v.sync()
+    shutil.copyfile(v.file_name() + ".dat", str(dst_dir / "3.dat"))
+    shutil.copyfile(v.file_name() + ".idx", str(dst_dir / "3.idx"))
+    since = last_append_at_ns(str(dst_dir / "3.dat"))
+    v.delete_needle(2)          # tombstone first...
+    _write(v, 9, b"later-live")  # ...then a live write
+    delta = read_incremental(v, since)
+    apply_incremental(str(dst_dir / "3.dat"), str(dst_dir / "3.idx"),
+                      delta, v.version)
+    b = Volume(str(dst_dir), "", 3, create=False, use_worker=False)
+    from seaweedfs_tpu.storage.volume import NotFoundError
+    with pytest.raises(NotFoundError):
+        b.read_needle(2)
+    assert b.read_needle(9).data == b"later-live"
+    b.close()
+    v.close()
+
+
+def test_delete_only_interval_replays(tmp_path):
+    """A delta window holding ONLY tombstones must still be streamed."""
+    import shutil
+    src_dir = tmp_path / "s2"
+    dst_dir = tmp_path / "d2"
+    src_dir.mkdir()
+    dst_dir.mkdir()
+    v = _vol(src_dir)
+    for i in range(3):
+        _write(v, i + 1, f"y-{i}".encode())
+    v.sync()
+    shutil.copyfile(v.file_name() + ".dat", str(dst_dir / "3.dat"))
+    shutil.copyfile(v.file_name() + ".idx", str(dst_dir / "3.idx"))
+    since = last_append_at_ns(str(dst_dir / "3.dat"))
+    v.delete_needle(1)
+    v.delete_needle(3)
+    delta = read_incremental(v, since)
+    assert delta, "delete-only delta must not be empty"
+    apply_incremental(str(dst_dir / "3.dat"), str(dst_dir / "3.idx"),
+                      delta, v.version)
+    b = Volume(str(dst_dir), "", 3, create=False, use_worker=False)
+    from seaweedfs_tpu.storage.volume import NotFoundError
+    for nid in (1, 3):
+        with pytest.raises(NotFoundError):
+            b.read_needle(nid)
+    assert b.read_needle(2).data == b"y-1"
+    # Cursor now covers the tombstones: next delta is empty (no
+    # re-fetch loop).
+    since2 = last_append_at_ns(str(dst_dir / "3.dat"))
+    assert read_incremental(v, since2) == b""
+    b.close()
+    v.close()
