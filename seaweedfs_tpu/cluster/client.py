@@ -56,6 +56,7 @@ class WeedClient:
             else [master_url]
         self.masters = [u.rstrip("/") for u in urls]
         self._master_idx = 0
+        self._secured: bool | None = None  # learned from responses
         self.cache = VidCache()
 
     @property
@@ -115,8 +116,13 @@ class WeedClient:
                         ttl=ttl)
         fid = a["fid"]
         url = f"http://{a['url']}/{fid}"
+        q = []
         if name:
-            url += f"?name={name}"
+            q.append(f"name={name}")
+        if a.get("auth"):  # master-minted write JWT (secured cluster)
+            q.append(f"jwt={a['auth']}")
+        if q:
+            url += "?" + "&".join(q)
         rpc.call(url, "POST", data)
         return fid
 
@@ -150,7 +156,19 @@ class WeedClient:
         locs = self.lookup(vid)
         if not locs:
             raise rpc.RpcError(404, f"volume {vid} has no locations")
-        rpc.call(f"http://{locs[0]['url']}/{fid}", "DELETE")
+        url = f"http://{locs[0]['url']}/{fid}"
+        # Secured cluster: fetch a delete token via lookup?fileId=
+        # (operation/delete_content.go).  Once the master answers
+        # without auth the cluster is known-unsecured and the extra
+        # lookup is skipped.
+        if self._secured is not False:
+            resp = self._master_call(
+                f"/dir/lookup?volumeId={vid}&fileId={fid}")
+            auth = resp.get("auth", "")
+            self._secured = bool(auth)
+            if auth:
+                url += f"?jwt={auth}"
+        rpc.call(url, "DELETE")
 
     def submit(self, data: bytes, **kw) -> dict:
         """upload + return {fid, size, url} (operation/submit.go)."""

@@ -59,7 +59,14 @@ class MasterServer:
                  pulse_seconds: int = 5,
                  garbage_threshold: float = 0.3,
                  meta_dir: str | None = None,
-                 peers: list[str] | None = None):
+                 peers: list[str] | None = None,
+                 jwt_signing_key: str = "",
+                 jwt_expires_seconds: int = 10):
+        # Write-path JWT (security/jwt.go): when configured, Assign
+        # responses carry an `auth` token volume servers require on
+        # needle writes/deletes.
+        self.jwt_signing_key = jwt_signing_key
+        self.jwt_expires_seconds = jwt_expires_seconds
         if meta_dir:
             import os
             os.makedirs(meta_dir, exist_ok=True)
@@ -284,10 +291,15 @@ class MasterServer:
                             406, "no free volumes and cannot grow")
         fid, count, locs = self.topo.pick_for_write(count, option)
         dn = locs[0]
-        return {"fid": fid, "count": count,
-                "url": dn.url(), "publicUrl": dn.public_url,
-                "replicas": [{"url": n.url(), "publicUrl": n.public_url}
-                             for n in locs[1:]]}
+        out = {"fid": fid, "count": count,
+               "url": dn.url(), "publicUrl": dn.public_url,
+               "replicas": [{"url": n.url(), "publicUrl": n.public_url}
+                            for n in locs[1:]]}
+        if self.jwt_signing_key:
+            from ..utils.security import gen_jwt
+            out["auth"] = gen_jwt(self.jwt_signing_key,
+                                  self.jwt_expires_seconds, fid)
+        return out
 
     def _allocate_volume(self, vid: int, option: VolumeGrowOption,
                          server) -> None:
@@ -318,9 +330,17 @@ class MasterServer:
         collection = query.get("collection", "")
         locs = self.topo.lookup(collection, vid)
         if locs:
-            return {"volumeId": vid, "locations": [
+            out = {"volumeId": vid, "locations": [
                 {"url": dn.url(), "publicUrl": dn.public_url}
                 for dn in locs]}
+            # Write token for delete/update of an existing fid
+            # (operation/delete_content.go fetches a lookup jwt).
+            if self.jwt_signing_key and query.get("fileId"):
+                from ..utils.security import gen_jwt
+                out["auth"] = gen_jwt(self.jwt_signing_key,
+                                      self.jwt_expires_seconds,
+                                      query["fileId"])
+            return out
         ec = self.topo.lookup_ec_shards(vid)
         if ec is not None:
             return {"volumeId": vid, "ecShards": {

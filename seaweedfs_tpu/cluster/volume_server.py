@@ -42,13 +42,18 @@ class VolumeServer:
                  max_volume_counts: list[int] | None = None,
                  data_center: str = "DefaultDataCenter",
                  rack: str = "DefaultRack",
-                 pulse_seconds: int = 2):
+                 pulse_seconds: int = 2,
+                 jwt_signing_key: str = ""):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
             else [master_url]
         self.master_url = self.masters[0]
         self._master_idx = 0
+        # Write-path guard (security/guard.go): when a signing key is
+        # configured, needle writes/deletes require a master-minted JWT.
+        from ..utils.security import Guard
+        self.guard = Guard(signing_key=jwt_signing_key)
         self._hb_seq = 0
         # Process generation: lets the master distinguish a restarted
         # volume server (seq starts over) from out-of-order arrivals.
@@ -81,6 +86,7 @@ class VolumeServer:
         s.route("POST", "/admin/ec/to_volume", self._ec_to_volume)
         s.route("POST", "/query", self._query)
         s.route("GET", "/admin/volume_tail", self._volume_tail)
+        s.route("POST", "/admin/leave", self._admin_leave)
         s.route("POST", "/admin/tier_upload", self._tier_upload)
         s.route("POST", "/admin/tier_download", self._tier_download)
         self._setup_metrics()
@@ -89,6 +95,7 @@ class VolumeServer:
         s.route("POST", "/admin/mount", self._admin_mount)
         s.route("POST", "/admin/unmount", self._admin_unmount)
         s.prefix_route("GET", "/", self._get_needle)
+        s.prefix_route("HEAD", "/", self._head_needle)
         s.prefix_route("POST", "/", self._post_needle)
         s.prefix_route("PUT", "/", self._post_needle)
         s.prefix_route("DELETE", "/", self._delete_needle)
@@ -248,6 +255,22 @@ class VolumeServer:
         fid = urllib.parse.unquote(path.lstrip("/"))
         return t.parse_file_id(fid)
 
+    def _head_needle(self, path: str, query: dict, body: bytes):
+        """Existence/size probe without the body (fsck, clients)."""
+        vid, key, cookie = self._parse_fid_path(path)
+        v = self.store.find_volume(vid)
+        if v is None and vid not in self.ec_volumes:
+            raise rpc.RpcError(404, f"volume {vid} not on this server")
+        if v is not None:
+            try:
+                n = self.store.read_needle(vid, key, cookie)
+            except NotFoundError as e:
+                raise rpc.RpcError(404, str(e)) from None
+            except VolumeError as e:
+                raise rpc.RpcError(403, str(e)) from None
+            return (200, b"", {"Content-Length": str(len(n.data))})
+        return (200, b"", {})  # EC probe: shard-level check is costly
+
     def _get_needle(self, path: str, query: dict, body: bytes):
         vid, key, cookie = self._parse_fid_path(path)
         v = self.store.find_volume(vid)
@@ -399,7 +422,22 @@ class VolumeServer:
         rec = ev.coder.reconstruct(arrs, wanted=[sid])
         return np.asarray(rec[sid]).tobytes()
 
+    def _check_write_jwt(self, path: str, query: dict) -> None:
+        """JWT gate on the write path (volume_server_handlers.go
+        maybeCheckJwtAuthorization) — replica fan-out is intra-cluster
+        and rides the original client's authorization."""
+        if not self.guard.signing_key or \
+                query.get("type") == "replicate":
+            return
+        from ..utils.security import JwtError
+        fid = urllib.parse.unquote(path.lstrip("/"))
+        try:
+            self.guard.check_jwt(query.get("jwt", ""), fid)
+        except JwtError as e:
+            raise rpc.RpcError(401, f"jwt: {e}") from None
+
     def _post_needle(self, path: str, query: dict, body: bytes) -> dict:
+        self._check_write_jwt(path, query)
         vid, key, cookie = self._parse_fid_path(path)
         v = self.store.find_volume(vid)
         if v is None:
@@ -422,6 +460,7 @@ class VolumeServer:
         return {"size": len(body), "eTag": f"{n.checksum:08x}"}
 
     def _delete_needle(self, path: str, query: dict, body: bytes) -> dict:
+        self._check_write_jwt(path, query)
         vid, key, _cookie = self._parse_fid_path(path)
         v = self.store.find_volume(vid)
         if v is None:
@@ -498,6 +537,13 @@ class VolumeServer:
         self.store.delete_volume(req["volume"])
         self._send_heartbeat()
         return {}
+
+    def _admin_leave(self, query: dict, body: bytes) -> dict:
+        """VolumeServerLeave: stop heartbeating so the master's dead-node
+        sweep drains this server (reads keep being served until the
+        process actually stops)."""
+        self._stop.set()
+        return {"leaving": True}
 
     def _admin_readonly(self, query: dict, body: bytes) -> dict:
         req = json.loads(body)

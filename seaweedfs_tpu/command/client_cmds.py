@@ -63,7 +63,11 @@ def run_download(flags: Flags, args: list[str]) -> int:
 
 def run_shell(flags: Flags, args: list[str]) -> int:
     from ..shell.repl import run_shell
-    return run_shell(_master(flags), commands=args or None)
+    filer = flags.get("filer", "")
+    if filer and not filer.startswith("http"):
+        filer = f"http://{filer}"
+    return run_shell(_master(flags), commands=args or None,
+                     filer_url=filer or None)
 
 
 def run_watch(flags: Flags, args: list[str]) -> int:

@@ -43,7 +43,8 @@ def run_master(flags: Flags, args: list[str]) -> int:
         volume_size_limit_mb=flags.get_int("volumeSizeLimitMB", 30 * 1024),
         default_replication=flags.get("defaultReplication", "000"),
         garbage_threshold=flags.get_float("garbageThreshold", 0.3),
-        peers=peers or None)
+        peers=peers or None,
+        jwt_signing_key=flags.get("jwt.key", ""))
     m.start()
     glog.infof("master serving at %s", m.server.url())
     return _wait_forever([m])
@@ -63,7 +64,8 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         port=flags.get_int("port", 8080),
         max_volume_counts=maxes,
         data_center=flags.get("dataCenter", "DefaultDataCenter"),
-        rack=flags.get("rack", "DefaultRack"))
+        rack=flags.get("rack", "DefaultRack"),
+        jwt_signing_key=flags.get("jwt.key", ""))
     vs.start()
     glog.infof("volume server serving at %s (dirs %s)",
                vs.server.url(), dirs)

@@ -126,7 +126,10 @@ class ChunkedWriter:
                                    ttl=self.ttl)
             fid = a["fid"]
             from ..cluster import rpc
-            resp = rpc.call(f"http://{a['url']}/{fid}", "POST", piece)
+            url = f"http://{a['url']}/{fid}"
+            if a.get("auth"):  # secured cluster write JWT
+                url += f"?jwt={a['auth']}"
+            resp = rpc.call(url, "POST", piece)
             etag = resp.get("eTag", "") if isinstance(resp, dict) else ""
             chunks.append(FileChunk(file_id=fid, offset=pos,
                                     size=len(piece),

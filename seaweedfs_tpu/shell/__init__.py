@@ -11,5 +11,6 @@ from .env import CommandEnv, ShellError  # noqa: F401
 
 # Importing the command modules registers them.
 from . import command_ec  # noqa: F401,E402
+from . import command_fs  # noqa: F401,E402
 from . import command_volume  # noqa: F401,E402
 from . import command_misc  # noqa: F401,E402

@@ -150,6 +150,61 @@ class VolumeUnmount(Command):
 
 
 @register
+class VolumeFsck(Command):
+    name = "volume.fsck"
+    help = ("volume.fsck [-v] — verify every filer chunk resolves to a "
+            "live needle (command_volume_fsck.go's "
+            "findMissingChunksInVolumeServers direction)")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        import urllib.request
+        flags, _ = self.parse_flags(args)
+        proxy = env.filer()
+        checked, missing = 0, []
+        stack = ["/"]
+        while stack:
+            d = stack.pop()
+            for e in proxy.list_all(d):
+                if e["is_directory"]:
+                    stack.append(e["FullPath"])
+                    continue
+                meta = proxy.meta(e["FullPath"])
+                for chunk in (meta or {}).get("chunks", []):
+                    checked += 1
+                    fid = chunk["file_id"]
+                    try:
+                        vid = int(fid.split(",")[0])
+                        locs = env.volume_locations(vid)
+                        if not locs:
+                            raise LookupError("no locations")
+                        req = urllib.request.Request(
+                            f"http://{locs[0]}/{fid}", method="HEAD")
+                        urllib.request.urlopen(req, timeout=10).read()
+                    except Exception as err:  # noqa: BLE001
+                        missing.append((e["FullPath"], fid, str(err)))
+        lines = [f"checked {checked} chunks, "
+                 f"{len(missing)} missing"]
+        if "v" in flags or missing:
+            lines += [f"  MISSING {path} chunk {fid}: {err}"
+                      for path, fid, err in missing[:50]]
+        return "\n".join(lines)
+
+
+@register
+class VolumeServerLeave(Command):
+    name = "volumeServer.leave"
+    help = ("volumeServer.leave -node <host:port> — stop the server's "
+            "heartbeats so the master drains it "
+            "(command_volume_server_leave.go)")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        env.vs_call(flags["node"], "/admin/leave", {})
+        return f"{flags['node']} is leaving the cluster"
+
+
+@register
 class VolumeTierUpload(Command):
     name = "volume.tier.upload"
     help = ("volume.tier.upload -volumeId <id> -node <host:port> "

@@ -18,12 +18,31 @@ class ShellError(Exception):
 
 
 class CommandEnv:
-    def __init__(self, master_url: str):
+    def __init__(self, master_url: str, filer_url: str | None = None):
         self.master_url = master_url.rstrip("/")
         self.client = WeedClient(self.master_url)
         self._lock_token: int | None = None
         self._renewer: threading.Timer | None = None
         self.cwd = "/"  # for fs.* commands
+        self.filer_url = filer_url.rstrip("/") if filer_url else None
+
+    def filer(self):
+        """FilerProxy for fs.* commands (shell -filer=host:8888)."""
+        if self.filer_url is None:
+            raise ShellError(
+                "no filer configured — start the shell with "
+                "-filer=host:8888")
+        from ..filer.client import FilerProxy
+        return FilerProxy(self.filer_url)
+
+    def resolve(self, path: str) -> str:
+        """cwd-relative -> absolute filer path (fs.cd semantics)."""
+        import posixpath
+        if not path:
+            return self.cwd
+        if not path.startswith("/"):
+            path = posixpath.join(self.cwd, path)
+        return posixpath.normpath(path)
 
     # -- cluster views -------------------------------------------------------
 

@@ -8,9 +8,10 @@ from .commands import run_command
 from .env import CommandEnv, ShellError
 
 
-def run_shell(master_url: str, commands: list[str] | None = None) -> int:
+def run_shell(master_url: str, commands: list[str] | None = None,
+              filer_url: str | None = None) -> int:
     """REPL against a master; with `commands`, run them and exit."""
-    env = CommandEnv(master_url)
+    env = CommandEnv(master_url, filer_url=filer_url)
     rc = 0
     try:
         if commands:
