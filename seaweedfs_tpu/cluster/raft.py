@@ -88,6 +88,18 @@ class RaftNode:
     def _log_path(self) -> str | None:
         return self.state_path + ".log" if self.state_path else None
 
+    @staticmethod
+    def _fsync_dir(path: str) -> None:
+        """fsync the parent directory so a rename/create survives power
+        loss — without this the fsynced file's directory entry may
+        still be lost, forgetting a granted vote."""
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
     def _load_state(self) -> None:
         if not self.state_path:
             return
@@ -120,16 +132,24 @@ class RaftNode:
         with open(tmp, "w") as f:
             json.dump({"term": self.current_term,
                        "voted_for": self.voted_for}, f)
+            f.flush()
+            os.fsync(f.fileno())  # a granted vote must survive power loss
         os.replace(tmp, self.state_path)
+        self._fsync_dir(self.state_path)
 
     def _append_log(self, entries: list[dict]) -> None:
         path = self._log_path()
         if not path or not entries:
             return
+        created = not os.path.exists(path)
         with open(path, "a") as f:
             for e in entries:
                 f.write(json.dumps(e, separators=(",", ":")) + "\n")
             f.flush()
+            # An acked log suffix is a durability promise to the leader.
+            os.fsync(f.fileno())
+        if created:
+            self._fsync_dir(path)
 
     def _rewrite_log(self) -> None:
         path = self._log_path()
@@ -139,7 +159,10 @@ class RaftNode:
         with open(tmp, "w") as f:
             for e in self.log:
                 f.write(json.dumps(e, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        self._fsync_dir(path)
 
     def _save_state(self) -> None:  # kept for vote/term call sites
         self._save_meta()
@@ -253,9 +276,14 @@ class RaftNode:
     # -- state transitions ---------------------------------------------------
 
     def _become_follower(self, term: int, leader: str | None) -> None:
+        # Election safety: a vote binds to a term — only forget it when
+        # the term actually advances.  The same-term step-down path
+        # (leader discovery) must keep voted_for or a node could grant
+        # two votes in one term (two leaders possible).
+        if term > self.current_term:
+            self.voted_for = None
         self.current_term = term
         self.state = FOLLOWER
-        self.voted_for = None
         if leader is not None:
             self.leader_id = leader
         self._save_state()

@@ -269,7 +269,16 @@ class VolumeServer:
             except VolumeError as e:
                 raise rpc.RpcError(403, str(e)) from None
             return (200, b"", {"Content-Length": str(len(n.data))})
-        return (200, b"", {})  # EC probe: shard-level check is costly
+        # EC probe: locate-only (.ecx binary search + .ecj check) —
+        # reports 404 for absent/deleted needles without reconstructing
+        # any data.
+        ev = self.ec_volumes[vid]
+        self._ensure_ec_version(ev)
+        try:
+            ev.locate_needle(key)
+        except NeedleNotFound as e:
+            raise rpc.RpcError(404, str(e)) from None
+        return (200, b"", {})
 
     def _get_needle(self, path: str, query: dict, body: bytes):
         vid, key, cookie = self._parse_fid_path(path)
@@ -424,10 +433,11 @@ class VolumeServer:
 
     def _check_write_jwt(self, path: str, query: dict) -> None:
         """JWT gate on the write path (volume_server_handlers.go
-        maybeCheckJwtAuthorization) — replica fan-out is intra-cluster
-        and rides the original client's authorization."""
-        if not self.guard.signing_key or \
-                query.get("type") == "replicate":
+        maybeCheckJwtAuthorization).  Replicated writes are NOT exempt:
+        the fan-out forwards the original client's jwt query param and
+        each replica re-verifies it, matching store_replicate.go which
+        forwards the JWT and still runs the auth check on replicas."""
+        if not self.guard.signing_key:
             return
         from ..utils.security import JwtError
         fid = urllib.parse.unquote(path.lstrip("/"))

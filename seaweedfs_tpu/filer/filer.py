@@ -322,16 +322,20 @@ class Filer:
                 sigs.append(s)
         ev = MetaEvent(directory, old, new, signatures=sigs)
         with self._log_lock:
+            # Append first: MetaLog may bump ts_ns to keep timestamps
+            # strictly increasing; the queue and live subscribers must
+            # see the same final timestamp as the journal.
+            d = ev.to_dict()
+            ev.ts_ns = self.meta_log.append(d)
             # Queue publish rides under the log lock so queue order can
             # never diverge from meta-log order.
             if self.notification_queue is not None:
                 try:
                     self.notification_queue.publish(
                         (new or old).path if (new or old) else directory,
-                        ev.to_dict())
+                        d)
                 except Exception:  # noqa: BLE001 — a dead queue must
                     pass           # not block namespace mutations
-            self.meta_log.append(ev.to_dict())
             # Deliver under the lock: a subscriber mid-replay in
             # subscribe() must not observe newer events first.
             for fn in list(self._subscribers):

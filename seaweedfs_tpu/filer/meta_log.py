@@ -67,14 +67,24 @@ class MetaLog:
 
     # -- write ---------------------------------------------------------------
 
-    def append(self, event: dict) -> None:
+    def append(self, event: dict) -> int:
+        """Append and return the (possibly adjusted) ts_ns.
+
+        ts_ns is forced strictly increasing (topic_log's max(now, last+1)
+        rule): subscribers page with a strict `> since_ns` cursor, so two
+        events sharing a boundary timestamp would be silently skipped
+        between pages.  The dict is adjusted in place so the caller can
+        propagate the final timestamp to live subscribers.
+        """
         with self._lock:
-            self._last_ts = max(self._last_ts, event["ts_ns"])
+            if event["ts_ns"] <= self._last_ts:
+                event["ts_ns"] = self._last_ts + 1
+            self._last_ts = event["ts_ns"]
             self._ring.append(event)
             if len(self._ring) > self.capacity:
                 self._ring = self._ring[-self.capacity:]
             if self.dir is None:
-                return
+                return event["ts_ns"]
             line = json.dumps(event, separators=(",", ":")) + "\n"
             data = line.encode()
             if self._seg_file is None or \
@@ -83,6 +93,7 @@ class MetaLog:
             self._seg_file.write(data)
             self._seg_file.flush()
             self._seg_size += len(data)
+            return event["ts_ns"]
 
     def _rotate(self, first_ts_ns: int) -> None:
         if self._seg_file is not None:

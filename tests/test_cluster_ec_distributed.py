@@ -78,6 +78,20 @@ def test_remote_shard_reads(cluster):
                 assert bytes(data) == expect
 
 
+def test_head_on_ec_volume_checks_existence(cluster):
+    """HEAD on an EC volume must be a locate-only probe: 200 for live
+    needles, 404 for absent keys — never a blind 200."""
+    master, servers = cluster
+    client = WeedClient(master.url())
+    vid, fids = _spread(master, servers, client)
+    url = servers[0].url()
+    assert rpc.call(f"http://{url}/{fids[0]}", "HEAD") is not None
+    cookie = fids[0].split(",")[1][-8:]
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{url}/{vid},deadbeef{cookie}", "HEAD")
+    assert ei.value.status == 404
+
+
 def test_reconstruction_across_servers(cluster):
     master, servers = cluster
     client = WeedClient(master.url())

@@ -32,6 +32,26 @@ def test_meta_log_memory_ring():
                                  {"ts_ns": 10, "n": 9}]
 
 
+def test_meta_log_forces_strictly_increasing_ts():
+    """Events sharing a boundary ts_ns would be skipped by the strict
+    `> since_ns` paging cursor; MetaLog bumps duplicates (topic_log's
+    max(now, last+1) rule) and reports the final ts to the caller."""
+    log = MetaLog(None)
+    assert log.append({"ts_ns": 100, "n": 0}) == 100
+    assert log.append({"ts_ns": 100, "n": 1}) == 101
+    assert log.append({"ts_ns": 50, "n": 2}) == 102
+    # Paging with the strict cursor sees every event exactly once.
+    seen = []
+    since = 0
+    while True:
+        page = log.read_since(since, limit=1)
+        if not page:
+            break
+        seen.extend(e["n"] for e in page)
+        since = page[-1]["ts_ns"]
+    assert seen == [0, 1, 2]
+
+
 def test_meta_log_persists_and_replays(tmp_path):
     d = str(tmp_path / "log")
     log = MetaLog(d, capacity=2)  # tiny ring: force disk replay

@@ -123,6 +123,37 @@ def test_raft_state_persistence(tmp_path):
     assert node2.current_term >= 1
 
 
+def test_raft_same_term_stepdown_keeps_vote(tmp_path):
+    """Election safety: a node that voted in term T and then steps down
+    on a same-term AppendEntries must NOT grant a second vote in T."""
+    node = RaftNode("http://me", ["http://me", "http://a", "http://b"],
+                    apply_fn=lambda cmd: None,
+                    state_path=str(tmp_path / "n.json"))
+    # Vote for candidate A in term 5.
+    out = node._h_request_vote({}, json.dumps(
+        {"term": 5, "candidate_id": "http://a",
+         "last_log_index": 0, "last_log_term": 0}).encode())
+    assert out["vote_granted"]
+    # Same-term heartbeat from (split-vote would make this impossible in
+    # a healthy cluster, but a candidate steps down the same way).
+    node.state = "candidate"
+    node._h_append_entries({}, json.dumps(
+        {"term": 5, "leader_id": "http://a", "prev_log_index": 0,
+         "prev_log_term": 0, "entries": [],
+         "leader_commit": 0}).encode())
+    assert node.voted_for == "http://a"  # vote survives the step-down
+    # A second candidate in the SAME term must be refused.
+    out = node._h_request_vote({}, json.dumps(
+        {"term": 5, "candidate_id": "http://b",
+         "last_log_index": 0, "last_log_term": 0}).encode())
+    assert not out["vote_granted"]
+    # A HIGHER term clears the vote as usual.
+    out = node._h_request_vote({}, json.dumps(
+        {"term": 6, "candidate_id": "http://b",
+         "last_log_index": 0, "last_log_term": 0}).encode())
+    assert out["vote_granted"]
+
+
 # -- multi-master HA -------------------------------------------------------
 
 

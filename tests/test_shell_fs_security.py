@@ -120,6 +120,17 @@ def test_jwt_secured_cluster(tmp_path):
         # default).
         fid2 = client.upload_data(b"again")
         assert rpc.call(f"http://{a['url']}/{fid2}") == b"again"
+        # type=replicate is NOT an auth bypass: replicated writes carry
+        # the original jwt and are re-verified (store_replicate.go
+        # forwards the JWT; replicas still run the auth check).
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.call(f"http://{a['url']}/{a['fid']}?type=replicate",
+                     "POST", b"nope")
+        assert ei.value.status == 401
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.call(f"http://{a['url']}/{a['fid']}?type=replicate",
+                     "DELETE")
+        assert ei.value.status == 401
     finally:
         vs.stop()
         master.stop()
