@@ -1,0 +1,226 @@
+#!/usr/bin/env python3
+"""End-to-end pipeline benchmarks — the BASELINE.md "to be measured" rows.
+
+Three real-path measurements (one JSON line each on stdout):
+
+1. `ec.encode` of a generated volume on the CPU via the native AVX2
+   coder — the analog of the reference's klauspost/reedsolomon path
+   (`weed shell ec.encode`, ec_encoder.go:194).  This is the baseline
+   the TPU path is measured against.
+2. The same `write_ec_files` end-to-end with the device coder —
+   INCLUDING disk reads, host->device transfer, kernel, device->host,
+   and shard-file writes.  This is the honest production number, not
+   the HBM-resident kernel number `bench.py` reports.
+3. `weed benchmark` write + random read over a live in-process
+   master + volume server (reference README numbers: 15,708 write /
+   47,019 read req/s on a MacBook i7).
+
+Knobs: BENCH_E2E_VOL_MB (volume size, default 1024), BENCH_E2E_N
+(benchmark file count, default 20000), BENCH_E2E_DEVICE=0 to skip the
+device pass (e.g. when the chip is busy).
+
+Diagnostics on stderr; stdout carries exactly one JSON line per metric.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REF_WRITE_RPS = 15708.23   # reference README.md:496-503
+REF_READ_RPS = 47019.38    # reference README.md:522-529
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def emit(metric: str, value: float, unit: str,
+         vs_baseline: float | None, note: str) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": unit,
+                      "vs_baseline": round(vs_baseline, 3)
+                      if vs_baseline else None,
+                      "note": note}), flush=True)
+
+
+def generate_volume(dir_: str, vid: int, size_mb: int) -> str:
+    """Fill a volume with ~64KB needles until it reaches size_mb."""
+    import numpy as np
+
+    from seaweedfs_tpu.core.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(dir_, "", vid)
+    rng = np.random.default_rng(0)
+    payload_size = 64 * 1024
+    target = size_mb * 1024 * 1024
+    key = 0
+    t0 = time.perf_counter()
+    while v.dat_size() < target:
+        key += 1
+        data = rng.integers(0, 256, payload_size, dtype=np.uint8).tobytes()
+        v.write_needle(Needle(cookie=0x1234, id=key, data=data))
+    v.sync()
+    base = v.file_name()
+    v.close()
+    log(f"generated volume {vid}: {os.path.getsize(base + '.dat') / 1e6:.0f}"
+        f" MB, {key} needles in {time.perf_counter() - t0:.1f}s")
+    return base
+
+
+def bench_ec_encode(base: str, backend: str, chunk_mb: int = 8) -> float:
+    """Time write_ec_files + .ecx generation; returns dat MB/s."""
+    from seaweedfs_tpu.ec.encoder import (write_ec_files,
+                                          write_sorted_file_from_idx)
+    from seaweedfs_tpu.ops.erasure import new_coder
+
+    coder = new_coder(backend=backend)
+    dat_size = os.path.getsize(base + ".dat")
+    t0 = time.perf_counter()
+    write_ec_files(base, coder=coder,
+                   chunk_size=chunk_mb * 1024 * 1024)
+    write_sorted_file_from_idx(base)
+    dt = time.perf_counter() - t0
+    for i in range(14):
+        ext = f".ec{i:02d}"
+        assert os.path.exists(base + ext), f"missing {ext}"
+    mbps = dat_size / dt / 1e6
+    log(f"ec.encode[{backend}]: {dat_size / 1e6:.0f} MB in {dt:.2f}s "
+        f"= {mbps:.1f} MB/s")
+    return mbps
+
+
+def cleanup_shards(base: str) -> None:
+    for i in range(14):
+        try:
+            os.unlink(base + f".ec{i:02d}")
+        except OSError:
+            pass
+    try:
+        os.unlink(base + ".ecx")
+    except OSError:
+        pass
+
+
+def bench_weed_benchmark(n: int, size: int = 1024, concurrency: int = 32,
+                         procs: int = 4,
+                         volume_servers: int = 4) -> tuple[dict, dict]:
+    """weed benchmark against a real multi-process cluster.
+
+    Servers run as subprocesses (`python -m seaweedfs_tpu master|volume`)
+    and the load generator forks `procs` client processes — the same
+    process topology as benchmarking the reference's Go binaries (one
+    Python process would serialize client AND servers on the GIL and
+    measure the interpreter, not the system).
+    """
+    import subprocess
+    import urllib.request
+
+    from seaweedfs_tpu.command.benchmark_cmd import run_benchmark
+    from seaweedfs_tpu.command import Flags
+    from seaweedfs_tpu.cluster.rpc import free_port
+
+    tmp = tempfile.mkdtemp(prefix="bench_weed_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    served: list = []
+
+    def spawn(*argv):
+        p = subprocess.Popen([sys.executable, "-m", "seaweedfs_tpu",
+                              *argv], env=env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        served.append(p)
+        return p
+
+    def wait_http(url, deadline=15.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline:
+            try:
+                urllib.request.urlopen(url, timeout=1).read()
+                return
+            except Exception:  # noqa: BLE001
+                time.sleep(0.1)
+        raise RuntimeError(f"server at {url} did not come up")
+
+    mport = free_port()
+    try:
+        spawn("master", f"-port={mport}", f"-mdir={tmp}/m",
+              "-volumeSizeLimitMB=1024")
+        wait_http(f"http://127.0.0.1:{mport}/dir/status")
+        for i in range(volume_servers):
+            vport = free_port()
+            os.makedirs(f"{tmp}/v{i}")
+            spawn("volume", f"-port={vport}", f"-dir={tmp}/v{i}",
+                  f"-mserver=127.0.0.1:{mport}", "-max=16")
+            wait_http(f"http://127.0.0.1:{vport}/admin/status")
+        time.sleep(1.0)  # first heartbeats
+        flags = Flags({"master": f"127.0.0.1:{mport}", "n": str(n),
+                       "size": str(size), "c": str(concurrency),
+                       "procs": str(procs)})
+        reports: list = []
+        rc = run_benchmark(flags, [], reports=reports)
+        assert rc == 0 and len(reports) == 2, (rc, reports)
+        return reports[0], reports[1]
+    finally:
+        for p in served:
+            p.terminate()
+        for p in served:
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> None:
+    vol_mb = int(os.environ.get("BENCH_E2E_VOL_MB", "1024"))
+    n = int(os.environ.get("BENCH_E2E_N", "20000"))
+    do_device = os.environ.get("BENCH_E2E_DEVICE", "1") == "1"
+
+    tmp = tempfile.mkdtemp(prefix="bench_e2e_")
+    try:
+        base = generate_volume(tmp, 1, vol_mb)
+
+        cpu_mbps = bench_ec_encode(base, "native")
+        emit(f"ec.encode {vol_mb}MB volume, CPU native AVX2",
+             cpu_mbps, "MB/s", None,
+             "reference-class CPU path (klauspost AVX2 analog); "
+             "includes disk read + shard-file writes + .ecx")
+        cleanup_shards(base)
+
+        if do_device:
+            try:
+                import jax
+                platform = jax.devices()[0].platform
+                dev_mbps = bench_ec_encode(base, "pallas", chunk_mb=32)
+                emit(f"ec.encode {vol_mb}MB volume, device end-to-end",
+                     dev_mbps, "MB/s",
+                     dev_mbps / cpu_mbps if cpu_mbps else None,
+                     f"write_ec_files on {platform}: disk -> host -> "
+                     "device -> kernel -> host -> shard files")
+                cleanup_shards(base)
+            except Exception as e:  # noqa: BLE001
+                log(f"device pass skipped: {type(e).__name__}: {e}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    wr, rd = bench_weed_benchmark(n)
+    emit("weed benchmark write req/s", wr["req_per_sec"], "req/s",
+         wr["req_per_sec"] / REF_WRITE_RPS,
+         f"n={n} 1KB c=16 vs reference MacBook 15708 req/s; "
+         f"p99 {wr['latency_ms']['p99']}ms")
+    emit("weed benchmark random read req/s", rd["req_per_sec"], "req/s",
+         rd["req_per_sec"] / REF_READ_RPS,
+         f"n={n} 1KB c=16 vs reference MacBook 47019 req/s; "
+         f"p99 {rd['latency_ms']['p99']}ms")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

@@ -3,10 +3,22 @@
 The reference runs gRPC (control) + HTTP (data) between roles
 (weed/pb/*.proto, SURVEY §2.4).  This build keeps the same service shapes
 — Assign/Lookup/heartbeat/allocate/EC RPCs with the same field names — but
-carries them as JSON over HTTP on a threading server: zero-dependency,
-debuggable with curl, and swappable for gRPC later without touching the
-handlers.  The bulk EC compute plane is jax collectives (parallel/), not
-these RPCs.
+carries them as JSON over HTTP: zero-dependency, debuggable with curl, and
+swappable for gRPC later without touching the handlers.  The bulk EC
+compute plane is jax collectives (parallel/), not these RPCs.
+
+Both halves are hand-rolled for per-request CPU, because on the write/read
+hot path the HTTP framing IS the workload (the storage op itself is
+~0.13ms): the server is a thread-per-connection keep-alive loop with a
+~30-line parser (http.server's BaseHTTPRequestHandler burns ~0.3ms/request
+in email.parser), and the client is a raw-socket keep-alive pool
+(http.client spends ~0.25ms/request the same way).  The reference's Go
+net/http does the equivalent in microseconds; this is the Python analog of
+its pooled transports (operation/upload_content.go:67).
+
+TLS: pass an ssl.SSLContext as JsonHttpServer(ssl_context=...) to serve
+https, and install the client side with set_client_ssl_context()
+(security.toml plane, reference weed/security/tls.go).
 """
 
 from __future__ import annotations
@@ -16,11 +28,18 @@ import os
 import socket
 import threading
 import time
-import urllib.error
 import urllib.parse
-import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
+
+_REASONS = {200: "OK", 201: "Created", 204: "No Content",
+            206: "Partial Content", 301: "Moved Permanently",
+            302: "Found", 304: "Not Modified", 307: "Temporary Redirect",
+            400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
+            404: "Not Found", 405: "Method Not Allowed",
+            406: "Not Acceptable", 409: "Conflict",
+            412: "Precondition Failed", 416: "Range Not Satisfiable",
+            423: "Locked", 500: "Internal Server Error",
+            501: "Not Implemented", 503: "Service Unavailable"}
 
 
 class RpcError(Exception):
@@ -36,25 +55,57 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def _read_headers(rf) -> dict[str, str]:
+    """Read header lines into a lowercase-keyed dict."""
+    headers: dict[str, str] = {}
+    while True:
+        line = rf.readline(65537)
+        if line in (b"\r\n", b"\n", b""):
+            return headers
+        i = line.find(b":")
+        if i > 0:
+            headers[line[:i].decode("latin-1").strip().lower()] = \
+                line[i + 1:].decode("latin-1").strip()
+
+
+def _read_chunked(rf) -> bytes:
+    """Minimal Transfer-Encoding: chunked body reader."""
+    out = bytearray()
+    while True:
+        line = rf.readline(65537)
+        if not line:
+            raise ConnectionError("eof in chunked body")
+        size = int(line.split(b";")[0].strip() or b"0", 16)
+        if size == 0:
+            # trailers until blank line
+            while rf.readline(65537) not in (b"\r\n", b"\n", b""):
+                pass
+            return bytes(out)
+        out += rf.read(size)
+        rf.read(2)  # CRLF
+
+
 class JsonHttpServer:
-    """Route table -> threading HTTP server.
+    """Route table -> threaded keep-alive HTTP server.
 
     Handlers: fn(query: dict, body: bytes) -> dict | bytes | tuple.
     Returning bytes sends application/octet-stream; a (status, dict)
-    tuple sets the status code.
+    tuple sets the status code; a 3-tuple adds extra headers; a
+    file-like payload is streamed.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 pass_headers: bool = False):
+                 pass_headers: bool = False, ssl_context=None):
         self.host = host
         self.port = port or free_port()
         self.pass_headers = pass_headers
+        self.ssl_context = ssl_context
         self.routes: dict[tuple[str, str], Callable] = {}
         self.prefix_routes: list[tuple[str, str, Callable]] = []
-        self._httpd: ThreadingHTTPServer | None = None
-        self._thread: threading.Thread | None = None
         self.metrics = None  # (Registry, Counter, Histogram) when on
         self._metrics_route = False
+        self._sock: socket.socket | None = None
+        self._running = False
 
     def serve_metrics_route(self, registry) -> None:
         """Route GET /metrics -> the registry's text exposition."""
@@ -91,219 +142,452 @@ class JsonHttpServer:
         self.prefix_routes.append((method, prefix, fn))
 
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.ssl_context else "http"
+        return f"{scheme}://{self.host}:{self.port}"
+
+    # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        server = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):  # quiet
-                pass
-
-            def _dispatch(self, method: str):
-                parsed = urllib.parse.urlparse(self.path)
-                # keep_blank_values: S3-style flag params (?uploads,
-                # ?tagging, ?delete) have no '=value'.
-                query = {k: v[0] for k, v in urllib.parse.parse_qs(
-                    parsed.query, keep_blank_values=True).items()}
-                # Select request headers handlers care about (Range for
-                # partial reads, Content-Type for upload mime) ride along
-                # in the query dict under reserved keys.
-                if self.headers.get("Range"):
-                    query["_range_header"] = self.headers["Range"]
-                if self.headers.get("Content-Type"):
-                    query["_content_type"] = self.headers["Content-Type"]
-                if server.pass_headers:
-                    # Full header dict + raw query string for handlers
-                    # that authenticate requests (S3 sig v4 needs the
-                    # exact header set and query encoding).
-                    query["_headers"] = {k.lower(): v for k, v
-                                         in self.headers.items()}
-                    query["_raw_query"] = parsed.query
-                    query["_method"] = method
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                fn = server.routes.get((method, parsed.path))
-                args = (query, body)
-                if fn is None:
-                    for m, prefix, pfn in server.prefix_routes:
-                        if m == method and parsed.path.startswith(prefix):
-                            fn = pfn
-                            args = (parsed.path, query, body)
-                            break
-                if fn is None:
-                    self._send(404, {"error": f"no route {method} "
-                                              f"{parsed.path}"})
-                    return
-                metrics = server.metrics
-                t0 = time.perf_counter() if metrics else 0.0
-                try:
-                    result = fn(*args)
-                except RpcError as e:
-                    self._send(e.status, {"error": e.message})
-                    return
-                except Exception as e:  # noqa: BLE001
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
-                    return
-                finally:
-                    # Exclude /metrics only where it IS the scrape
-                    # endpoint; on gateways it's a user path to count.
-                    if metrics and not (server._metrics_route
-                                        and parsed.path == "/metrics"):
-                        _reg, counter, hist = metrics
-                        counter.inc(type=method)
-                        hist.observe(time.perf_counter() - t0,
-                                     type=method)
-                extra = None
-                if isinstance(result, tuple):
-                    if len(result) == 3:
-                        status, payload, extra = result
-                    else:
-                        status, payload = result
-                else:
-                    status, payload = 200, result
-                self._send(status, payload, extra)
-
-            def _send(self, status: int, payload, extra=None):
-                if hasattr(payload, "read"):
-                    # Stream any file-like payload (open file, upstream
-                    # HTTP response) without buffering it: O(1MB) memory
-                    # per in-flight large read.
-                    import shutil
-                    extra = dict(extra or {})
-                    ctype = extra.pop("Content-Type",
-                                      "application/octet-stream")
-                    size = extra.pop("Content-Length", None)
-                    if size is None:
-                        size = str(os.fstat(payload.fileno()).st_size)
-                    self.send_response(status)
-                    self.send_header("Content-Type", ctype)
-                    self.send_header("Content-Length", str(size))
-                    for k, v in extra.items():
-                        self.send_header(k, v)
-                    self.end_headers()
-                    with payload:
-                        if self.command != "HEAD":
-                            shutil.copyfileobj(payload, self.wfile,
-                                               length=1 << 20)
-                    return
-                extra = dict(extra or {})
-                if isinstance(payload, (bytes, bytearray)):
-                    data = bytes(payload)
-                    ctype = extra.pop("Content-Type",
-                                      "application/octet-stream")
-                else:
-                    data = json.dumps(payload or {}).encode()
-                    ctype = extra.pop("Content-Type", "application/json")
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                # HEAD handlers advertise the real body size without
-                # materializing it.
-                clen = extra.pop("Content-Length", str(len(data)))
-                self.send_header("Content-Length", clen)
-                for k, v in extra.items():
-                    self.send_header(k, v)
-                self.end_headers()
-                if self.command != "HEAD":
-                    self.wfile.write(data)
-
-            def do_GET(self):
-                self._dispatch("GET")
-
-            def do_HEAD(self):
-                self._dispatch("HEAD")
-
-            def do_POST(self):
-                self._dispatch("POST")
-
-            def do_PUT(self):
-                self._dispatch("PUT")
-
-            def do_DELETE(self):
-                self._dispatch("DELETE")
-
-            # WebDAV verbs (gateways route them like any other method)
-
-            def do_OPTIONS(self):
-                self._dispatch("OPTIONS")
-
-            def do_PROPFIND(self):
-                self._dispatch("PROPFIND")
-
-            def do_PROPPATCH(self):
-                self._dispatch("PROPPATCH")
-
-            def do_MKCOL(self):
-                self._dispatch("MKCOL")
-
-            def do_MOVE(self):
-                self._dispatch("MOVE")
-
-            def do_COPY(self):
-                self._dispatch("COPY")
-
-            def do_LOCK(self):
-                self._dispatch("LOCK")
-
-            def do_UNLOCK(self):
-                self._dispatch("UNLOCK")
-
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
-        self._httpd.daemon_threads = True
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name=f"http:{self.port}",
-                                        daemon=True)
-        self._thread.start()
+        import sys as _sys
+        if _sys.getswitchinterval() > 0.001:
+            # Thread-per-connection + the default 5ms GIL switch
+            # interval convoys request latency to ~5ms p50 under
+            # concurrent load; 1ms keeps handler threads responsive.
+            _sys.setswitchinterval(0.001)
+        self._sock = socket.create_server((self.host, self.port),
+                                          backlog=128)
+        self._running = True
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"http:{self.port}").start()
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        self._running = False
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    # -- connection loop -----------------------------------------------------
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            if self.ssl_context is not None:
+                # Handshake in the connection thread so a slow/bogus
+                # client can't stall the accept loop.
+                conn = self.ssl_context.wrap_socket(conn, server_side=True)
+            conn.settimeout(120.0)
+            rf = conn.makefile("rb", buffering=1 << 16)
+            while self._running:
+                if not self._serve_one(conn, rf):
+                    return
+        except Exception:  # noqa: BLE001 — peer reset / TLS failure / ...
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_one(self, conn, rf) -> bool:
+        """Handle one request; returns False when the connection is done."""
+        line = rf.readline(65537)
+        if not line:
+            return False
+        try:
+            method, target, version = \
+                line.decode("latin-1").rstrip("\r\n").split(" ", 2)
+        except ValueError:
+            self._respond(conn, "GET", 400, {"error": "bad request line"},
+                          None, close=True)
+            return False
+        headers = _read_headers(rf)
+        if headers.get("expect", "").lower() == "100-continue":
+            conn.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+        if headers.get("transfer-encoding", "").lower() == "chunked":
+            body = _read_chunked(rf)
+        else:
+            clen = int(headers.get("content-length") or 0)
+            body = rf.read(clen) if clen else b""
+            if clen and len(body) < clen:
+                return False  # truncated request
+        keep = (version == "HTTP/1.1"
+                and headers.get("connection", "").lower() != "close")
+
+        parsed = urllib.parse.urlparse(target)
+        # keep_blank_values: S3-style flag params (?uploads, ?tagging,
+        # ?delete) have no '=value'.
+        query = {k: v[0] for k, v in urllib.parse.parse_qs(
+            parsed.query, keep_blank_values=True).items()}
+        # Select request headers handlers care about (Range for partial
+        # reads, Content-Type for upload mime) ride along in the query
+        # dict under reserved keys.
+        if "range" in headers:
+            query["_range_header"] = headers["range"]
+        if "content-type" in headers:
+            query["_content_type"] = headers["content-type"]
+        if self.pass_headers:
+            # Full header dict + raw query string for handlers that
+            # authenticate requests (S3 sig v4 needs the exact header
+            # set and query encoding).
+            query["_headers"] = headers
+            query["_raw_query"] = parsed.query
+            query["_method"] = method
+
+        fn = self.routes.get((method, parsed.path))
+        args = (query, body)
+        if fn is None:
+            for m, prefix, pfn in self.prefix_routes:
+                if m == method and parsed.path.startswith(prefix):
+                    fn = pfn
+                    args = (parsed.path, query, body)
+                    break
+        if fn is None:
+            self._respond(conn, method, 404,
+                          {"error": f"no route {method} {parsed.path}"},
+                          None, close=not keep)
+            return keep
+
+        metrics = self.metrics
+        t0 = time.perf_counter() if metrics else 0.0
+        try:
+            result = fn(*args)
+        except RpcError as e:
+            self._respond(conn, method, e.status, {"error": e.message},
+                          None, close=not keep)
+            return keep
+        except Exception as e:  # noqa: BLE001
+            self._respond(conn, method, 500,
+                          {"error": f"{type(e).__name__}: {e}"},
+                          None, close=not keep)
+            return keep
+        finally:
+            # Exclude /metrics only where it IS the scrape endpoint; on
+            # gateways it's a user path to count.
+            if metrics and not (self._metrics_route
+                                and parsed.path == "/metrics"):
+                _reg, counter, hist = metrics
+                counter.inc(type=method)
+                hist.observe(time.perf_counter() - t0, type=method)
+
+        extra = None
+        if isinstance(result, tuple):
+            if len(result) == 3:
+                status, payload, extra = result
+            else:
+                status, payload = result
+        else:
+            status, payload = 200, result
+        self._respond(conn, method, status, payload, extra,
+                      close=not keep)
+        return keep
+
+    def _respond(self, conn, method: str, status: int, payload,
+                 extra=None, close: bool = False) -> None:
+        extra = dict(extra or {})
+        reason = _REASONS.get(status, "Unknown")
+        head = [f"HTTP/1.1 {status} {reason}"]
+
+        if hasattr(payload, "read"):
+            # Stream any file-like payload (open file, upstream HTTP
+            # response) without buffering it: O(1MB) memory per
+            # in-flight large read.
+            ctype = extra.pop("Content-Type", "application/octet-stream")
+            size = extra.pop("Content-Length", None)
+            if size is None:
+                size = str(os.fstat(payload.fileno()).st_size)
+            head.append(f"Content-Type: {ctype}")
+            head.append(f"Content-Length: {size}")
+            for k, v in extra.items():
+                head.append(f"{k}: {v}")
+            if close:
+                head.append("Connection: close")
+            conn.sendall(("\r\n".join(head) + "\r\n\r\n").encode("latin-1"))
+            with payload:
+                if method != "HEAD":
+                    while True:
+                        chunk = payload.read(1 << 20)
+                        if not chunk:
+                            break
+                        conn.sendall(chunk)
+            return
+
+        if isinstance(payload, (bytes, bytearray)):
+            data = bytes(payload)
+            ctype = extra.pop("Content-Type", "application/octet-stream")
+        else:
+            data = json.dumps(payload or {}).encode()
+            ctype = extra.pop("Content-Type", "application/json")
+        head.append(f"Content-Type: {ctype}")
+        # HEAD handlers advertise the real body size without
+        # materializing it.
+        head.append(f"Content-Length: {extra.pop('Content-Length', None) or len(data)}")
+        for k, v in extra.items():
+            head.append(f"{k}: {v}")
+        if close:
+            head.append("Connection: close")
+        buf = ("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+        if method != "HEAD":
+            buf += data
+        conn.sendall(buf)
+
+
+# -- pooled HTTP client ------------------------------------------------------
+# The reference's hot path assumes connection reuse (its Go http.Client
+# pools transport connections; operation/upload_content.go:67).  A fresh
+# TCP handshake per RPC capped the write path at ~360 req/s in bench_e2e,
+# and http.client's email.parser header handling costs another
+# ~0.25ms/request; this is a raw-socket keep-alive pool.
+
+_client_ssl_context = None
+
+
+def set_client_ssl_context(ctx) -> None:
+    """Install the ssl.SSLContext used for https:// RPCs (security.toml
+    TLS plane — see utils/security)."""
+    global _client_ssl_context
+    _client_ssl_context = ctx
+
+
+class _Conn:
+    """One pooled keep-alive connection."""
+
+    __slots__ = ("sock", "rf", "key")
+
+    def __init__(self, sock: socket.socket, key: tuple):
+        self.sock = sock
+        self.rf = sock.makefile("rb", buffering=1 << 16)
+        self.key = key
+
+    def close(self) -> None:
+        try:
+            self.rf.close()
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _Resp:
+    """Response with lazily-read body (callers stream or read())."""
+
+    __slots__ = ("status", "reason", "headers", "_rf", "_remaining",
+                 "_chunks", "will_close", "_done")
+
+    def __init__(self, status, reason, headers, rf):
+        self.status = status
+        self.reason = reason
+        self.headers = headers
+        self._rf = rf
+        self.will_close = headers.get("connection", "").lower() == "close"
+        self._chunks = headers.get("transfer-encoding",
+                                   "").lower() == "chunked"
+        if self._chunks:
+            self._remaining = -1
+        else:
+            clen = headers.get("content-length")
+            if clen is None:
+                self.will_close = True  # read-until-close body
+                self._remaining = -1
+            else:
+                self._remaining = int(clen)
+        self._done = False
+
+    def getheader(self, name: str, default=None):
+        return self.headers.get(name.lower(), default)
+
+    def read(self, n: int = -1) -> bytes:
+        if self._done:
+            return b""
+        if self._chunks:
+            # Simple strategy: drain the whole chunked body once.
+            data = _read_chunked(self._rf)
+            self._done = True
+            return data
+        if self._remaining < 0:  # until close
+            data = self._rf.read() if n < 0 else self._rf.read(n)
+            if not data or n < 0:
+                self._done = True
+            return data
+        want = self._remaining if n < 0 else min(n, self._remaining)
+        data = self._rf.read(want) if want else b""
+        self._remaining -= len(data)
+        if self._remaining == 0 or (want and not data):
+            self._done = True
+        return data
+
+
+class _ConnPool:
+    def __init__(self, max_idle_per_host: int = 32):
+        self.max_idle = max_idle_per_host
+        self._idle: dict[tuple, list[_Conn]] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, scheme: str, host: str, port: int,
+                timeout: float):
+        """Returns (conn, was_reused)."""
+        key = (scheme, host, port)
+        with self._lock:
+            pool = self._idle.get(key)
+            if pool:
+                conn = pool.pop()
+                conn.sock.settimeout(timeout)
+                return conn, True
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if scheme == "https":
+            import ssl
+            ctx = _client_ssl_context or ssl.create_default_context()
+            sock = ctx.wrap_socket(sock, server_hostname=host)
+        return _Conn(sock, key), False
+
+    def release(self, conn: _Conn) -> None:
+        with self._lock:
+            pool = self._idle.setdefault(conn.key, [])
+            if len(pool) < self.max_idle:
+                pool.append(conn)
+                return
+        conn.close()
+
+
+_pool = _ConnPool()
+
+
+def _request(url: str, method: str, body, timeout: float,
+             max_redirects: int = 3):
+    """One pooled request; returns (_Resp, _Conn) with the body NOT yet
+    read (callers stream or read()).  Retries exactly once on a stale
+    reused keep-alive connection (failure before any response bytes)."""
+    u = urllib.parse.urlsplit(url)
+    scheme = u.scheme or "http"
+    host = u.hostname or "127.0.0.1"
+    port = u.port or (443 if scheme == "https" else 80)
+    path = u.path or "/"
+    if u.query:
+        path += "?" + u.query
+    req = (f"{method} {path} HTTP/1.1\r\n"
+           f"Host: {host}:{port}\r\n"
+           f"Content-Length: {len(body) if body else 0}\r\n"
+           "\r\n").encode("latin-1")
+    if body:
+        req += body
+    for attempt in (0, 1):
+        conn, reused = _pool.acquire(scheme, host, port, timeout)
+        try:
+            conn.sock.sendall(req)
+            line = conn.rf.readline(65537)
+            if not line:
+                raise ConnectionResetError("server closed connection")
+            parts = line.decode("latin-1").rstrip("\r\n").split(" ", 2)
+            status = int(parts[1])
+            reason = parts[2] if len(parts) > 2 else ""
+            headers = _read_headers(conn.rf)
+        except (ConnectionResetError, BrokenPipeError):
+            # A reused keep-alive the server closed between our
+            # requests: safe to retry once.  NOT for timeouts — a slow
+            # server may still be processing, and a re-send would run a
+            # non-idempotent RPC twice.
+            conn.close()
+            if reused and attempt == 0:
+                continue
+            raise
+        except Exception:
+            conn.close()
+            raise
+        while status == 100:  # ignore interim responses
+            line = conn.rf.readline(65537)
+            parts = line.decode("latin-1").rstrip("\r\n").split(" ", 2)
+            status = int(parts[1])
+            reason = parts[2] if len(parts) > 2 else ""
+            headers = _read_headers(conn.rf)
+        resp = _Resp(status, reason, headers, conn.rf)
+        if status in (301, 302, 307, 308) and max_redirects > 0:
+            location = resp.getheader("location")
+            if location:
+                resp.read()
+                _finish(conn, resp)
+                return _request(
+                    urllib.parse.urljoin(url, location), method, body,
+                    timeout, max_redirects - 1)
+        return resp, conn
+    raise AssertionError("unreachable")
+
+
+def _finish(conn: _Conn, resp: _Resp) -> None:
+    """Return a fully-read connection to the pool (or close it)."""
+    if resp.will_close or not resp._done:
+        conn.close()
+    else:
+        _pool.release(conn)
+
+
+def _raise_rpc_error(resp: _Resp, data: bytes) -> None:
+    try:
+        message = json.loads(data or b"{}").get(
+            "error", f"HTTP Error {resp.status}: {resp.reason}")
+    except Exception:  # noqa: BLE001
+        message = f"HTTP Error {resp.status}: {resp.reason}"
+    raise RpcError(resp.status, message)
 
 
 def call(url: str, method: str = "GET", body: bytes | None = None,
          timeout: float = 10.0):
     """HTTP call returning parsed JSON (dict) or raw bytes."""
-    req = urllib.request.Request(url, data=body, method=method)
+    resp, conn = _request(url, method, body, timeout)
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        if method == "HEAD":
+            data = b""         # no body follows a HEAD response even
+            resp._done = True  # when Content-Length advertises one
+        else:
             data = resp.read()
-            if resp.headers.get("Content-Type", "").startswith(
-                    "application/json"):
-                return json.loads(data or b"{}")
-            return data
-    except urllib.error.HTTPError as e:
-        try:
-            message = json.loads(e.read() or b"{}").get("error", str(e))
-        except Exception:  # noqa: BLE001
-            message = str(e)
-        raise RpcError(e.code, message) from None
+    except Exception:
+        conn.close()
+        raise
+    _finish(conn, resp)
+    if resp.status >= 400:
+        _raise_rpc_error(resp, data)
+    if (resp.getheader("content-type") or "").startswith(
+            "application/json"):
+        return json.loads(data or b"{}")
+    return data
 
 
 def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:
     """Stream a GET response to a file in chunks; returns byte count.
     Bulk transfers (volume/shard copies) must never buffer a 30GB .dat
     in memory (the reference streams CopyFile in chunks too)."""
-    req = urllib.request.Request(url)
+    resp, conn = _request(url, "GET", None, timeout)
+    if resp.status >= 400:
+        data = resp.read()
+        _finish(conn, resp)
+        _raise_rpc_error(resp, data)
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp, \
-                open(path, "wb") as f:
+        with open(path, "wb") as f:
             total = 0
             while True:
                 chunk = resp.read(1 << 20)
                 if not chunk:
-                    return total
+                    break
                 f.write(chunk)
                 total += len(chunk)
-    except urllib.error.HTTPError as e:
-        try:
-            message = json.loads(e.read() or b"{}").get("error", str(e))
-        except Exception:  # noqa: BLE001
-            message = str(e)
-        raise RpcError(e.code, message) from None
+    except Exception:
+        conn.close()
+        raise
+    _finish(conn, resp)
+    return total
 
 
 def call_json(url: str, method: str = "POST", payload: dict | None = None,

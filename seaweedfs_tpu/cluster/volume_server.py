@@ -484,6 +484,14 @@ class VolumeServer:
                    method: str) -> None:
         """Fan out to sibling replicas (all-or-fail, store_replicate.go)."""
         vid = self._parse_fid_path(path)[0]
+        v = self.store.find_volume(vid)
+        if v is not None and \
+                v.super_block.replica_placement.copy_count() == 1:
+            # Single-copy volumes have no siblings; skip the master
+            # lookup entirely (store_replicate.go consults the volume's
+            # own replica placement the same way) — this is one master
+            # RPC saved per write on the hot path.
+            return
         try:
             lookup = rpc.call(
                 f"{self.master_url}/dir/lookup?volumeId={vid}")

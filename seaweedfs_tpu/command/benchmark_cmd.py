@@ -4,6 +4,12 @@ Reference: weed/command/benchmark.go:26-141 (write then random read
 via assign+upload against a live master, concurrency workers,
 latency percentiles printed by printStats :434, synthetic payloads
 :523).
+
+The reference's goroutine workers share one multi-core Go process;
+Python threads share the GIL, so `-procs=K` (default 4 when c >= 8)
+forks K worker processes each running c/K client threads — the same
+aggregate concurrency with real CPU parallelism.  `-procs=1` keeps
+everything in-process (used by tests).
 """
 
 from __future__ import annotations
@@ -61,16 +67,76 @@ class _Stats:
         return out
 
 
-def run_benchmark(flags: Flags, args: list[str]) -> int:
+def _mp_worker(outq, barrier, master: str, phase: str, count: int,
+               size: int, collection: str, nthreads: int,
+               fids_in: list[str], seed: int) -> None:
+    """One forked load process: nthreads client threads, own stats."""
+    from ..cluster.client import WeedClient
+    client = WeedClient(master)
+    payload = random.Random(7).randbytes(size)
+    stats = _Stats()
+    fids: list[str] = []
+    fid_lock = threading.Lock()
+
+    def w_write(c: int) -> None:
+        for _ in range(c):
+            t0 = time.perf_counter()
+            try:
+                fid = client.upload_data(payload, collection=collection)
+            except Exception:  # noqa: BLE001 — count, keep loading
+                stats.error()
+                continue
+            stats.add(time.perf_counter() - t0, size)
+            with fid_lock:
+                fids.append(fid)
+
+    def w_read(c: int, rng: random.Random) -> None:
+        for _ in range(c):
+            fid = rng.choice(fids_in)
+            t0 = time.perf_counter()
+            try:
+                data = client.download(fid)
+            except Exception:  # noqa: BLE001
+                stats.error()
+                continue
+            stats.add(time.perf_counter() - t0, len(data))
+
+    per = count // nthreads
+    counts = [per + (1 if i < count % nthreads else 0)
+              for i in range(nthreads)]
+    if phase == "write":
+        threads = [threading.Thread(target=w_write, args=(c,), daemon=True)
+                   for c in counts if c]
+    else:
+        threads = [threading.Thread(
+            target=w_read, args=(c, random.Random(seed * 1000 + i)),
+            daemon=True) for i, c in enumerate(counts) if c]
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    outq.put({"lat": stats.latencies_ms, "bytes": stats.bytes,
+              "errors": stats.errors, "fids": fids,
+              "wall": time.perf_counter() - t0})
+
+
+def run_benchmark(flags: Flags, args: list[str],
+                  reports: list | None = None) -> int:
     from ..cluster.client import WeedClient
     master = flags.get("master", "127.0.0.1:9333")
     master = master if master.startswith("http") else f"http://{master}"
     n = flags.get_int("n", 1024)
     size = flags.get_int("size", 1024)
     concurrency = flags.get_int("c", 16)
+    procs = flags.get_int("procs", 4 if concurrency >= 8 else 1)
     do_write = flags.get("write", "true").lower() != "false"
     do_read = flags.get("read", "true").lower() != "false"
     collection = flags.get("collection", "")
+    if procs > 1:
+        return _run_benchmark_mp(master, n, size, concurrency, procs,
+                                 do_write, do_read, collection, reports)
     client = WeedClient(master)
     payload = random.Random(7).randbytes(size)
     fids: list[str] = []
@@ -115,7 +181,9 @@ def run_benchmark(flags: Flags, args: list[str]) -> int:
             t.start()
         for t in threads:
             t.join()
-        stats.report(title, time.perf_counter() - t0)
+        out = stats.report(title, time.perf_counter() - t0)
+        if reports is not None:
+            reports.append(out)
 
     print(f"benchmarking {master}: n={n} size={size}B "
           f"concurrency={concurrency}")
@@ -130,7 +198,65 @@ def run_benchmark(flags: Flags, args: list[str]) -> int:
     return 0
 
 
+def _run_benchmark_mp(master: str, n: int, size: int, concurrency: int,
+                      procs: int, do_write: bool, do_read: bool,
+                      collection: str,
+                      reports: list | None) -> int:
+    """Spawn `procs` load processes per phase and merge their stats."""
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")  # safe even if the parent touched jax
+    nthreads = max(1, concurrency // procs)
+
+    def run_phase(phase: str, fids_in: list[str]) -> list[str]:
+        outq = ctx.Queue()
+        per = n // procs
+        counts = [c for c in
+                  (per + (1 if i < n % procs else 0)
+                   for i in range(procs)) if c]
+        # Barrier parties must match the workers actually spawned, or a
+        # small -n with zero-count slots would deadlock everyone.
+        barrier = ctx.Barrier(len(counts) + 1)
+        workers = [ctx.Process(
+            target=_mp_worker,
+            args=(outq, barrier, master, phase, c, size, collection,
+                  nthreads, fids_in, i), daemon=True)
+            for i, c in enumerate(counts)]
+        for w in workers:
+            w.start()
+        barrier.wait()  # everyone imported and connected; go
+        t0 = time.perf_counter()
+        stats = _Stats()
+        fids: list[str] = []
+        for _ in workers:
+            out = outq.get()
+            stats.latencies_ms.extend(out["lat"])
+            stats.bytes += out["bytes"]
+            stats.errors += out["errors"]
+            fids.extend(out["fids"])
+        wall = time.perf_counter() - t0
+        for w in workers:
+            w.join()
+        title = "write" if phase == "write" else "random read"
+        rep = stats.report(f"{title} ({procs} procs x "
+                           f"{nthreads} threads)", wall)
+        if reports is not None:
+            reports.append(rep)
+        return fids
+
+    print(f"benchmarking {master}: n={n} size={size}B "
+          f"concurrency={concurrency} procs={procs}")
+    fids: list[str] = []
+    if do_write:
+        fids = run_phase("write", [])
+    if do_read:
+        if not fids:
+            print("nothing to read (write phase skipped/failed)")
+            return 1
+        run_phase("read", fids)
+    return 0
+
+
 register(Command(
     "benchmark",
-    "benchmark -master=host:9333 -n=1024 -size=1024 -c=16",
+    "benchmark -master=host:9333 -n=1024 -size=1024 -c=16 -procs=4",
     "write/read load test against a cluster", run_benchmark))

@@ -72,11 +72,19 @@ def _encode_dat_file(dat, dat_size: int, coder: ErasureCoder, outputs,
                           min(chunk_size, large))
         remaining -= large * DATA_SHARDS
         processed += large * DATA_SHARDS
+    # Small-block rows, many per coder call: a volume under 10GB is
+    # ENTIRELY 1MB small rows, and a (10, 1MB) kernel launch is
+    # dispatch-bound on TPU (~13ms fixed cost over the tunnel).  Rows
+    # are column-independent, so K consecutive rows stack into one
+    # (10, K*small) call — same bytes, K fewer launches; each shard's
+    # blocks from consecutive rows are consecutive in its shard file.
+    rows_per_call = max(1, chunk_size // small)
     while remaining > 0:
-        _encode_block_row(dat, processed, small, coder, outputs,
-                          min(chunk_size, small))
-        remaining -= small * DATA_SHARDS
-        processed += small * DATA_SHARDS
+        row_bytes = small * DATA_SHARDS
+        nrows = min(rows_per_call, -(-remaining // row_bytes))
+        _encode_small_rows(dat, processed, small, nrows, coder, outputs)
+        remaining -= row_bytes * nrows
+        processed += row_bytes * nrows
 
 
 def _encode_block_row(dat, start: int, block_size: int, coder: ErasureCoder,
@@ -96,6 +104,30 @@ def _encode_block_row(dat, start: int, block_size: int, coder: ErasureCoder,
             outputs[i].write(data[i].tobytes())
         for p in range(PARITY_SHARDS):
             outputs[DATA_SHARDS + p].write(parity[p].tobytes())
+
+
+def _encode_small_rows(dat, start: int, small: int, nrows: int,
+                       coder: ErasureCoder, outputs) -> None:
+    """Encode `nrows` consecutive small-block rows in ONE coder call.
+
+    Byte-identical to calling _encode_block_row per row: shard i's
+    stacked columns are its blocks from rows r=0..nrows-1, zero-padded
+    at EOF exactly as the per-row path pads."""
+    fd = dat.fileno()
+    data = np.zeros((DATA_SHARDS, nrows * small), dtype=np.uint8)
+    for r in range(nrows):
+        base = start + r * small * DATA_SHARDS
+        col = r * small
+        for i in range(DATA_SHARDS):
+            raw = os.pread(fd, small, base + i * small)
+            if raw:
+                data[i, col:col + len(raw)] = \
+                    np.frombuffer(raw, dtype=np.uint8)
+    parity = np.asarray(coder.encode(data))
+    for i in range(DATA_SHARDS):
+        outputs[i].write(data[i].tobytes())
+    for p in range(PARITY_SHARDS):
+        outputs[DATA_SHARDS + p].write(parity[p].tobytes())
 
 
 def rebuild_ec_files(base_file_name: str,
