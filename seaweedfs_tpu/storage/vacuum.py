@@ -84,11 +84,12 @@ def commit_compact(volume: Volume, snapshot_size: int) -> None:
         volume.nm.close()
         os.replace(base + ".cpd", base + ".dat")
         os.replace(base + ".cpx", base + ".idx")
-        # Reload in place.
-        from .needle_map import MemoryNeedleMap
+        # Reload in place (same map kind the volume was opened with).
+        from .needle_map import new_needle_map
         volume._dat = open(base + ".dat", "r+b")
         volume.super_block = SuperBlock.from_bytes(volume._dat.read(64 * 1024))
-        volume.nm = MemoryNeedleMap.load(base + ".idx")
+        volume.nm = new_needle_map(
+            getattr(volume, "needle_map_kind", "compact"), base + ".idx")
         volume._dat.seek(0, os.SEEK_END)
         volume._append_at = volume._dat.tell()
 

@@ -27,7 +27,7 @@ from ..core.replica_placement import ReplicaPlacement
 from ..core.super_block import SUPER_BLOCK_SIZE, SuperBlock
 from ..core.ttl import TTL
 from ..utils.rwlock import RWLock
-from .needle_map import MemoryNeedleMap
+from .needle_map import new_needle_map
 
 MAX_BATCH_REQUESTS = 128
 MAX_BATCH_BYTES = 4 * 1024 * 1024
@@ -57,7 +57,7 @@ class Volume:
                  replica_placement: ReplicaPlacement | None = None,
                  ttl: TTL | None = None, create: bool = True,
                  version: int = CURRENT_VERSION, use_worker: bool = True,
-                 remote_file=None):
+                 remote_file=None, needle_map_kind: str = "compact"):
         self.dir = dir_
         self.collection = collection
         self.vid = vid
@@ -78,7 +78,8 @@ class Volume:
             use_worker = False
             self.super_block = SuperBlock.from_bytes(
                 remote_file.pread(SUPER_BLOCK_SIZE + 64 * 1024, 0))
-            self.nm = MemoryNeedleMap.load(base + ".idx")
+            self.needle_map_kind = needle_map_kind
+            self.nm = new_needle_map(needle_map_kind, base + ".idx")
             self._append_at = remote_file.size()
             self.last_modified = time.time()
             self._closed = False
@@ -101,7 +102,10 @@ class Volume:
                 ttl=ttl or TTL())
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
-        self.nm = MemoryNeedleMap.load(base + ".idx")
+        self.needle_map_kind = needle_map_kind
+        self.nm = new_needle_map(needle_map_kind, base + ".idx")
+        if needle_map_kind == "sorted_file":
+            self.readonly = True  # the .sdx map cannot journal updates
         self._dat.seek(0, os.SEEK_END)
         self._append_at = self._dat.tell()
         self.last_modified = time.time()
