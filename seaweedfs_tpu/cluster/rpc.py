@@ -37,8 +37,10 @@ _REASONS = {200: "OK", 201: "Created", 204: "No Content",
             400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
             404: "Not Found", 405: "Method Not Allowed",
             406: "Not Acceptable", 409: "Conflict",
-            412: "Precondition Failed", 416: "Range Not Satisfiable",
-            423: "Locked", 500: "Internal Server Error",
+            412: "Precondition Failed", 414: "URI Too Long",
+            416: "Range Not Satisfiable", 423: "Locked",
+            431: "Request Header Fields Too Large",
+            500: "Internal Server Error",
             501: "Not Implemented", 503: "Service Unavailable"}
 
 
@@ -55,13 +57,30 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+class _LineTooLong(Exception):
+    """A request/header line exceeded the 64KB cap (maps to 414/431)."""
+
+
 def _read_headers(rf) -> dict[str, str]:
-    """Read header lines into a lowercase-keyed dict."""
+    """Read header lines into a lowercase-keyed dict.
+
+    EOF mid-headers is a connection error, not end-of-headers — a
+    truncated request must never be parsed as a complete one.  A line
+    missing its newline at the 64KB cap raises _LineTooLong instead of
+    being silently truncated (and then misparsed)."""
     headers: dict[str, str] = {}
     while True:
         line = rf.readline(65537)
-        if line in (b"\r\n", b"\n", b""):
+        if line in (b"\r\n", b"\n"):
             return headers
+        if not line:
+            raise ConnectionError("eof in headers")
+        if not line.endswith(b"\n"):
+            # A newline-less line shorter than the cap is EOF truncation
+            # (peer died mid-line); only a full-cap line is too long.
+            if len(line) < 65537:
+                raise ConnectionError("eof mid-header line")
+            raise _LineTooLong("header line exceeds 64KB")
         i = line.find(b":")
         if i > 0:
             headers[line[:i].decode("latin-1").strip().lower()] = \
@@ -81,8 +100,27 @@ def _read_chunked(rf) -> bytes:
             while rf.readline(65537) not in (b"\r\n", b"\n", b""):
                 pass
             return bytes(out)
-        out += rf.read(size)
+        piece = rf.read(size)
+        if len(piece) < size:
+            raise ConnectionError("eof in chunked body")
+        out += piece
         rf.read(2)  # CRLF
+
+
+def _drain_then_fin(conn, rf, limit: int = 1 << 20) -> None:
+    """Graceful error-close: signal FIN and drain the peer's unread
+    request bytes (bounded) so the kernel doesn't RST away the error
+    response we just sent."""
+    try:
+        conn.shutdown(socket.SHUT_WR)
+        conn.settimeout(2.0)
+        while limit > 0:
+            data = rf.read(min(65536, limit))
+            if not data:
+                return
+            limit -= len(data)
+    except OSError:
+        pass
 
 
 class JsonHttpServer:
@@ -205,6 +243,13 @@ class JsonHttpServer:
         line = rf.readline(65537)
         if not line:
             return False
+        if not line.endswith(b"\n"):
+            if len(line) < 65537:
+                return False  # EOF mid-request-line: peer died
+            self._respond(conn, "GET", 414, {"error": "URI too long"},
+                          None, close=True)
+            _drain_then_fin(conn, rf)
+            return False
         try:
             method, target, version = \
                 line.decode("latin-1").rstrip("\r\n").split(" ", 2)
@@ -212,7 +257,16 @@ class JsonHttpServer:
             self._respond(conn, "GET", 400, {"error": "bad request line"},
                           None, close=True)
             return False
-        headers = _read_headers(rf)
+        try:
+            headers = _read_headers(rf)
+        except _LineTooLong:
+            self._respond(conn, method, 431,
+                          {"error": "header line too long"}, None,
+                          close=True)
+            _drain_then_fin(conn, rf)
+            return False
+        except ConnectionError:
+            return False  # truncated request: never route it
         if headers.get("expect", "").lower() == "100-continue":
             conn.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
         if headers.get("transfer-encoding", "").lower() == "chunked":
@@ -385,7 +439,7 @@ class _Resp:
     """Response with lazily-read body (callers stream or read())."""
 
     __slots__ = ("status", "reason", "headers", "_rf", "_remaining",
-                 "_chunks", "will_close", "_done")
+                 "_chunks", "_chunk_left", "will_close", "_done")
 
     def __init__(self, status, reason, headers, rf):
         self.status = status
@@ -395,6 +449,7 @@ class _Resp:
         self.will_close = headers.get("connection", "").lower() == "close"
         self._chunks = headers.get("transfer-encoding",
                                    "").lower() == "chunked"
+        self._chunk_left = 0
         if self._chunks:
             self._remaining = -1
         else:
@@ -413,10 +468,7 @@ class _Resp:
         if self._done:
             return b""
         if self._chunks:
-            # Simple strategy: drain the whole chunked body once.
-            data = _read_chunked(self._rf)
-            self._done = True
-            return data
+            return self._read_chunked_n(n)
         if self._remaining < 0:  # until close
             data = self._rf.read() if n < 0 else self._rf.read(n)
             if not data or n < 0:
@@ -425,9 +477,50 @@ class _Resp:
         want = self._remaining if n < 0 else min(n, self._remaining)
         data = self._rf.read(want) if want else b""
         self._remaining -= len(data)
-        if self._remaining == 0 or (want and not data):
+        if self._remaining == 0:
             self._done = True
+        elif len(data) < want:
+            # Early peer close with Content-Length unsatisfied is a
+            # failed transfer, never a short success (http.client raised
+            # IncompleteRead here; so do we).
+            raise ConnectionError(
+                f"incomplete read: peer closed with {self._remaining} "
+                f"of {self.headers.get('content-length')} bytes unread")
         return data
+
+    def _read_chunked_n(self, n: int) -> bytes:
+        """Incremental chunked-body reader honoring the requested size,
+        so call_to_file keeps its 1MB streaming for chunked upstreams."""
+        if n < 0:
+            out = bytearray()
+            while not self._done:
+                out += self._read_chunked_n(1 << 20)
+            return bytes(out)
+        if self._done:
+            return b""
+        out = bytearray()
+        while len(out) < n:
+            if self._chunk_left == 0:
+                line = self._rf.readline(65537)
+                if not line:
+                    raise ConnectionError("eof in chunked body")
+                size = int(line.split(b";")[0].strip() or b"0", 16)
+                if size == 0:
+                    while self._rf.readline(65537) not in (b"\r\n", b"\n",
+                                                           b""):
+                        pass
+                    self._done = True
+                    break
+                self._chunk_left = size
+            take = min(n - len(out), self._chunk_left)
+            piece = self._rf.read(take)
+            if len(piece) < take:
+                raise ConnectionError("eof in chunked body")
+            out += piece
+            self._chunk_left -= take
+            if self._chunk_left == 0:
+                self._rf.read(2)  # CRLF
+        return bytes(out)
 
 
 class _ConnPool:
@@ -517,8 +610,11 @@ def _request(url: str, method: str, body, timeout: float,
         if status in (301, 302, 307, 308) and max_redirects > 0:
             location = resp.getheader("location")
             if location:
-                resp.read()
-                _finish(conn, resp)
+                try:
+                    resp.read()
+                    _finish(conn, resp)
+                except Exception:  # noqa: BLE001 — truncated redirect body
+                    conn.close()
                 return _request(
                     urllib.parse.urljoin(url, location), method, body,
                     timeout, max_redirects - 1)
@@ -571,7 +667,11 @@ def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:
     in memory (the reference streams CopyFile in chunks too)."""
     resp, conn = _request(url, "GET", None, timeout)
     if resp.status >= 400:
-        data = resp.read()
+        try:
+            data = resp.read()
+        except Exception:
+            conn.close()
+            raise
         _finish(conn, resp)
         _raise_rpc_error(resp, data)
     try:
@@ -586,6 +686,11 @@ def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:
     except Exception:
         conn.close()
         raise
+    clen = resp.getheader("content-length")
+    if clen is not None and total != int(clen):
+        conn.close()
+        raise ConnectionError(
+            f"incomplete download: got {total} of {clen} bytes")
     _finish(conn, resp)
     return total
 

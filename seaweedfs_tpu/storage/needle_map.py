@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import io
 import os
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -36,6 +37,19 @@ from ..core import types as t
 # The on-disk .idx record, vectorizable: big-endian u64 key, u32 offset
 # (units of 8 bytes), i32 size.
 _IDX_DTYPE = np.dtype([("key", ">u8"), ("offset", ">u4"), ("size", ">i4")])
+
+
+def _keep_last_live(arr: np.ndarray) -> np.ndarray:
+    """Vectorized .idx replay: the LAST occurrence per key decides its
+    fate; returns the live selection ascending by key.  (np.unique
+    returns the FIRST index, so scan the reversed key array.)"""
+    keys = arr["key"].astype(np.uint64)
+    _uniq, idx_rev = np.unique(keys[::-1], return_index=True)
+    last = len(keys) - 1 - idx_rev  # ascending-key order
+    sel = arr[last]
+    live = (sel["offset"].astype(np.uint32) > 0) & \
+           (sel["size"].astype(np.int32) > 0)
+    return sel[live]
 
 
 @dataclass
@@ -164,6 +178,11 @@ class CompactNeedleMap:
         self._live = 0
         self.metrics = MapMetrics()
         self._idx_file = idx_file
+        # The dict map this replaced was GIL-atomic; sorted-array swaps in
+        # _merge() are not.  Vacuum's lock-free get()s and the tail path's
+        # ordered_offsets() run concurrently with the write worker, so every
+        # public method takes this lock (RLock: put/delete call get).
+        self._lock = threading.RLock()
 
     @classmethod
     def load(cls, idx_path: str) -> "CompactNeedleMap":
@@ -181,20 +200,14 @@ class CompactNeedleMap:
         arr = np.frombuffer(raw[:usable], dtype=_IDX_DTYPE)
         if len(arr) == 0:
             return nm
-        keys = arr["key"].astype(np.uint64)
         offs = arr["offset"].astype(np.uint32)
         sizes = arr["size"].astype(np.int32)
-        nm.metrics.maximum_file_key = int(keys.max())
-        # Last occurrence per key decides its fate (np.unique returns the
-        # FIRST index, so scan the reversed key array).
-        _uniq, idx_rev = np.unique(keys[::-1], return_index=True)
-        last = len(keys) - 1 - idx_rev  # ascending-key order
-        lk, lo, ls = keys[last], offs[last], sizes[last]
-        live = (lo > 0) & (ls > 0)
-        nm._keys = lk[live].copy()
-        nm._offs = lo[live].copy()
-        nm._sizes = ls[live].copy()
-        nm._live = int(live.sum())
+        nm.metrics.maximum_file_key = int(arr["key"].astype(np.uint64).max())
+        live_sel = _keep_last_live(arr)
+        nm._keys = live_sel["key"].astype(np.uint64)
+        nm._offs = live_sel["offset"].astype(np.uint32)
+        nm._sizes = live_sel["size"].astype(np.int32)
+        nm._live = len(live_sel)
         writes = (offs > 0) & (sizes > 0)
         write_bytes = int(sizes[writes].sum())
         live_bytes = int(nm._sizes.sum())
@@ -214,10 +227,11 @@ class CompactNeedleMap:
         return None
 
     def get(self, key: int) -> tuple[int, int] | None:
-        hit = self._overflow.get(key)
-        if hit is not None:
-            return None if hit[1] == t.TOMBSTONE_FILE_SIZE else hit
-        return self._base_get(key)
+        with self._lock:
+            hit = self._overflow.get(key)
+            if hit is not None:
+                return None if hit[1] == t.TOMBSTONE_FILE_SIZE else hit
+            return self._base_get(key)
 
     def __contains__(self, key: int) -> bool:
         return self.get(key) is not None
@@ -228,39 +242,42 @@ class CompactNeedleMap:
     # -- mutations -----------------------------------------------------------
 
     def put(self, key: int, offset: int, size: int) -> None:
-        prev = self.get(key)
-        if prev is not None:
-            self.metrics.deletion_count += 1
-            self.metrics.deletion_byte_count += prev[1]
-        else:
-            self.metrics.file_count += 1
-            self._live += 1
-        self.metrics.file_byte_count += size
-        self.metrics.maximum_file_key = max(self.metrics.maximum_file_key,
-                                            key)
-        self._overflow[key] = (offset, size)
-        if self._idx_file is not None:
-            idx_mod.append_entry(self._idx_file, key, offset, size)
-        if len(self._overflow) >= self.OVERFLOW_MERGE:
-            self._merge()
+        with self._lock:
+            prev = self.get(key)
+            if prev is not None:
+                self.metrics.deletion_count += 1
+                self.metrics.deletion_byte_count += prev[1]
+            else:
+                self.metrics.file_count += 1
+                self._live += 1
+            self.metrics.file_byte_count += size
+            self.metrics.maximum_file_key = max(
+                self.metrics.maximum_file_key, key)
+            self._overflow[key] = (offset, size)
+            if self._idx_file is not None:
+                idx_mod.append_entry(self._idx_file, key, offset, size)
+            if len(self._overflow) >= self.OVERFLOW_MERGE:
+                self._merge()
 
     def delete(self, key: int) -> int:
-        prev = self.get(key)
-        if prev is None:
-            return 0
-        self.metrics.deletion_count += 1
-        self.metrics.deletion_byte_count += prev[1]
-        self._live -= 1
-        self._overflow[key] = (0, t.TOMBSTONE_FILE_SIZE)
-        if self._idx_file is not None:
-            idx_mod.append_entry(self._idx_file, key, 0,
-                                 t.TOMBSTONE_FILE_SIZE)
-        if len(self._overflow) >= self.OVERFLOW_MERGE:
-            self._merge()
-        return prev[1]
+        with self._lock:
+            prev = self.get(key)
+            if prev is None:
+                return 0
+            self.metrics.deletion_count += 1
+            self.metrics.deletion_byte_count += prev[1]
+            self._live -= 1
+            self._overflow[key] = (0, t.TOMBSTONE_FILE_SIZE)
+            if self._idx_file is not None:
+                idx_mod.append_entry(self._idx_file, key, 0,
+                                     t.TOMBSTONE_FILE_SIZE)
+            if len(self._overflow) >= self.OVERFLOW_MERGE:
+                self._merge()
+            return prev[1]
 
     def _merge(self) -> None:
-        """Fold the overflow into the sorted base arrays."""
+        """Fold the overflow into the sorted base arrays (caller holds
+        self._lock)."""
         if not self._overflow:
             return
         items = sorted(self._overflow.items())
@@ -284,17 +301,21 @@ class CompactNeedleMap:
     def ordered_offsets(self):
         """Live-needle .dat offsets in append (= offset) order — the
         probe set for BinarySearchByAppendAtNs."""
-        self._merge()
-        return np.sort(self._offs).astype(np.int64) * \
-            t.NEEDLE_PADDING_SIZE
+        with self._lock:
+            self._merge()
+            return np.sort(self._offs).astype(np.int64) * \
+                t.NEEDLE_PADDING_SIZE
 
     def ascending_visit(self, fn) -> None:
-        self._merge()
+        with self._lock:
+            self._merge()
+            keys = self._keys
+            offs = self._offs
+            sizes = self._sizes
         pad = t.NEEDLE_PADDING_SIZE
-        for i in range(len(self._keys)):
-            fn(t.NeedleMapEntry(int(self._keys[i]),
-                                int(self._offs[i]) * pad,
-                                int(self._sizes[i])))
+        for i in range(len(keys)):
+            fn(t.NeedleMapEntry(int(keys[i]), int(offs[i]) * pad,
+                                int(sizes[i])))
 
     def content_size(self) -> int:
         return self.metrics.file_byte_count
@@ -353,23 +374,46 @@ class SortedFileNeedleMap:
     @staticmethod
     def generate(idx_path: str, sdx_path: str) -> None:
         """Sort an .idx into the .sdx this map searches
-        (WriteSortedFileFromIdx for volumes)."""
+        (WriteSortedFileFromIdx for volumes).
+
+        One numpy pass over the raw records — 16 bytes/entry transient,
+        never a Python dict — so generating on a huge volume's idx stays
+        within the memory envelope the mapper itself promises."""
         with open(idx_path, "rb") as f:
-            db = MemDb.from_idx(f)
+            raw = f.read()
+        usable = len(raw) - len(raw) % idx_mod.ENTRY_SIZE
+        arr = np.frombuffer(raw[:usable], dtype=_IDX_DTYPE)
+        payload = _keep_last_live(arr).tobytes() if len(arr) else b""
         tmp = sdx_path + ".tmp"
         with open(tmp, "wb") as out:
-            out.write(db.to_sorted_bytes())
+            out.write(payload)
+            out.flush()
+            os.fsync(out.fileno())
         os.replace(tmp, sdx_path)
+        # Change-detector sidecar: .idx files are append-only, so the
+        # source byte length is an exact staleness signal where mtime
+        # granularity is not.
+        with open(sdx_path + ".src", "w") as meta:
+            meta.write(str(usable))
 
     @classmethod
     def load(cls, idx_path: str) -> "SortedFileNeedleMap":
-        """Open (generating the .sdx when missing or older than the
-        .idx)."""
+        """Open, regenerating the .sdx when missing or stale.  Staleness
+        checks the recorded source .idx length (append-only ⇒ exact),
+        not mtime, so an append landing within mtime granularity still
+        triggers regeneration."""
         sdx = idx_path[:-4] + ".sdx" if idx_path.endswith(".idx") \
             else idx_path + ".sdx"
-        if not os.path.exists(sdx) or (
-                os.path.exists(idx_path)
-                and os.path.getmtime(sdx) < os.path.getmtime(idx_path)):
+        stale = not os.path.exists(sdx)
+        if not stale and os.path.exists(idx_path):
+            try:
+                with open(sdx + ".src") as meta:
+                    recorded = int(meta.read().strip())
+            except (OSError, ValueError):
+                recorded = -1
+            cur = os.path.getsize(idx_path)
+            stale = recorded != cur - cur % idx_mod.ENTRY_SIZE
+        if stale:
             cls.generate(idx_path, sdx)
         return cls(sdx)
 
