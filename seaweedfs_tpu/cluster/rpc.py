@@ -87,9 +87,10 @@ def _read_headers(rf) -> dict[str, str]:
                 line[i + 1:].decode("latin-1").strip()
 
 
-def _read_chunked(rf) -> bytes:
-    """Minimal Transfer-Encoding: chunked body reader."""
-    out = bytearray()
+def _iter_chunks(rf):
+    """Transfer-Encoding: chunked parser — yields each chunk's payload.
+    The single implementation behind both the server's one-shot body
+    read and the client's incremental response reader."""
     while True:
         line = rf.readline(65537)
         if not line:
@@ -99,12 +100,17 @@ def _read_chunked(rf) -> bytes:
             # trailers until blank line
             while rf.readline(65537) not in (b"\r\n", b"\n", b""):
                 pass
-            return bytes(out)
+            return
         piece = rf.read(size)
         if len(piece) < size:
             raise ConnectionError("eof in chunked body")
-        out += piece
+        yield piece
         rf.read(2)  # CRLF
+
+
+def _read_chunked(rf) -> bytes:
+    """Minimal Transfer-Encoding: chunked body reader (whole body)."""
+    return b"".join(_iter_chunks(rf))
 
 
 def _drain_then_fin(conn, rf, limit: int = 1 << 20) -> None:
@@ -439,7 +445,8 @@ class _Resp:
     """Response with lazily-read body (callers stream or read())."""
 
     __slots__ = ("status", "reason", "headers", "_rf", "_remaining",
-                 "_chunks", "_chunk_left", "will_close", "_done")
+                 "_chunks", "_chunk_iter", "_chunk_buf", "will_close",
+                 "_done")
 
     def __init__(self, status, reason, headers, rf):
         self.status = status
@@ -449,7 +456,8 @@ class _Resp:
         self.will_close = headers.get("connection", "").lower() == "close"
         self._chunks = headers.get("transfer-encoding",
                                    "").lower() == "chunked"
-        self._chunk_left = 0
+        self._chunk_iter = None
+        self._chunk_buf = b""
         if self._chunks:
             self._remaining = -1
         else:
@@ -489,37 +497,23 @@ class _Resp:
         return data
 
     def _read_chunked_n(self, n: int) -> bytes:
-        """Incremental chunked-body reader honoring the requested size,
-        so call_to_file keeps its 1MB streaming for chunked upstreams."""
-        if n < 0:
-            out = bytearray()
-            while not self._done:
-                out += self._read_chunked_n(1 << 20)
-            return bytes(out)
-        if self._done:
-            return b""
+        """Incremental chunked-body reader honoring the requested size
+        (so call_to_file keeps its 1MB streaming for chunked upstreams),
+        driven by the shared _iter_chunks parser."""
+        if self._chunk_iter is None:
+            self._chunk_iter = _iter_chunks(self._rf)
         out = bytearray()
-        while len(out) < n:
-            if self._chunk_left == 0:
-                line = self._rf.readline(65537)
-                if not line:
-                    raise ConnectionError("eof in chunked body")
-                size = int(line.split(b";")[0].strip() or b"0", 16)
-                if size == 0:
-                    while self._rf.readline(65537) not in (b"\r\n", b"\n",
-                                                           b""):
-                        pass
+        while n < 0 or len(out) < n:
+            if not self._chunk_buf:
+                try:
+                    self._chunk_buf = next(self._chunk_iter)
+                except StopIteration:
                     self._done = True
                     break
-                self._chunk_left = size
-            take = min(n - len(out), self._chunk_left)
-            piece = self._rf.read(take)
-            if len(piece) < take:
-                raise ConnectionError("eof in chunked body")
-            out += piece
-            self._chunk_left -= take
-            if self._chunk_left == 0:
-                self._rf.read(2)  # CRLF
+            take = len(self._chunk_buf) if n < 0 \
+                else min(n - len(out), len(self._chunk_buf))
+            out += self._chunk_buf[:take]
+            self._chunk_buf = self._chunk_buf[take:]
         return bytes(out)
 
 
@@ -664,7 +658,10 @@ def call(url: str, method: str = "GET", body: bytes | None = None,
 def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:
     """Stream a GET response to a file in chunks; returns byte count.
     Bulk transfers (volume/shard copies) must never buffer a 30GB .dat
-    in memory (the reference streams CopyFile in chunks too)."""
+    in memory (the reference streams CopyFile in chunks too).  Writes
+    land in a `.dl.tmp` sibling renamed into place only on a complete
+    transfer, so a truncated download never masquerades as a valid
+    shard/volume file at the destination path."""
     resp, conn = _request(url, "GET", None, timeout)
     if resp.status >= 400:
         try:
@@ -674,8 +671,9 @@ def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:
             raise
         _finish(conn, resp)
         _raise_rpc_error(resp, data)
+    tmp = path + ".dl.tmp"
     try:
-        with open(path, "wb") as f:
+        with open(tmp, "wb") as f:
             total = 0
             while True:
                 chunk = resp.read(1 << 20)
@@ -683,14 +681,18 @@ def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:
                     break
                 f.write(chunk)
                 total += len(chunk)
+        clen = resp.getheader("content-length")
+        if clen is not None and total != int(clen):
+            raise ConnectionError(
+                f"incomplete download: got {total} of {clen} bytes")
     except Exception:
         conn.close()
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         raise
-    clen = resp.getheader("content-length")
-    if clen is not None and total != int(clen):
-        conn.close()
-        raise ConnectionError(
-            f"incomplete download: got {total} of {clen} bytes")
+    os.replace(tmp, path)
     _finish(conn, resp)
     return total
 

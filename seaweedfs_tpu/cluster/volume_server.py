@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import re
 import threading
 import time
 import urllib.parse
@@ -66,6 +67,8 @@ class VolumeServer:
         self.store = Store(directories, max_volume_counts,
                            ip=host, port=self.server.port)
         self.ec_volumes: dict[int, EcVolume] = {}
+        self._ec_recv_lock = threading.Lock()
+        self._ec_recv_vlocks: dict[int, threading.Lock] = {}
         self._ec_loc_cache: dict[int, tuple[float, dict[int, list[str]]]] = {}
         self._load_ec_volumes()
         s = self.server
@@ -83,6 +86,7 @@ class VolumeServer:
         s.route("GET", "/admin/ec/shard_read", self._ec_shard_read)
         s.route("GET", "/admin/ec/shard_file", self._ec_shard_file)
         s.route("POST", "/admin/ec/copy_shard", self._ec_copy_shard)
+        s.route("POST", "/admin/ec/receive_shard", self._ec_receive_shard)
         s.route("POST", "/admin/ec/to_volume", self._ec_to_volume)
         s.route("POST", "/query", self._query)
         s.route("GET", "/admin/volume_tail", self._volume_tail)
@@ -582,11 +586,16 @@ class VolumeServer:
 
     # -- EC admin ------------------------------------------------------------
 
+    _VOLUME_EXT = re.compile(r"\.(ec\d\d|ecx|ecj|vif|dat)$")
+
     def _volume_base(self, vid: int) -> str:
         v = self.store.find_volume(vid)
         if v is not None:
             return v.file_name()
-        # Look for loose files (shards without a mounted volume).
+        # Look for loose files (shards without a mounted volume),
+        # accepting only well-formed volume extensions — a glob like
+        # `1.ec*` also matches in-flight temp files (`1.ec01.part`),
+        # and deriving the base from one corrupts every later write.
         for loc in self.store.locations:
             for name in (str(vid), f"*_{vid}"):
                 import glob as _glob
@@ -594,8 +603,10 @@ class VolumeServer:
                                                name + ".ec*")) + \
                     _glob.glob(os.path.join(loc.directory, name + ".ecx")) \
                     + _glob.glob(os.path.join(loc.directory, name + ".dat"))
-                if hits:
-                    return hits[0].rsplit(".", 1)[0]
+                for hit in hits:
+                    m = self._VOLUME_EXT.search(hit)
+                    if m:
+                        return hit[:m.start()]
         return os.path.join(self.store.locations[0].directory, str(vid))
 
     def _ec_generate(self, query: dict, body: bytes) -> dict:
@@ -716,6 +727,51 @@ class VolumeServer:
                     except FileNotFoundError:
                         pass
         return {}
+
+    def _ec_receive_shard(self, query: dict, body: bytes) -> dict:
+        """Push-mode shard install: the batched mesh rebuild
+        (parallel/cluster_rebuild.py) decodes centrally and scatters
+        rebuilt shards here — the inverse of copy_shard's pull.  Pulls
+        the .ecx/.vif sidecars from ?ecx_source= when absent so the
+        shard is servable once mounted."""
+        vid = int(query["volume"])
+        sid = int(query["shard"])
+        if not 0 <= sid < TOTAL_SHARDS:
+            raise rpc.RpcError(400, f"bad shard id {sid}")
+        base = self._volume_base(vid)
+        os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+        # Temp names must not collide with _volume_base's discovery
+        # globs (`<vid>.ec*`) or concurrent receives would mis-derive
+        # the base path from a half-written sibling.
+        tmp = f"{base}.rcv{sid}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, base + to_ext(sid))
+        source = query.get("ecx_source", "")
+        if source:
+            # Per-volume serialization: concurrent receives for the same
+            # volume must not double-pull the sidecars, but receives for
+            # OTHER volumes shouldn't stall behind these downloads.
+            with self._ec_recv_lock:
+                vlock = self._ec_recv_vlocks.setdefault(
+                    vid, threading.Lock())
+            with vlock:
+                if not os.path.exists(base + ".ecx"):
+                    for ext in (".ecx", ".vif", ".ecj"):
+                        try:
+                            # Sidecars are best-effort: the shard itself
+                            # is already durably installed, and a missing
+                            # .vif/.ecj is normal.  call_to_file is
+                            # atomic (tmp + rename), so failures leave
+                            # nothing behind.
+                            rpc.call_to_file(
+                                f"http://{source}/admin/ec/shard_file?"
+                                f"volume={vid}&ext={ext}", base + ext)
+                        except (rpc.RpcError, OSError):
+                            pass
+        return {"volume": vid, "shard": sid, "bytes": len(body)}
 
     def _ec_to_volume(self, query: dict, body: bytes) -> dict:
         """VolumeEcShardsToVolume: local data shards (.ec00-.ec09) + .ecx

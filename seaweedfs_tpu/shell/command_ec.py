@@ -178,22 +178,38 @@ class EcEncode(Command):
 @register
 class EcRebuild(Command):
     name = "ec.rebuild"
-    help = ("ec.rebuild [-volumeId <id>] — regenerate missing EC shards "
-            "on one rebuilder node from the survivors")
+    help = ("ec.rebuild [-volumeId <id>[,<id>...]] [-batch] "
+            "[-maxBatchMB 256] — regenerate missing EC shards.  Default: "
+            "one volume at a time on a rebuilder node.  -batch: gather "
+            "survivors from their holders, rebuild EVERY volume in "
+            "mesh-batched compiled steps (volumes data-parallel over "
+            "chips), scatter the shards back — the multi-volume path "
+            "(BASELINE configs #3/#5)")
 
     def do(self, args: list[str], env: CommandEnv) -> str:
         env.confirm_is_locked()
         flags, _ = self.parse_flags(args)
         if "volumeId" in flags:
-            vids = [int(flags["volumeId"])]
+            vids = [int(v) for v in flags["volumeId"].split(",")]
         else:
             vids = self._all_ec_vids(env)
+        if flags.get("batch") == "true":
+            return self.rebuild_batch(env, vids, flags)
         out = []
         for vid in vids:
             msg = self.rebuild_one(env, vid)
             if msg:
                 out.append(msg)
         return "\n".join(out) or "nothing to rebuild"
+
+    def rebuild_batch(self, env: CommandEnv, vids: list[int],
+                      flags: dict) -> str:
+        from ..parallel import cluster_rebuild
+        mesh = cluster_rebuild.make_mesh()
+        max_mb = int(flags.get("maxBatchMB", 256))
+        messages = cluster_rebuild.batch_rebuild(
+            env, vids, mesh=mesh, max_batch_bytes=max_mb << 20)
+        return "\n".join(messages) or "nothing to rebuild"
 
     def _all_ec_vids(self, env: CommandEnv) -> list[int]:
         vids = set()

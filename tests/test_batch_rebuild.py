@@ -1,0 +1,187 @@
+"""Mesh-batched multi-volume EC rebuild driven through the shell.
+
+The production entry point (`ec.rebuild -batch`) must gather survivor
+shards from their volume-server holders, rebuild every volume's missing
+shards in mesh-batched compiled steps (volumes data-parallel over the
+8-device virtual mesh), scatter the rebuilt shards back onto cluster
+nodes, and mount them — byte-identical to the originals.
+
+Reference behavior being matched: weed/shell/command_ec_rebuild.go:57
+(one volume at a time on one node) — here batched per SURVEY §2.3's
+mapping of multi-volume rebuild onto the `vol` mesh axis.
+"""
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.parallel import cluster_rebuild
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _freshen(servers):
+    for vs in servers:
+        vs._send_heartbeat(full=True)
+        vs._ec_loc_cache.clear()
+
+
+def _make_ec_volumes(master, servers, n_volumes=3, objs_per_volume=6):
+    """Grow volumes, upload into each, EC-encode and spread 5/5/4.
+    Returns {vid: [(payload, fid), ...]}."""
+    client = WeedClient(master.url())
+    rpc.call_json(f"{master.url()}/vol/grow?count={n_volumes}", "POST")
+    by_vid: dict[int, list] = {}
+    i = 0
+    while any(len(v) < objs_per_volume
+              for v in by_vid.values()) or len(by_vid) < n_volumes:
+        payload = f"batch-rebuild-{i}".encode() * (i % 7 + 1)
+        fid = client.upload_data(payload)
+        by_vid.setdefault(int(fid.split(",")[0]), []).append(
+            (payload, fid))
+        i += 1
+        if i > 400:
+            break
+    vids = sorted(by_vid)[:n_volumes]
+    spread = [(servers[0], [0, 1, 2, 3, 4]),
+              (servers[1], [5, 6, 7, 8, 9]),
+              (servers[2], [10, 11, 12, 13])]
+    for vid in vids:
+        src = client.lookup(vid)[0]["url"]
+        rpc.call_json(f"http://{src}/admin/ec/generate", "POST",
+                      {"volume": vid})
+        for vs, shards in spread:
+            if vs.url() != src:
+                rpc.call_json(f"http://{vs.url()}/admin/ec/copy_shard",
+                              "POST", {"volume": vid, "source": src,
+                                       "shards": shards,
+                                       "copy_ecx": True})
+        for vs, shards in spread:
+            rpc.call_json(f"http://{vs.url()}/admin/ec/mount", "POST",
+                          {"volume": vid})
+            drop = [s for s in range(14) if s not in shards]
+            rpc.call_json(f"http://{vs.url()}/admin/ec/delete_shards",
+                          "POST", {"volume": vid, "shards": drop})
+        rpc.call_json(f"http://{src}/admin/delete_volume", "POST",
+                      {"volume": vid})
+    _freshen(servers)
+    return client, {vid: by_vid[vid] for vid in vids}
+
+
+def _shard_bytes(server_url, vid, sid) -> bytes:
+    return bytes(rpc.call(
+        f"http://{server_url}/admin/ec/shard_file?volume={vid}"
+        f"&shard={sid}"))
+
+
+def _holder_of(env, vid, sid) -> str:
+    return env.ec_shard_locations(vid)[sid][0]
+
+
+def test_batch_rebuild_through_shell(cluster):
+    master, servers = cluster
+    client, volumes = _make_ec_volumes(master, servers, n_volumes=3)
+    vids = sorted(volumes)
+    env = CommandEnv(master.url())
+
+    # Capture originals, then lose shards: two volumes lose the SAME
+    # set (one mesh group, V=2) and the third a different set (second
+    # group) — exercises signature grouping and multi-step batching.
+    lost = {vids[0]: [0, 3], vids[1]: [0, 3], vids[2]: [12, 13]}
+    originals = {}
+    for vid, sids in lost.items():
+        for sid in sids:
+            holder = _holder_of(env, vid, sid)
+            originals[(vid, sid)] = _shard_bytes(holder, vid, sid)
+            rpc.call_json(f"http://{holder}/admin/ec/delete_shards",
+                          "POST", {"volume": vid, "shards": [sid]})
+    _freshen(servers)
+    for vid, sids in lost.items():
+        present = set(env.ec_shard_locations(vid))
+        assert all(s not in present for s in sids)
+
+    run_command(env, "lock")
+    out = run_command(env, "ec.rebuild -batch")
+    for vid in vids:
+        assert f"volume {vid}: rebuilt shards" in out
+
+    _freshen(servers)
+    for vid, sids in lost.items():
+        locs = env.ec_shard_locations(vid)
+        assert sorted(locs) == list(range(14)), \
+            f"volume {vid} shards incomplete: {sorted(locs)}"
+        for sid in sids:
+            rebuilt = _shard_bytes(locs[sid][0], vid, sid)
+            assert rebuilt == originals[(vid, sid)], \
+                f"volume {vid} shard {sid} not byte-identical"
+
+    # Every object still reads back through the rebuilt cluster.
+    for vid, pairs in volumes.items():
+        for payload, fid in pairs:
+            assert bytes(client.download(fid)) == payload
+    env.close()
+
+
+def test_batch_rebuild_skips_unrecoverable(cluster):
+    master, servers = cluster
+    client, volumes = _make_ec_volumes(master, servers, n_volumes=1)
+    vid = next(iter(volumes))
+    env = CommandEnv(master.url())
+    # Lose 5 shards -> only 9 survive -> must be skipped, not crash.
+    for sid in [0, 1, 2, 3, 4]:
+        holder = _holder_of(env, vid, sid)
+        rpc.call_json(f"http://{holder}/admin/ec/delete_shards", "POST",
+                      {"volume": vid, "shards": [sid]})
+    _freshen(servers)
+    run_command(env, "lock")
+    out = run_command(env, "ec.rebuild -batch")
+    assert "SKIPPED" in out and str(vid) in out
+    env.close()
+
+
+def test_batch_rebuild_nothing_to_do(cluster):
+    master, servers = cluster
+    client, volumes = _make_ec_volumes(master, servers, n_volumes=1)
+    env = CommandEnv(master.url())
+    run_command(env, "lock")
+    assert run_command(env, "ec.rebuild -batch") == "nothing to rebuild"
+    env.close()
+
+
+def test_plan_rebuilds_groups_by_signature(cluster):
+    master, servers = cluster
+    client, volumes = _make_ec_volumes(master, servers, n_volumes=3)
+    vids = sorted(volumes)
+    env = CommandEnv(master.url())
+    lost = {vids[0]: [1], vids[1]: [1], vids[2]: [13]}
+    for vid, sids in lost.items():
+        for sid in sids:
+            holder = _holder_of(env, vid, sid)
+            rpc.call_json(f"http://{holder}/admin/ec/delete_shards",
+                          "POST", {"volume": vid, "shards": [sid]})
+    _freshen(servers)
+    plan = cluster_rebuild.plan_rebuilds(env)
+    assert len(plan.groups) == 2
+    sig_two = [vs for vs in plan.groups.values() if len(vs) == 2]
+    assert len(sig_two) == 1
+    assert {v for v, _ in sig_two[0]} == {vids[0], vids[1]}
+    assert not plan.skipped
+    env.close()
