@@ -15,6 +15,7 @@ Replicated writes fan out to sibling replicas looked up at the master
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import os
 import random
@@ -69,7 +70,14 @@ class VolumeServer:
         self.ec_volumes: dict[int, EcVolume] = {}
         self._ec_recv_lock = threading.Lock()
         self._ec_recv_vlocks: dict[int, threading.Lock] = {}
-        self._ec_loc_cache: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        # vid -> (fetched_at, ttl, shard->urls).  TTL is tiered by how
+        # complete the last lookup was (store_ec.go:221-229): a lookup
+        # that can't even serve reads retries quickly, a full set is
+        # trusted for a long time.
+        self._ec_loc_cache: dict[
+            int, tuple[float, float, dict[int, list[str]]]] = {}
+        self._ec_read_pool: concurrent.futures.ThreadPoolExecutor | None = None
+        self._ec_pool_lock = threading.Lock()
         self._load_ec_volumes()
         s = self.server
         s.route("GET", "/admin/status", self._admin_status)
@@ -118,6 +126,10 @@ class VolumeServer:
     def stop(self) -> None:
         self._stop.set()
         self.server.stop()
+        with self._ec_pool_lock:
+            if self._ec_read_pool is not None:
+                self._ec_read_pool.shutdown(wait=False)
+                self._ec_read_pool = None
         for ev in self.ec_volumes.values():
             ev.close()
         self.store.close()
@@ -362,12 +374,27 @@ class VolumeServer:
         raise rpc.RpcError(
             500, f"cannot determine version of ec volume {ev.vid}")
 
-    def _ec_shard_locations(self, vid: int) -> dict[int, list[str]]:
-        """Shard id -> server urls, cached briefly (cachedLookup tiers)."""
+    @staticmethod
+    def _loc_ttl(locs: dict[int, list[str]]) -> float:
+        """Freshness tier for a shard-location lookup result, mirroring
+        the reference's cachedLookupEcShardLocations tiers
+        (store_ec.go:221-229): a set too small to serve reads (<10
+        shards) is retried after 11s, an incomplete set after 7m, and a
+        full 14-shard map is trusted for 37m."""
+        n = len(locs)
+        if n < 10:
+            return 11.0
+        if n < TOTAL_SHARDS:
+            return 7 * 60.0
+        return 37 * 60.0
+
+    def _ec_shard_locations(self, vid: int,
+                            refresh: bool = False) -> dict[int, list[str]]:
+        """Shard id -> server urls, cached with tiered freshness."""
         now = time.time()
         hit = self._ec_loc_cache.get(vid)
-        if hit and now - hit[0] < 10.0:
-            return hit[1]
+        if hit and not refresh and now - hit[0] < hit[1]:
+            return hit[2]
         locs: dict[int, list[str]] = {}
         try:
             resp = rpc.call(f"{self.master_url}/dir/lookup?volumeId={vid}")
@@ -375,9 +402,18 @@ class VolumeServer:
                 locs[int(sid_str)] = [d["url"] for d in dns]
         except Exception:  # noqa: BLE001 — stale cache beats failing
             if hit:
-                return hit[1]
-        self._ec_loc_cache[vid] = (now, locs)
+                return hit[2]
+        self._ec_loc_cache[vid] = (now, self._loc_ttl(locs), locs)
         return locs
+
+    def _ec_pool(self) -> concurrent.futures.ThreadPoolExecutor:
+        """Shared fan-out pool for degraded EC reads.  Tasks never submit
+        nested work, so a bounded pool cannot deadlock."""
+        with self._ec_pool_lock:
+            if self._ec_read_pool is None:
+                self._ec_read_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="ec-read")
+            return self._ec_read_pool
 
     def _read_ec_interval(self, ev: EcVolume, interval) -> bytes:
         sid, off = interval.to_shard_id_and_offset(
@@ -389,8 +425,54 @@ class VolumeServer:
             buf = shard.read_at(off, size)
             if len(buf) == size:
                 return buf
-        # 2. remote shard holders
+        # 2. remote shard holders (failover across every holder, like
+        #    readRemoteEcShardInterval walking sourceDataNodes)
         locations = self._ec_shard_locations(ev.vid)
+        data = self._fetch_shard_interval(ev, locations, sid, off, size)
+        if data is not None:
+            return data
+        # 3. reconstruct from >=10 other shard intervals.  Fan the reads
+        # out in parallel — latency is the slowest single fetch, not the
+        # sum of 13 round-trips (store_ec.go:322-376 launches one
+        # goroutine per shard; recoverOneRemoteEcShardInterval).
+        pool = self._ec_pool()
+        futs = {
+            pool.submit(
+                self._fetch_shard_interval, ev, locations, other, off, size):
+            other
+            for other in range(TOTAL_SHARDS) if other != sid
+        }
+        have: dict[int, bytes] = {}
+        for f in concurrent.futures.as_completed(futs):
+            buf = f.result()
+            if buf is not None:
+                have[futs[f]] = buf
+                if len(have) >= 10:
+                    break
+        for f in futs:
+            f.cancel()
+        if len(have) < 10:
+            # The location map let us down — drop it so the next read
+            # refreshes immediately instead of waiting out the TTL.
+            self._ec_loc_cache.pop(ev.vid, None)
+            raise rpc.RpcError(
+                500, f"cannot reconstruct shard {sid}: only {len(have)} "
+                     f"shard intervals reachable")
+        import numpy as np
+        arrs = {k: np.frombuffer(v, dtype=np.uint8) for k, v in have.items()}
+        rec = ev.coder.reconstruct(arrs, wanted=[sid])
+        return np.asarray(rec[sid]).tobytes()
+
+    def _fetch_shard_interval(self, ev: EcVolume,
+                              locations: dict[int, list[str]],
+                              sid: int, off: int, size: int) -> bytes | None:
+        """One shard's interval: local file first, then every remote
+        holder in turn.  Returns None when no source can serve it."""
+        local = ev.shards.get(sid)
+        if local is not None:
+            buf = local.read_at(off, size)
+            if len(buf) == size:
+                return buf
         me = self.url()
         for url in locations.get(sid, []):
             if url == me:
@@ -403,37 +485,7 @@ class VolumeServer:
                     return bytes(data)
             except Exception:  # noqa: BLE001 — try next holder
                 continue
-        # 3. reconstruct from >=10 other shard intervals (local + remote)
-        have: dict[int, bytes] = {}
-        for other in range(TOTAL_SHARDS):
-            if other == sid or len(have) >= 10:
-                continue
-            local = ev.shards.get(other)
-            if local is not None:
-                buf = local.read_at(off, size)
-                if len(buf) == size:
-                    have[other] = buf
-                    continue
-            for url in locations.get(other, []):
-                if url == me:
-                    continue
-                try:
-                    data = rpc.call(
-                        f"http://{url}/admin/ec/shard_read?volume={ev.vid}"
-                        f"&shard={other}&offset={off}&size={size}")
-                    if len(data) == size:
-                        have[other] = bytes(data)
-                        break
-                except Exception:  # noqa: BLE001
-                    continue
-        if len(have) < 10:
-            raise rpc.RpcError(
-                500, f"cannot reconstruct shard {sid}: only {len(have)} "
-                     f"shard intervals reachable")
-        import numpy as np
-        arrs = {k: np.frombuffer(v, dtype=np.uint8) for k, v in have.items()}
-        rec = ev.coder.reconstruct(arrs, wanted=[sid])
-        return np.asarray(rec[sid]).tobytes()
+        return None
 
     def _check_write_jwt(self, path: str, query: dict) -> None:
         """JWT gate on the write path (volume_server_handlers.go

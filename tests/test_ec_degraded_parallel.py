@@ -1,0 +1,86 @@
+"""Degraded EC reads must fan out in parallel.
+
+The reference launches one goroutine per shard when reconstructing a
+missing interval (store_ec.go:322-376), so degraded-read latency is the
+slowest single shard fetch — not the sum of up to 13 sequential
+round-trips.  These tests inject a per-holder delay into the shard_read
+RPC and assert the wall-clock stays near one delay, plus unit-check the
+tiered location-cache freshness (store_ec.go:221-229).
+"""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+
+from test_cluster_ec_distributed import _spread, cluster  # noqa: F401
+
+DELAY = 0.4
+
+
+def test_loc_ttl_tiers():
+    urls = ["h1:1"]
+    too_few = {s: urls for s in range(9)}
+    incomplete = {s: urls for s in range(12)}
+    full = {s: urls for s in range(14)}
+    assert VolumeServer._loc_ttl(too_few) == 11.0
+    assert VolumeServer._loc_ttl(incomplete) == 7 * 60.0
+    assert VolumeServer._loc_ttl(full) == 37 * 60.0
+    assert VolumeServer._loc_ttl({}) == 11.0
+
+
+def test_degraded_read_latency_is_one_fetch(cluster, monkeypatch):  # noqa: F811
+    master, servers = cluster
+    client = WeedClient(master.url())
+    vid, fids = _spread(master, servers, client)
+    # Lose shards 0-3 (server 0 keeps only shard 4): a read of any data
+    # interval from server 2 (parity-only holder) must reconstruct from
+    # 10 sources, 6 of them remote.
+    rpc.call_json(f"http://{servers[0].url()}/admin/ec/delete_shards",
+                  "POST", {"volume": vid, "shards": [0, 1, 2, 3]})
+    for vs in servers:
+        vs._send_heartbeat(full=True)
+        vs._ec_loc_cache.clear()
+
+    real_call = rpc.call
+    fetches = []
+
+    def slow_call(url, *args, **kwargs):
+        if "/admin/ec/shard_read" in url:
+            fetches.append(url)
+            time.sleep(DELAY)
+        return real_call(url, *args, **kwargs)
+
+    monkeypatch.setattr(rpc, "call", slow_call)
+    t0 = time.monotonic()
+    data = rpc.call(f"http://{servers[2].url()}/{fids[0]}")
+    elapsed = time.monotonic() - t0
+    assert bytes(data) == b"payload-zero"
+    remote_fetches = len(fetches)
+    assert remote_fetches >= 5, fetches
+    serial_floor = remote_fetches * DELAY
+    # Parallel fan-out: one delay for the gather (plus scheduling slack);
+    # far below the serial sum.
+    assert elapsed < min(serial_floor * 0.6, serial_floor - 2 * DELAY), (
+        f"degraded read took {elapsed:.2f}s for {remote_fetches} remote "
+        f"fetches (serial would be >= {serial_floor:.2f}s)")
+
+
+def test_failed_reconstruction_drops_location_cache(cluster):  # noqa: F811
+    master, servers = cluster
+    client = WeedClient(master.url())
+    vid, fids = _spread(master, servers, client)
+    # Drop 5 shards cluster-wide -> only 9 survive -> reconstruction
+    # fails AND the server forgets the now-useless location map so the
+    # next read refreshes immediately.
+    rpc.call_json(f"http://{servers[0].url()}/admin/ec/delete_shards",
+                  "POST", {"volume": vid, "shards": [0, 1, 2, 3, 4]})
+    for vs in servers:
+        vs._send_heartbeat(full=True)
+        vs._ec_loc_cache.clear()
+    with pytest.raises(rpc.RpcError):
+        rpc.call(f"http://{servers[1].url()}/{fids[0]}")
+    assert vid not in servers[1]._ec_loc_cache
