@@ -61,7 +61,8 @@ class MasterServer:
                  meta_dir: str | None = None,
                  peers: list[str] | None = None,
                  jwt_signing_key: str = "",
-                 jwt_expires_seconds: int = 10):
+                 jwt_expires_seconds: int = 10,
+                 ssl_context=None):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
@@ -79,7 +80,8 @@ class MasterServer:
         self.vg = VolumeGrowth()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
-        self.server = rpc.JsonHttpServer(host, port)
+        self.server = rpc.JsonHttpServer(host, port,
+                                         ssl_context=ssl_context)
         s = self.server
         s.route("POST", "/heartbeat", self._heartbeat)
         s.route("GET", "/dir/assign", self._assign)
@@ -126,12 +128,19 @@ class MasterServer:
         # leader owns id issuance, followers proxy mutating requests
         # (server/raft_server.go, master_server.go:155).
         self.raft = None
+        self._raft_id = f"http://{self.server.host}:{self.server.port}"
         self._id_lock = threading.Lock()
         if peers:
             from .raft import RaftNode
             norm = [p if p.startswith("http") else f"http://{p}"
                     for p in peers]
-            me = self.url()
+            # Raft identities are scheme-normalized http:// addresses
+            # regardless of TLS: -peers lists are written as host:port,
+            # and whether the wire is encrypted is the transport's
+            # decision (rpc.set_client_ssl_context force_https), not
+            # part of a node's identity.
+            me = self._raft_id = \
+                f"http://{self.server.host}:{self.server.port}"
             if me not in norm:
                 # A textual alias of this node left in the peer list
                 # would grant phantom self-votes (split brain) and
@@ -179,7 +188,11 @@ class MasterServer:
         """Forward a mutating request to the current leader
         (master_server.go proxyToLeader)."""
         leader = self.raft.leader() if self.raft else None
-        if not leader or leader == self.url():
+        # Compare against the scheme-normalized raft identity, not
+        # self.url(): under TLS url() is https:// while raft ids stay
+        # http://, and a stale self-leader hint must 503 here instead
+        # of proxying the request to ourselves.
+        if not leader or leader == self._raft_id:
             raise rpc.RpcError(503, "no leader elected yet; retry")
         if query.get("proxied"):
             # Stale mutual leader hints during an election would bounce

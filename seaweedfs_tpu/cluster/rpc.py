@@ -411,24 +411,44 @@ class JsonHttpServer:
 # ~0.25ms/request; this is a raw-socket keep-alive pool.
 
 _client_ssl_context = None
+_force_https = False
 
 
-def set_client_ssl_context(ctx) -> None:
+def set_client_ssl_context(ctx, force_https: bool = False) -> None:
     """Install the ssl.SSLContext used for https:// RPCs (security.toml
-    TLS plane — see utils/security)."""
-    global _client_ssl_context
-    _client_ssl_context = ctx
+    TLS plane — see utils/security).  With force_https=True every
+    outgoing http:// URL is dialed over TLS instead: cluster code builds
+    addresses as `http://host:port`, and like the reference's gRPC dial
+    options (security/tls.go LoadClientTLS) the transport — not each
+    call site — decides whether the wire is encrypted.  Pass ctx=None to
+    reset (plaintext)."""
+    global _client_ssl_context, _force_https
+    # Connections negotiated under the previous plane must not outlive
+    # it: close everything idle AND bump the pool generation so
+    # in-flight connections are dropped (not re-pooled) when released.
+    # Context swap and generation bump happen under the pool lock so
+    # acquire() can snapshot (ctx, gen) atomically — a dial racing the
+    # rotation can't get the old identity stamped with the new gen.
+    with _pool._lock:
+        _client_ssl_context = ctx
+        _force_https = bool(ctx) and force_https
+        _pool.gen += 1
+        for conns in _pool._idle.values():
+            for conn in conns:
+                conn.close()
+        _pool._idle.clear()
 
 
 class _Conn:
     """One pooled keep-alive connection."""
 
-    __slots__ = ("sock", "rf", "key")
+    __slots__ = ("sock", "rf", "key", "gen")
 
-    def __init__(self, sock: socket.socket, key: tuple):
+    def __init__(self, sock: socket.socket, key: tuple, gen: int = 0):
         self.sock = sock
         self.rf = sock.makefile("rb", buffering=1 << 16)
         self.key = key
+        self.gen = gen
 
     def close(self) -> None:
         try:
@@ -522,6 +542,10 @@ class _ConnPool:
         self.max_idle = max_idle_per_host
         self._idle: dict[tuple, list[_Conn]] = {}
         self._lock = threading.Lock()
+        # Bumped on TLS-plane changes: connections from an older
+        # generation are never re-pooled, so a rotated client identity
+        # can't keep riding sessions negotiated under the old one.
+        self.gen = 0
 
     def acquire(self, scheme: str, host: str, port: int,
                 timeout: float):
@@ -533,20 +557,25 @@ class _ConnPool:
                 conn = pool.pop()
                 conn.sock.settimeout(timeout)
                 return conn, True
+            # Snapshot the TLS plane atomically with its generation:
+            # if a rotation lands during our handshake below, this
+            # conn keeps the OLD gen and release() will drop it.
+            ctx, gen = _client_ssl_context, self.gen
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if scheme == "https":
             import ssl
-            ctx = _client_ssl_context or ssl.create_default_context()
+            ctx = ctx or ssl.create_default_context()
             sock = ctx.wrap_socket(sock, server_hostname=host)
-        return _Conn(sock, key), False
+        return _Conn(sock, key, gen), False
 
     def release(self, conn: _Conn) -> None:
         with self._lock:
-            pool = self._idle.setdefault(conn.key, [])
-            if len(pool) < self.max_idle:
-                pool.append(conn)
-                return
+            if conn.gen == self.gen:
+                pool = self._idle.setdefault(conn.key, [])
+                if len(pool) < self.max_idle:
+                    pool.append(conn)
+                    return
         conn.close()
 
 
@@ -560,6 +589,8 @@ def _request(url: str, method: str, body, timeout: float,
     reused keep-alive connection (failure before any response bytes)."""
     u = urllib.parse.urlsplit(url)
     scheme = u.scheme or "http"
+    if scheme == "http" and _force_https:
+        scheme = "https"
     host = u.hostname or "127.0.0.1"
     port = u.port or (443 if scheme == "https" else 80)
     path = u.path or "/"

@@ -45,7 +45,8 @@ class VolumeServer:
                  data_center: str = "DefaultDataCenter",
                  rack: str = "DefaultRack",
                  pulse_seconds: int = 2,
-                 jwt_signing_key: str = ""):
+                 jwt_signing_key: str = "",
+                 ssl_context=None):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -64,7 +65,8 @@ class VolumeServer:
         self.data_center = data_center
         self.rack = rack
         self.pulse_seconds = pulse_seconds
-        self.server = rpc.JsonHttpServer(host, port)
+        self.server = rpc.JsonHttpServer(host, port,
+                                         ssl_context=ssl_context)
         self.store = Store(directories, max_volume_counts,
                            ip=host, port=self.server.port)
         self.ec_volumes: dict[int, EcVolume] = {}

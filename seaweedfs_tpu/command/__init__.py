@@ -116,6 +116,22 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     flags, rest = parse_flags(args)
     glog.setup(verbosity=flags.get_int("v", 0))
+    # Every cluster-dialing command — servers AND clients (upload,
+    # shell, mount, …) — goes through the TLS plane when security.toml
+    # configures [grpc.client], matching the reference where each
+    # command's gRPC dials go through security.LoadClientTLS.  A broken
+    # security.toml fails closed with a message; exempt are the
+    # commands needed to repair it and the offline local-file tools
+    # that never dial the cluster.
+    if name not in ("scaffold", "version", "fix", "compact", "export"):
+        from ..utils.security import (install_cluster_tls,
+                                      security_configuration)
+        try:
+            install_cluster_tls(security_configuration())
+        except Exception as e:  # noqa: BLE001 — bad TOML / cert paths
+            print(f"security.toml: {e}\n(fix it, or regenerate with "
+                  f"`weed scaffold -config=security`)", file=sys.stderr)
+            return 2
     try:
         return cmd.run(flags, rest)
     except KeyboardInterrupt:

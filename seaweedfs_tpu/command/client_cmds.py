@@ -107,6 +107,48 @@ expires_after_seconds = 10
 [access]
 ui = false
 white_list = []
+
+# TLS for all cluster RPC (reference weed/security/tls.go): every
+# server presents its [grpc.<role>] cert; cluster clients dial with
+# [grpc.client].  Leave blank for plaintext.
+#
+# client_auth: "none" (default) serves ordinary TLS so standard
+# end-user clients (curl, aws-cli, davfs2, browsers) can connect;
+# "require" additionally demands a CA-signed client certificate — the
+# reference's mutual-TLS RequireAndVerifyClientCert — appropriate when
+# the port is reachable only by cluster peers.
+[grpc]
+ca = ""
+
+[grpc.master]
+cert = ""
+key  = ""
+# client_auth = "require"
+
+[grpc.volume]
+cert = ""
+key  = ""
+# client_auth = "require"
+
+[grpc.filer]
+cert = ""
+key  = ""
+
+[grpc.s3]
+cert = ""
+key  = ""
+
+[grpc.webdav]
+cert = ""
+key  = ""
+
+[grpc.msg_broker]
+cert = ""
+key  = ""
+
+[grpc.client]
+cert = ""
+key  = ""
 ''',
     "master": '''\
 # master.toml

@@ -15,6 +15,25 @@ from ..utils import glog
 from . import Command, Flags, register
 
 
+def _security(component: str):
+    """Server SSLContext for `component` from the process-wide
+    security.toml (reference: security.LoadServerTLS with the shared
+    viper config, weed/security/tls.go).  The client half of the plane
+    is installed once by the CLI dispatcher before any command runs.
+    Config mistakes (bad client_auth, missing cert files) exit with a
+    message instead of a traceback."""
+    from ..utils.security import load_server_tls, security_configuration
+    try:
+        ctx = load_server_tls(security_configuration(), component)
+    except Exception as e:  # noqa: BLE001 — bad values / cert paths
+        import sys
+        print(f"security.toml [grpc.{component}]: {e}", file=sys.stderr)
+        raise SystemExit(2) from None
+    if ctx is not None:
+        glog.infof("serving TLS (security.toml [grpc.%s])", component)
+    return ctx
+
+
 def _wait_forever(servers: list) -> int:
     stop = threading.Event()
 
@@ -44,7 +63,8 @@ def run_master(flags: Flags, args: list[str]) -> int:
         default_replication=flags.get("defaultReplication", "000"),
         garbage_threshold=flags.get_float("garbageThreshold", 0.3),
         peers=peers or None,
-        jwt_signing_key=flags.get("jwt.key", ""))
+        jwt_signing_key=flags.get("jwt.key", ""),
+        ssl_context=_security("master"))
     m.start()
     glog.infof("master serving at %s", m.server.url())
     return _wait_forever([m])
@@ -65,7 +85,8 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         max_volume_counts=maxes,
         data_center=flags.get("dataCenter", "DefaultDataCenter"),
         rack=flags.get("rack", "DefaultRack"),
-        jwt_signing_key=flags.get("jwt.key", ""))
+        jwt_signing_key=flags.get("jwt.key", ""),
+        ssl_context=_security("volume"))
     vs.start()
     glog.infof("volume server serving at %s (dirs %s)",
                vs.server.url(), dirs)
@@ -78,7 +99,8 @@ def run_msg_broker(flags: Flags, args: list[str]) -> int:
     mb = MessageBroker(
         filer if filer.startswith("http") else f"http://{filer}",
         host=flags.get("ip", "127.0.0.1"),
-        port=flags.get_int("port", 17777))
+        port=flags.get_int("port", 17777),
+        ssl_context=_security("msg_broker"))
     mb.start()
     glog.infof("message broker serving at %s", mb.url())
     return _wait_forever([mb])
@@ -94,7 +116,8 @@ def run_filer(flags: Flags, args: list[str]) -> int:
         store_path=flags.get("dir") or None,
         collection=flags.get("collection", ""),
         replication=flags.get("defaultReplicaPlacement") or None,
-        metrics_port=flags.get_int("metricsPort", 0) or None)
+        metrics_port=flags.get_int("metricsPort", 0) or None,
+        ssl_context=_security("filer"))
     fs.start()
     glog.infof("filer serving at %s", fs.server.url())
     return _wait_forever([fs])
@@ -128,7 +151,8 @@ def run_s3(flags: Flags, args: list[str]) -> int:
         host=flags.get("ip", "127.0.0.1"),
         port=flags.get_int("port", 8333),
         identities=_s3_identities(flags.get("config")),
-        metrics_port=flags.get_int("metricsPort", 0) or None)
+        metrics_port=flags.get_int("metricsPort", 0) or None,
+        ssl_context=_security("s3"))
     s3.start()
     glog.infof("s3 gateway serving at %s", s3.server.url())
     return _wait_forever([s3])
@@ -140,7 +164,8 @@ def run_webdav(flags: Flags, args: list[str]) -> int:
         filer_url=_norm_master(flags.get("filer", "127.0.0.1:8888")),
         host=flags.get("ip", "127.0.0.1"),
         port=flags.get_int("port", 7333),
-        metrics_port=flags.get_int("metricsPort", 0) or None)
+        metrics_port=flags.get_int("metricsPort", 0) or None,
+        ssl_context=_security("webdav"))
     dav.start()
     glog.infof("webdav serving at %s", dav.server.url())
     return _wait_forever([dav])
@@ -156,7 +181,8 @@ def run_server(flags: Flags, args: list[str]) -> int:
                meta_dir=flags.get("mdir") or None,
                volume_size_limit_mb=flags.get_int(
                    "volumeSizeLimitMB", 30 * 1024),
-               default_replication=flags.get("defaultReplication", "000"))
+               default_replication=flags.get("defaultReplication", "000"),
+               ssl_context=_security("master"))
     m.start()
     servers.append(m)
     dirs = [d for d in flags.get("dir", "./data").split(",") if d]
@@ -168,7 +194,8 @@ def run_server(flags: Flags, args: list[str]) -> int:
                       max_volume_counts=maxes,
                       data_center=flags.get("dataCenter",
                                             "DefaultDataCenter"),
-                      rack=flags.get("rack", "DefaultRack"))
+                      rack=flags.get("rack", "DefaultRack"),
+                      ssl_context=_security("volume"))
     vs.start()
     servers.append(vs)
     glog.infof("master at %s, volume at %s", m.server.url(),
@@ -177,21 +204,24 @@ def run_server(flags: Flags, args: list[str]) -> int:
         from ..filer.server import FilerServer
         fs = FilerServer(master_url=m.server.url(), host=ip,
                          port=flags.get_int("filer.port", 8888),
-                         store_path=flags.get("filer.dir") or None)
+                         store_path=flags.get("filer.dir") or None,
+                         ssl_context=_security("filer"))
         fs.start()
         servers.append(fs)
         glog.infof("filer at %s", fs.server.url())
         if flags.get_bool("s3", False):
             from ..s3api.server import S3ApiServer
             s3 = S3ApiServer(filer_url=fs.server.url(), host=ip,
-                             port=flags.get_int("s3.port", 8333))
+                             port=flags.get_int("s3.port", 8333),
+                             ssl_context=_security("s3"))
             s3.start()
             servers.append(s3)
             glog.infof("s3 at %s", s3.server.url())
         if flags.get_bool("webdav", False):
             from ..webdav.server import WebDavServer
             dav = WebDavServer(filer_url=fs.server.url(), host=ip,
-                               port=flags.get_int("webdav.port", 7333))
+                               port=flags.get_int("webdav.port", 7333),
+                               ssl_context=_security("webdav"))
             dav.start()
             servers.append(dav)
             glog.infof("webdav at %s", dav.server.url())

@@ -33,7 +33,8 @@ class FilerServer:
                  port: int = 0, store_path: str | None = None,
                  chunk_size: int = 4 * 1024 * 1024,
                  collection: str = "", replication: str | None = None,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 ssl_context=None):
         # Accepts an HA seed list; all master traffic (including the
         # /dir/* proxies mounts rely on) fails over via WeedClient.
         self.client = WeedClient(master_url)
@@ -46,7 +47,8 @@ class FilerServer:
                            delete_file_id_fn=self._delete_file_ids,
                            meta_log_dir=meta_log_dir)
         self.streamer = ChunkStreamer(self.client)
-        self.server = rpc.JsonHttpServer(host, port)
+        self.server = rpc.JsonHttpServer(host, port,
+                                         ssl_context=ssl_context)
         s = self.server
         s.route("GET", "/.meta/subscribe", self._meta_subscribe)
         s.route("GET", "/.meta/info", self._meta_info)

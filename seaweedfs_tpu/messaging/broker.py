@@ -25,9 +25,11 @@ LIVENESS_TTL = 10.0
 
 class MessageBroker:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
-                 port: int = 0, register_interval: float = 3.0):
+                 port: int = 0, register_interval: float = 3.0,
+                 ssl_context=None):
         self.filer = FilerProxy(filer_url)
-        self.server = rpc.JsonHttpServer(host, port)
+        self.server = rpc.JsonHttpServer(host, port,
+                                         ssl_context=ssl_context)
         self.register_interval = register_interval
         self._logs: dict[tuple[str, str, int], TopicPartitionLog] = {}
         self._lock = threading.Lock()

@@ -103,10 +103,12 @@ class S3ApiServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
                  port: int = 0,
                  identities: list[Identity] | None = None,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 ssl_context=None):
         self.filer = FilerProxy(filer_url)
         self.iam = IdentityAccessManagement(identities)
-        self.server = rpc.JsonHttpServer(host, port, pass_headers=True)
+        self.server = rpc.JsonHttpServer(host, port, pass_headers=True,
+                                         ssl_context=ssl_context)
         for method in ("GET", "HEAD", "PUT", "POST", "DELETE"):
             self.server.prefix_route(method, "/", self._route)
         # Bucket names own the URL namespace, so /metrics lives on its

@@ -40,10 +40,12 @@ def _http_date(ts: float) -> str:
 class WebDavServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
                  port: int = 0, root: str = "/",
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 ssl_context=None):
         self.filer = FilerProxy(filer_url)
         self.root = "/" + root.strip("/") if root.strip("/") else ""
-        self.server = rpc.JsonHttpServer(host, port, pass_headers=True)
+        self.server = rpc.JsonHttpServer(host, port, pass_headers=True,
+                                         ssl_context=ssl_context)
         for method in ("OPTIONS", "PROPFIND", "PROPPATCH", "GET", "HEAD",
                        "PUT", "POST", "DELETE", "MKCOL", "MOVE", "COPY",
                        "LOCK", "UNLOCK"):
