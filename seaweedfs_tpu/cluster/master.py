@@ -139,8 +139,7 @@ class MasterServer:
             # and whether the wire is encrypted is the transport's
             # decision (rpc.set_client_ssl_context force_https), not
             # part of a node's identity.
-            me = self._raft_id = \
-                f"http://{self.server.host}:{self.server.port}"
+            me = self._raft_id
             if me not in norm:
                 # A textual alias of this node left in the peer list
                 # would grant phantom self-votes (split brain) and

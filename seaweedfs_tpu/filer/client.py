@@ -58,6 +58,13 @@ class FilerProxy:
     def mkdir(self, path: str) -> None:
         rpc.call(self._q(path) + "?mkdir=true", "POST", b"")
 
+    def hardlink(self, src: str, dst: str) -> dict:
+        """`ln src dst` (filerstore_hardlink.go plane)."""
+        out = rpc.call(self._q(dst) + "?hardlink.from=" +
+                       urllib.parse.quote(src, safe=""), "POST", b"")
+        assert isinstance(out, dict)
+        return out
+
     def rename(self, path: str, new_path: str) -> None:
         rpc.call(self._q(path) + "?mv.to=" +
                  urllib.parse.quote(new_path, safe=""), "POST", b"")

@@ -72,8 +72,21 @@ class Filer:
                  | None = None,
                  log_capacity: int = 4096,
                  meta_log_dir: str | None = None,
-                 signature: int | None = None):
+                 signature: int | None = None,
+                 fetch_chunk_fn: Callable[[str], bytes] | None = None):
         self.store = store or MemoryStore()
+        # Serializes every hardlink-doc read-modify-write: the HTTP
+        # server is thread-per-connection, and a lost counter update
+        # either leaks content forever or frees shared chunks while
+        # links remain.  RLock because find_entry (expiry release) can
+        # re-enter from inside a guarded section.
+        self._hl_lock = threading.RLock()
+        # Fetches a stored blob by file id — needed to expand chunk
+        # manifests when freeing a deleted file's chunks (the manifest
+        # AND its inner chunks must both go; filer_deletion.go resolves
+        # manifests before deleting).  Without it, manifest chunks are
+        # deleted but their inner chunks leak to vacuum.
+        self._fetch_chunk = fetch_chunk_fn
         # Filer signature: random id stamped on every locally-originated
         # event — the cross-cluster sync loop-breaker (filer.go filer
         # Signature field).
@@ -104,15 +117,122 @@ class Filer:
                                       daemon=True, name="filer-gc")
         self._pump.start()
 
+    # -- hardlinks (filerstore_hardlink.go) -----------------------------------
+    #
+    # A hardlinked file's content lives ONCE in the store's KV plane
+    # under its hard_link_id; every path entry in the link group is a
+    # pointer carrying that id.  Reads overlay the KV blob
+    # (maybeReadHardLink), writes through any path update the blob
+    # (setHardLink), and deletes decrement the shared counter, freeing
+    # the chunks only when the last link goes (DeleteHardLink).
+
+    _HL_PREFIX = "hardlink/"
+
+    def _hl_read(self, hid: str) -> dict | None:
+        import json
+        blob = self.store.kv_get(self._HL_PREFIX + hid)
+        return None if blob is None else json.loads(blob)
+
+    def _hl_write(self, hid: str, doc: dict) -> None:
+        import json
+        self.store.kv_put(self._HL_PREFIX + hid, json.dumps(doc).encode())
+
+    def _hl_doc(self, entry: Entry, counter: int) -> dict:
+        return {"attributes": entry.attributes.to_dict(),
+                "chunks": [c.to_dict() for c in entry.chunks],
+                "hard_link_counter": counter}
+
+    def _maybe_read_hardlink(self, e: Entry) -> Entry:
+        if not e.hard_link_id:
+            return e
+        doc = self._hl_read(e.hard_link_id)
+        if doc is not None:
+            e.attributes = Attributes.from_dict(doc["attributes"])
+            e.chunks = [FileChunk.from_dict(c) for c in doc["chunks"]]
+            e.hard_link_counter = doc["hard_link_counter"]
+        return e
+
+    def _hl_store_content(self, entry: Entry) -> None:
+        """Write entry content through to the shared doc.  The counter
+        ALWAYS comes from the store side: a client replaying a cached
+        entry (stale counter) must never clobber the live link count —
+        that would free shared chunks while links still exist."""
+        with self._hl_lock:
+            doc = self._hl_read(entry.hard_link_id)
+            counter = doc["hard_link_counter"] if doc \
+                else max(1, entry.hard_link_counter)
+            entry.hard_link_counter = counter
+            self._hl_write(entry.hard_link_id,
+                           self._hl_doc(entry, counter))
+
+    def _release_hardlink(self, e: Entry, delete_chunks: bool) -> None:
+        """One path in the link group is going away: decrement the
+        shared counter; the last release frees the content."""
+        with self._hl_lock:
+            doc = self._hl_read(e.hard_link_id)
+            if doc is None:
+                if delete_chunks:
+                    self._queue_chunk_deletion(e.chunks)
+                return
+            doc["hard_link_counter"] -= 1
+            if doc["hard_link_counter"] <= 0:
+                self.store.kv_delete(self._HL_PREFIX + e.hard_link_id)
+                if delete_chunks:
+                    self._queue_chunk_deletion(
+                        [FileChunk.from_dict(c) for c in doc["chunks"]])
+            else:
+                self._hl_write(e.hard_link_id, doc)
+
+    def create_hardlink(self, src: str, dst: str) -> Entry:
+        """`ln src dst`: dst becomes another name for src's content.
+        The first link converts src into the KV-backed form."""
+        import secrets
+        src, dst = _norm(src), _norm(dst)
+        if self.exists(dst):
+            raise FilerError(f"{dst} already exists")
+        with self._hl_lock:
+            e = self._maybe_read_hardlink(self.store.find_entry(src))
+            if e.is_directory:
+                raise FilerError(f"cannot hardlink directory {src}")
+            if not e.hard_link_id:
+                before = e.clone()
+                e.hard_link_id = secrets.token_hex(8)
+                e.hard_link_counter = 1
+                self._hl_write(e.hard_link_id, self._hl_doc(e, 1))
+                self.store.update_entry(e)
+                # The conversion is a mutation of src — subscribers
+                # (filer.sync, mount meta caches) must see the entry
+                # gain its hard_link_id or replicas would later free
+                # shared chunks on src's deletion.
+                self._notify(e.dir, before, e)
+            doc = self._hl_read(e.hard_link_id)
+            if doc is None:
+                # Entry row survived but the doc is gone (lost KV
+                # plane): repair by re-seeding from the entry.
+                doc = self._hl_doc(e, max(1, e.hard_link_counter))
+            doc["hard_link_counter"] += 1
+            self._hl_write(e.hard_link_id, doc)
+            link = Entry(path=dst, attributes=e.attributes,
+                         chunks=[c for c in e.chunks],
+                         hard_link_id=e.hard_link_id,
+                         hard_link_counter=doc["hard_link_counter"])
+        self._ensure_parents(dst.rsplit("/", 1)[0] or "/", e.attributes)
+        self.store.insert_entry(link)
+        self._notify(link.dir, None, link)
+        return link
+
     # -- namespace CRUD ------------------------------------------------------
 
     def find_entry(self, path: str) -> Entry:
         path = _norm(path)
         if path == "/":
             return ROOT.clone()
-        e = self.store.find_entry(path)
+        e = self._maybe_read_hardlink(self.store.find_entry(path))
         if e.is_expired():
-            self._queue_chunk_deletion(e.chunks)
+            if e.hard_link_id:
+                self._release_hardlink(e, delete_chunks=True)
+            else:
+                self._queue_chunk_deletion(e.chunks)
             self.store.delete_entry(path)
             self._notify(e.dir, e, None)
             raise NotFound(path)
@@ -151,22 +271,37 @@ class Filer:
                 # event (filer.go:163-176) — otherwise two synced filers
                 # ping-pong directory updates forever.
                 return old
+            old = self._maybe_read_hardlink(old)
+            if old.hard_link_id and not entry.hard_link_id:
+                # Overwriting one name of a link group rewrites the
+                # shared content — every other link sees it (POSIX
+                # open(O_TRUNC) on a hardlinked file).
+                entry.hard_link_id = old.hard_link_id
+                entry.hard_link_counter = old.hard_link_counter
             garbage = minus_chunks(old.chunks, entry.chunks)
             self._queue_chunk_deletion(garbage)
         if not entry.attributes.crtime:
             entry.attributes.crtime = time.time()
         if not entry.attributes.mtime:
             entry.attributes.mtime = time.time()
+        if entry.hard_link_id:
+            self._hl_store_content(entry)
         self.store.insert_entry(entry)
         self._notify(entry.dir, old, entry)
         return entry
 
     def update_entry(self, entry: Entry) -> Entry:
         entry.path = _norm(entry.path)
-        old = self.store.find_entry(entry.path)  # must exist
+        old = self._maybe_read_hardlink(
+            self.store.find_entry(entry.path))  # must exist
+        if old.hard_link_id and not entry.hard_link_id:
+            entry.hard_link_id = old.hard_link_id
+            entry.hard_link_counter = old.hard_link_counter
         garbage = minus_chunks(old.chunks, entry.chunks)
         self._queue_chunk_deletion(garbage)
         entry.attributes.mtime = time.time()
+        if entry.hard_link_id:
+            self._hl_store_content(entry)
         self.store.update_entry(entry)
         self._notify(entry.dir, old, entry)
         return entry
@@ -206,13 +341,17 @@ class Filer:
             children = self.store.list_directory_entries(path, "", True, 2)
             if children and not recursive:
                 raise FilerError(f"{path} is not empty")
-            if delete_chunks:
-                for child in list(self._walk(path)):
-                    if child.path == path:
-                        continue
+            for child in list(self._walk(path)):
+                if child.path == path:
+                    continue
+                if child.hard_link_id:
+                    self._release_hardlink(child, delete_chunks)
+                elif delete_chunks:
                     self._queue_chunk_deletion(child.chunks)
             self.store.delete_folder_children(path)
-        if delete_chunks:
+        if e.hard_link_id:
+            self._release_hardlink(e, delete_chunks)
+        elif delete_chunks:
             self._queue_chunk_deletion(e.chunks)
         self.store.delete_entry(path)
         self._notify(e.dir, e, None)
@@ -234,8 +373,12 @@ class Filer:
             if not page:
                 break
             for e in page:
+                e = self._maybe_read_hardlink(e)
                 if e.is_expired():
-                    self._queue_chunk_deletion(e.chunks)
+                    if e.hard_link_id:
+                        self._release_hardlink(e, delete_chunks=True)
+                    else:
+                        self._queue_chunk_deletion(e.chunks)
                     self.store.delete_entry(e.path)
                     self._notify(e.dir, e, None)
                     continue
@@ -280,6 +423,15 @@ class Filer:
     def _queue_chunk_deletion(self, chunks: list[FileChunk]) -> None:
         if not chunks:
             return
+        from .filechunk_manifest import (has_chunk_manifest,
+                                         resolve_chunk_manifest)
+        if has_chunk_manifest(chunks) and self._fetch_chunk is not None:
+            try:
+                data, manifests = resolve_chunk_manifest(
+                    self._fetch_chunk, chunks)
+                chunks = data + manifests
+            except Exception:  # noqa: BLE001 — an unreadable manifest
+                pass  # still frees the chunks we can see
         with self._del_lock:
             self._pending_deletions.extend(c.file_id for c in chunks)
 

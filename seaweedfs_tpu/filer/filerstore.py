@@ -56,11 +56,14 @@ class FilerStore:
                                limit: int) -> list[Entry]:
         raise NotImplementedError
 
-    # KV (filer.proto KvGet/KvPut — used for sync checkpoints etc.)
+    # KV (filer.proto KvGet/KvPut — sync checkpoints, hardlink blobs)
     def kv_put(self, key: str, value: bytes) -> None:
         raise NotImplementedError
 
     def kv_get(self, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def kv_delete(self, key: str) -> None:
         raise NotImplementedError
 
     def close(self) -> None:
@@ -161,6 +164,10 @@ class MemoryStore(FilerStore):
         with self._lock:
             return self._kv.get(key)
 
+    def kv_delete(self, key: str) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+
 
 class SqliteStore(FilerStore):
     """sqlite3-backed store — the abstract_sql analog
@@ -254,6 +261,11 @@ class SqliteStore(FilerStore):
             row = self._db.execute(
                 "SELECT v FROM filer_kv WHERE k=?", (key,)).fetchone()
         return bytes(row[0]) if row else None
+
+    def kv_delete(self, key: str) -> None:
+        with self._lock:
+            self._db.execute("DELETE FROM filer_kv WHERE k=?", (key,))
+            self._db.commit()
 
     def close(self) -> None:
         with self._lock:

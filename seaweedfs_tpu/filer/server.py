@@ -43,10 +43,11 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         meta_log_dir = store_path + ".metalog" if store_path else None
+        self.streamer = ChunkStreamer(self.client)
         self.filer = Filer(store=store_for_path(store_path),
                            delete_file_id_fn=self._delete_file_ids,
-                           meta_log_dir=meta_log_dir)
-        self.streamer = ChunkStreamer(self.client)
+                           meta_log_dir=meta_log_dir,
+                           fetch_chunk_fn=self.streamer._fetch)
         self.server = rpc.JsonHttpServer(host, port,
                                          ssl_context=ssl_context)
         s = self.server
@@ -97,6 +98,28 @@ class FilerServer:
                 self.client.delete(fid)
             except Exception:  # noqa: BLE001 — volume may be down/EC'd;
                 pass           # orphan blobs are vacuum's problem
+
+    def _save_blob(self, data: bytes, collection: str = "",
+                   ttl: str = ""):
+        """Store one blob as a single chunk — used for chunk-manifest
+        bodies, which must never themselves be split."""
+        from .entry import FileChunk
+        a = self.client.assign(collection=collection or self.collection,
+                               replication=self.replication, ttl=ttl)
+        url = f"http://{a['url']}/{a['fid']}"
+        if a.get("auth"):
+            url += f"?jwt={a['auth']}"
+        rpc.call(url, "POST", data)
+        return FileChunk(file_id=a["fid"], offset=0, size=len(data),
+                         mtime=time.time_ns())
+
+    def _manifestize(self, chunks, collection: str = "", ttl: str = ""):
+        """Collapse huge chunk lists before they hit the metadata store
+        (filer_server_handlers_write_autochunk.go saveMetaData ->
+        MaybeManifestize)."""
+        from .filechunk_manifest import maybe_manifestize
+        return maybe_manifestize(
+            lambda data: self._save_blob(data, collection, ttl), chunks)
 
     # -- read ----------------------------------------------------------------
 
@@ -192,11 +215,26 @@ class FilerServer:
             # and filer.sync, which move chunks without re-uploading).
             d = json.loads(body)
             d["path"] = path
+            entry = Entry.from_dict(d)
+            entry.chunks = self._manifestize(
+                entry.chunks, entry.attributes.collection)
             try:
                 with self.filer.with_signatures(self._signatures(query)):
-                    e = self.filer.create_entry(Entry.from_dict(d))
+                    e = self.filer.create_entry(entry)
             except FilerError as err:
                 raise rpc.RpcError(409, str(err)) from None
+            return e.to_dict()
+        if "hardlink.from" in query:
+            # `ln` through the HTTP surface: POST /new/name?hardlink.from=
+            # /existing/file (the filer gRPC CreateEntry-with-HardLinkId
+            # path the FUSE mount uses in the reference).
+            src = query["hardlink.from"]
+            try:
+                e = self.filer.create_hardlink(src, path)
+            except NotFound:
+                raise rpc.RpcError(404, f"{src} not found") from None
+            except FilerError as err:
+                raise rpc.RpcError(400, str(err)) from None
             return e.to_dict()
         if "mv.to" in query:
             dst = query["mv.to"]
@@ -225,7 +263,7 @@ class FilerServer:
         writer = ChunkedWriter(
             self.client, chunk_size=self.chunk_size,
             collection=collection, replication=self.replication, ttl=ttl)
-        chunks = writer.write(body)
+        chunks = self._manifestize(writer.write(body), collection, ttl)
         attr = Attributes(
             mtime=time.time(), crtime=time.time(),
             mime=query.get("_content_type",

@@ -46,7 +46,11 @@ class ChunkCache:
 
 
 class ChunkStreamer:
-    """Resolves chunk views and fetches the bytes (StreamContent)."""
+    """Resolves chunk views and fetches the bytes (StreamContent).
+
+    Manifest chunks are expanded lazily at read time — the entry's
+    metadata stays small while the full chunk list lives in the blob
+    store (filechunk_manifest.go ResolveChunkManifest)."""
 
     def __init__(self, client: WeedClient,
                  cache: ChunkCache | None = None):
@@ -60,10 +64,21 @@ class ChunkStreamer:
             self.cache.put(file_id, data)
         return data
 
+    def resolve(self, chunks: list[FileChunk]) -> list[FileChunk]:
+        """Expand any manifest chunks into their data chunks (the
+        manifest blobs ride the same chunk cache as file data)."""
+        from .filechunk_manifest import (has_chunk_manifest,
+                                         resolve_chunk_manifest)
+        if not has_chunk_manifest(chunks):
+            return chunks
+        data, _manifests = resolve_chunk_manifest(self._fetch, chunks)
+        return data
+
     def read(self, chunks: list[FileChunk], offset: int = 0,
              size: int = -1) -> bytes:
         """Materialize byte range [offset, offset+size) (gaps are zeros,
         like a sparse file)."""
+        chunks = self.resolve(chunks)
         file_size = total_size(chunks)
         if size < 0:
             size = max(file_size - offset, 0)
@@ -84,6 +99,7 @@ class ChunkStreamer:
                      chunk_bytes: int = 4 * 1024 * 1024
                      ) -> Iterator[bytes]:
         """Yield the range in bounded pieces (HTTP streaming)."""
+        chunks = self.resolve(chunks)
         file_size = total_size(chunks)
         if size < 0:
             size = max(file_size - offset, 0)

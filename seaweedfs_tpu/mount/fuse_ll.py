@@ -84,7 +84,7 @@ class c_fuse_operations(ctypes.Structure):
                         ctypes.c_char_p)),
         ("rename", _op(ctypes.c_int, ctypes.c_char_p,
                        ctypes.c_char_p)),
-        ("link", ctypes.c_void_p),
+        ("link", _op(ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p)),
         ("chmod", _op(ctypes.c_int, ctypes.c_char_p, ctypes.c_uint)),
         ("chown", _op(ctypes.c_int, ctypes.c_char_p, ctypes.c_uint,
                       ctypes.c_uint)),
@@ -250,6 +250,11 @@ class FuseMount:
         def op_symlink(target, path):
             w.symlink(_p(target), _p(path))
         ops.symlink = type(ops.symlink)(op_symlink)
+
+        @wrap
+        def op_link(src, dst):
+            w.link(_p(src), _p(dst))
+        ops.link = type(ops.link)(op_link)
 
         @wrap
         def op_readlink(path, buf, size):

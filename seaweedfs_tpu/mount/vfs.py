@@ -195,7 +195,8 @@ class WFS:
             mode = stat_m.S_IFLNK | 0o777
         else:
             mode = stat_m.S_IFREG | attr.get("mode", 0o644)
-        return {"st_mode": mode, "st_nlink": 1,
+        return {"st_mode": mode,
+                "st_nlink": max(1, e.get("hard_link_counter", 0)),
                 "st_size": size,
                 "st_mtime": attr.get("mtime", 0.0) or 0.0,
                 "st_ctime": attr.get("crtime", 0.0) or 0.0,
@@ -254,6 +255,14 @@ class WFS:
         self.proxy.rename(self._full(old), self._full(new))
         self.meta_cache.invalidate(self._full(old))
         self.meta_cache.invalidate(self._full(new))
+
+    def link(self, src: str, dst: str) -> None:
+        """Hardlink: dst becomes another name for src's content, backed
+        by the filer's hard_link_id indirection
+        (filerstore_hardlink.go; filesys/dir_link.go Link)."""
+        self.proxy.hardlink(self._full(src), self._full(dst))
+        self.meta_cache.invalidate(self._full(src))
+        self.meta_cache.invalidate(self._full(dst))
 
     def symlink(self, target: str, path: str) -> None:
         entry = {"attributes": {"symlink_target": target,
