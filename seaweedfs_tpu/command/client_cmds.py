@@ -177,7 +177,9 @@ enabled = false
 enabled = true
 file = "filer.db"
 
-[leveldb_file]
+# Embedded ordered-KV store (the reference's leveldb default):
+# log-structured, crash-safe, directory-backed.
+[ordered_kv]
 enabled = false
 dir = "."
 ''',

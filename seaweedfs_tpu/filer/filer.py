@@ -167,33 +167,44 @@ class Filer:
 
     def _release_hardlink(self, e: Entry, delete_chunks: bool) -> None:
         """One path in the link group is going away: decrement the
-        shared counter; the last release frees the content."""
+        shared counter; the last release frees the content.  Chunk
+        deletion (which may resolve manifests over the network) happens
+        AFTER the lock is dropped so unrelated hardlink traffic never
+        stalls behind volume-server fetches."""
+        to_free: list[FileChunk] | None = None
         with self._hl_lock:
             doc = self._hl_read(e.hard_link_id)
             if doc is None:
-                if delete_chunks:
-                    self._queue_chunk_deletion(e.chunks)
-                return
-            doc["hard_link_counter"] -= 1
-            if doc["hard_link_counter"] <= 0:
-                self.store.kv_delete(self._HL_PREFIX + e.hard_link_id)
-                if delete_chunks:
-                    self._queue_chunk_deletion(
-                        [FileChunk.from_dict(c) for c in doc["chunks"]])
+                to_free = e.chunks
             else:
-                self._hl_write(e.hard_link_id, doc)
+                doc["hard_link_counter"] -= 1
+                if doc["hard_link_counter"] <= 0:
+                    self.store.kv_delete(self._HL_PREFIX + e.hard_link_id)
+                    to_free = [FileChunk.from_dict(c)
+                               for c in doc["chunks"]]
+                else:
+                    self._hl_write(e.hard_link_id, doc)
+        if delete_chunks and to_free:
+            self._queue_chunk_deletion(to_free)
 
     def create_hardlink(self, src: str, dst: str) -> Entry:
         """`ln src dst`: dst becomes another name for src's content.
         The first link converts src into the KV-backed form."""
         import secrets
         src, dst = _norm(src), _norm(dst)
-        if self.exists(dst):
-            raise FilerError(f"{dst} already exists")
         with self._hl_lock:
+            # Everything that can fail — dst collision, src checks,
+            # parent creation — runs BEFORE the counter bump, and the
+            # dst check sits inside the lock, so a failed or racing
+            # link can never leak a reference (which would pin the
+            # content forever).
+            if self.exists(dst):
+                raise FilerError(f"{dst} already exists")
             e = self._maybe_read_hardlink(self.store.find_entry(src))
             if e.is_directory:
                 raise FilerError(f"cannot hardlink directory {src}")
+            self._ensure_parents(dst.rsplit("/", 1)[0] or "/",
+                                 e.attributes)
             if not e.hard_link_id:
                 before = e.clone()
                 e.hard_link_id = secrets.token_hex(8)
@@ -216,8 +227,7 @@ class Filer:
                          chunks=[c for c in e.chunks],
                          hard_link_id=e.hard_link_id,
                          hard_link_counter=doc["hard_link_counter"])
-        self._ensure_parents(dst.rsplit("/", 1)[0] or "/", e.attributes)
-        self.store.insert_entry(link)
+            self.store.insert_entry(link)
         self._notify(link.dir, None, link)
         return link
 

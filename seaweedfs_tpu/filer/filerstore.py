@@ -273,9 +273,31 @@ class SqliteStore(FilerStore):
 
 
 def store_for_path(path: str | None) -> FilerStore:
-    """Factory: None -> memory, else sqlite file (scaffold's store choice)."""
+    """Store factory, mirroring the reference's filer.toml-driven choice
+    (weed/filer/filer_on_disk.go + command/scaffold.go's filer section):
+    an `enabled = true` section in filer.toml wins; without one, a
+    directory-shaped path gets the embedded ordered-KV store (the
+    reference's leveldb default) and a file path gets sqlite.  None is
+    the in-memory test store."""
     if path is None:
         return MemoryStore()
+    from ..utils.config import load_configuration
+    cfg = load_configuration("filer")
+    if cfg.get_bool("memory.enabled"):
+        return MemoryStore()
+    if cfg.get_bool("ordered_kv.enabled"):
+        from .ordered_kv import OrderedKvStore
+        return OrderedKvStore(cfg.get_string("ordered_kv.dir") or path)
+    if cfg.get_bool("sqlite.enabled"):
+        return SqliteStore(cfg.get_string("sqlite.file") or path)
+    import os
+    if os.path.isfile(path):
+        # An existing regular file is a sqlite store from a previous
+        # run, whatever its extension — never shadow it.
+        return SqliteStore(path)
+    if os.path.isdir(path) or not os.path.splitext(path)[1]:
+        from .ordered_kv import OrderedKvStore
+        return OrderedKvStore(path)
     return SqliteStore(path)
 
 

@@ -99,27 +99,17 @@ class FilerServer:
             except Exception:  # noqa: BLE001 — volume may be down/EC'd;
                 pass           # orphan blobs are vacuum's problem
 
-    def _save_blob(self, data: bytes, collection: str = "",
-                   ttl: str = ""):
-        """Store one blob as a single chunk — used for chunk-manifest
-        bodies, which must never themselves be split."""
-        from .entry import FileChunk
-        a = self.client.assign(collection=collection or self.collection,
-                               replication=self.replication, ttl=ttl)
-        url = f"http://{a['url']}/{a['fid']}"
-        if a.get("auth"):
-            url += f"?jwt={a['auth']}"
-        rpc.call(url, "POST", data)
-        return FileChunk(file_id=a["fid"], offset=0, size=len(data),
-                         mtime=time.time_ns())
-
     def _manifestize(self, chunks, collection: str = "", ttl: str = ""):
         """Collapse huge chunk lists before they hit the metadata store
         (filer_server_handlers_write_autochunk.go saveMetaData ->
-        MaybeManifestize)."""
+        MaybeManifestize).  Manifest blobs are stored as single chunks
+        with the same collection/TTL as the data they index."""
         from .filechunk_manifest import maybe_manifestize
+        from .stream import upload_blob
         return maybe_manifestize(
-            lambda data: self._save_blob(data, collection, ttl), chunks)
+            lambda data: upload_blob(self.client, data,
+                                     collection or self.collection,
+                                     self.replication, ttl), chunks)
 
     # -- read ----------------------------------------------------------------
 
@@ -216,12 +206,20 @@ class FilerServer:
             d = json.loads(body)
             d["path"] = path
             entry = Entry.from_dict(d)
+            pre_fids = {c.file_id for c in entry.chunks}
+            ttl_sec = entry.attributes.ttl_sec
             entry.chunks = self._manifestize(
-                entry.chunks, entry.attributes.collection)
+                entry.chunks, entry.attributes.collection,
+                f"{ttl_sec}s" if ttl_sec else "")
             try:
                 with self.filer.with_signatures(self._signatures(query)):
                     e = self.filer.create_entry(entry)
             except FilerError as err:
+                # The caller owns its chunks, but the manifest blobs we
+                # just uploaded belong to nobody now — free them.
+                self._delete_file_ids(
+                    [c.file_id for c in entry.chunks
+                     if c.is_chunk_manifest and c.file_id not in pre_fids])
                 raise rpc.RpcError(409, str(err)) from None
             return e.to_dict()
         if "hardlink.from" in query:
@@ -263,7 +261,8 @@ class FilerServer:
         writer = ChunkedWriter(
             self.client, chunk_size=self.chunk_size,
             collection=collection, replication=self.replication, ttl=ttl)
-        chunks = self._manifestize(writer.write(body), collection, ttl)
+        raw_chunks = writer.write(body)
+        chunks = self._manifestize(raw_chunks, collection, ttl)
         attr = Attributes(
             mtime=time.time(), crtime=time.time(),
             mime=query.get("_content_type",
@@ -275,8 +274,13 @@ class FilerServer:
                 entry = self.filer.create_entry(
                     Entry(path=path, chunks=chunks, attributes=attr))
         except FilerError as e:
-            # Roll back the uploaded chunks: the entry never existed.
-            self._delete_file_ids([c.file_id for c in chunks])
+            # Roll back EVERYTHING uploaded: the raw data chunks (the
+            # manifest blobs only reference them — deleting the
+            # manifest first would orphan them) plus the manifest
+            # blobs themselves.
+            self._delete_file_ids(
+                [c.file_id for c in raw_chunks] +
+                [c.file_id for c in chunks if c.is_chunk_manifest])
             raise rpc.RpcError(409, str(e)) from None
         return {"name": entry.name, "size": total_size(chunks),
                 "eTag": chunks_etag(chunks)}

@@ -111,6 +111,24 @@ class ChunkStreamer:
             pos += n
 
 
+def upload_blob(client: WeedClient, data: bytes, collection: str = "",
+                replication: str | None = None, ttl: str = "",
+                offset: int = 0) -> FileChunk:
+    """Assign a file id and upload one blob as a single chunk — the one
+    place the assign → POST (+JWT) sequence lives (upload_content.go)."""
+    from ..cluster import rpc
+    a = client.assign(collection=collection, replication=replication,
+                      ttl=ttl)
+    fid = a["fid"]
+    url = f"http://{a['url']}/{fid}"
+    if a.get("auth"):  # secured cluster write JWT
+        url += f"?jwt={a['auth']}"
+    resp = rpc.call(url, "POST", data)
+    etag = resp.get("eTag", "") if isinstance(resp, dict) else ""
+    return FileChunk(file_id=fid, offset=offset, size=len(data),
+                     mtime=time.time_ns(), etag=etag)
+
+
 class ChunkedWriter:
     """Upload a byte stream as fixed-size chunks (the filer's auto-chunk
     upload, filer_server_handlers_write_autochunk.go:188)."""
@@ -137,18 +155,7 @@ class ChunkedWriter:
             piece = reader.read(self.chunk_size)
             if not piece:
                 break
-            a = self.client.assign(collection=self.collection,
-                                   replication=self.replication,
-                                   ttl=self.ttl)
-            fid = a["fid"]
-            from ..cluster import rpc
-            url = f"http://{a['url']}/{fid}"
-            if a.get("auth"):  # secured cluster write JWT
-                url += f"?jwt={a['auth']}"
-            resp = rpc.call(url, "POST", piece)
-            etag = resp.get("eTag", "") if isinstance(resp, dict) else ""
-            chunks.append(FileChunk(file_id=fid, offset=pos,
-                                    size=len(piece),
-                                    mtime=time.time_ns(), etag=etag))
+            chunks.append(upload_blob(self.client, piece, self.collection,
+                                      self.replication, self.ttl, pos))
             pos += len(piece)
         return chunks

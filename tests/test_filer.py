@@ -118,12 +118,15 @@ def test_total_size():
 
 # -- store conformance -------------------------------------------------------
 
-@pytest.fixture(params=["memory", "sqlite", "sqlite-file"])
+@pytest.fixture(params=["memory", "sqlite", "sqlite-file", "ordered_kv"])
 def store(request, tmp_path):
     if request.param == "memory":
         s = MemoryStore()
     elif request.param == "sqlite":
         s = SqliteStore()
+    elif request.param == "ordered_kv":
+        from seaweedfs_tpu.filer.ordered_kv import OrderedKvStore
+        s = OrderedKvStore(str(tmp_path / "okv"))
     else:
         s = SqliteStore(str(tmp_path / "filer.db"))
     yield s

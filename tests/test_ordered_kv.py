@@ -1,0 +1,162 @@
+"""Durability and engine semantics of the embedded ordered-KV store
+(seaweedfs_tpu/filer/ordered_kv.py — the leveldb-analog default store;
+conformance with the FilerStore contract is covered by the parametric
+suite in test_filer.py::TestStoreConformance)."""
+
+import os
+
+from seaweedfs_tpu.filer.entry import Attributes, Entry
+from seaweedfs_tpu.filer.filerstore import store_for_path
+from seaweedfs_tpu.filer.ordered_kv import OrderedKv, OrderedKvStore
+
+
+def test_reopen_recovers_from_wal(tmp_path):
+    kv = OrderedKv(str(tmp_path))
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"2")
+    kv.put(b"a", b"3")
+    kv.delete(b"b")
+    kv.close()
+    kv2 = OrderedKv(str(tmp_path))
+    assert kv2.get(b"a") == b"3"
+    assert kv2.get(b"b") is None
+    kv2.close()
+
+
+def test_reopen_without_close_simulates_crash(tmp_path):
+    kv = OrderedKv(str(tmp_path))
+    for i in range(100):
+        kv.put(f"k{i:03d}".encode(), b"v" * 10)
+    # no close(): the WAL was flushed per append, a crashed process
+    # leaves exactly these bytes behind
+    kv2 = OrderedKv(str(tmp_path))
+    assert kv2.get(b"k099") == b"v" * 10
+    assert len(kv2.scan(b"", b"\xff")) == 100
+    kv2.close()
+    kv.close()
+
+
+def test_torn_tail_is_dropped(tmp_path):
+    kv = OrderedKv(str(tmp_path))
+    kv.put(b"good", b"yes")
+    kv.close()
+    with open(tmp_path / "kv.wal", "ab") as f:
+        f.write(b"\x13\x37garbage-torn-record")
+    kv2 = OrderedKv(str(tmp_path))
+    assert kv2.get(b"good") == b"yes"
+    # and the torn bytes are gone so new appends stay parseable
+    kv2.put(b"after", b"tear")
+    kv2.close()
+    kv3 = OrderedKv(str(tmp_path))
+    assert kv3.get(b"after") == b"tear"
+    kv3.close()
+
+
+def test_compaction_snapshot_and_reopen(tmp_path):
+    kv = OrderedKv(str(tmp_path), compact_min_bytes=1)
+    for i in range(50):
+        kv.put(b"key", f"value-{i}".encode())  # 49 dead versions
+    kv.put(b"other", b"x")
+    kv.compact()
+    assert os.path.getsize(tmp_path / "kv.wal") == 0
+    assert os.path.getsize(tmp_path / "kv.snap") > 0
+    kv.put(b"post", b"snap")
+    kv.close()
+    kv2 = OrderedKv(str(tmp_path))
+    assert kv2.get(b"key") == b"value-49"
+    assert kv2.get(b"other") == b"x"
+    assert kv2.get(b"post") == b"snap"
+    kv2.close()
+
+
+def test_scan_range_and_limit(tmp_path):
+    kv = OrderedKv(str(tmp_path))
+    for ch in "fbdace":
+        kv.put(ch.encode(), ch.upper().encode())
+    rows = kv.scan(b"b", b"e")
+    assert [k for k, _ in rows] == [b"b", b"c", b"d"]
+    assert [k for k, _ in kv.scan(b"", b"\xff", limit=2)] == [b"a", b"b"]
+    kv.close()
+
+
+def test_store_reopen_keeps_namespace(tmp_path):
+    d = str(tmp_path / "fstore")
+    s = OrderedKvStore(d)
+    for name in ("a.txt", "b.txt"):
+        s.insert_entry(Entry(path=f"/docs/{name}",
+                             attributes=Attributes(mtime=1.0)))
+    s.kv_put("checkpoint", b"123")
+    s.close()
+    s2 = OrderedKvStore(d)
+    assert s2.find_entry("/docs/a.txt").path == "/docs/a.txt"
+    assert [e.name for e in
+            s2.list_directory_entries("/docs", "", True, 10)] == \
+        ["a.txt", "b.txt"]
+    assert s2.kv_get("checkpoint") == b"123"
+    s2.close()
+
+
+def test_sibling_prefix_not_deleted(tmp_path):
+    """/ab must survive delete_folder_children(/a) — the range-bound
+    subtlety the key layout is designed around."""
+    s = OrderedKvStore(str(tmp_path / "s"))
+    s.insert_entry(Entry(path="/a/x", attributes=Attributes()))
+    s.insert_entry(Entry(path="/a/sub/y", attributes=Attributes()))
+    s.insert_entry(Entry(path="/ab", attributes=Attributes()))
+    s.delete_folder_children("/a")
+    assert s.find_entry("/ab")
+    for gone in ("/a/x", "/a/sub/y"):
+        try:
+            s.find_entry(gone)
+            raise AssertionError(f"{gone} survived")
+        except Exception:
+            pass
+    s.close()
+
+
+def test_bisect_fallback_engine(tmp_path, monkeypatch):
+    """Without sortedcontainers the store falls back to the bisect
+    index and behaves identically (incl. durability)."""
+    import seaweedfs_tpu.filer.ordered_kv as okv
+    monkeypatch.setattr(okv, "SortedDict", None)
+    kv = okv.OrderedKv(str(tmp_path))
+    assert isinstance(kv._m, okv._BisectDict)
+    for ch in "dbca":
+        kv.put(ch.encode(), ch.encode())
+    kv.put(b"b", b"B2")
+    kv.delete(b"c")
+    assert [k for k, _ in kv.scan(b"", b"\xff")] == [b"a", b"b", b"d"]
+    assert kv.get(b"b") == b"B2"
+    kv.delete_range(b"a", b"b")
+    kv.compact()
+    kv.close()
+    kv2 = okv.OrderedKv(str(tmp_path))
+    assert [k for k, _ in kv2.scan(b"", b"\xff")] == [b"b", b"d"]
+    kv2.close()
+
+
+def test_store_for_path_existing_file_never_shadowed(tmp_path):
+    """An extensionless path holding a sqlite store from a previous
+    run must keep opening as sqlite, not be shadowed by a new
+    ordered-kv directory."""
+    from seaweedfs_tpu.filer.filerstore import SqliteStore
+    p = str(tmp_path / "filermeta")
+    old = SqliteStore(p)
+    old.insert_entry(Entry(path="/legacy.txt",
+                           attributes=Attributes(mtime=1.0)))
+    old.close()
+    s = store_for_path(p)
+    assert s.name == "sqlite"
+    assert s.find_entry("/legacy.txt").path == "/legacy.txt"
+    s.close()
+
+
+def test_store_for_path_picks_ordered_kv_for_directories(tmp_path):
+    d = tmp_path / "metadir"
+    d.mkdir()
+    s = store_for_path(str(d))
+    assert isinstance(s, OrderedKvStore)
+    s.close()
+    s2 = store_for_path(str(tmp_path / "filer.db"))
+    assert s2.name == "sqlite"
+    s2.close()
