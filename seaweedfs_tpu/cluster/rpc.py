@@ -108,6 +108,80 @@ def _iter_chunks(rf):
         rf.read(2)  # CRLF
 
 
+def _chunk_pump(chunk_iter, buf: bytes, n: int):
+    """Pull up to n bytes (all when n<0) from a chunk iterator with a
+    carry buffer — the one chunked-read state machine shared by request
+    (BodyReader) and response (_Resp) sides.  Returns
+    (data, leftover_buf, exhausted)."""
+    out = bytearray()
+    exhausted = False
+    while n < 0 or len(out) < n:
+        if not buf:
+            try:
+                buf = next(chunk_iter)
+            except StopIteration:
+                exhausted = True
+                break
+        take = len(buf) if n < 0 else min(n - len(out), len(buf))
+        out += buf[:take]
+        buf = buf[take:]
+    return bytes(out), buf, exhausted
+
+
+class BodyReader:
+    """Incremental request-body reader for stream_body routes.
+
+    Handlers call read(n) for bounded pieces (exactly n bytes until
+    EOF) or read() for the remainder; the server drains anything left
+    over so keep-alive framing survives handlers that bail early.  A
+    peer that dies mid-body raises ConnectionError — a short body must
+    never be mistaken for a complete one."""
+
+    def __init__(self, rf, length: int | None, chunked: bool):
+        self._rf = rf
+        self._remaining = length or 0
+        self._chunk_iter = _iter_chunks(rf) if chunked else None
+        self._buf = b""
+        self.truncated = False
+        # Declared size; None for chunked bodies (handlers that want to
+        # forward with a Content-Length check this).
+        self.length = None if chunked else length
+
+    def read(self, n: int = -1) -> bytes:
+        if self._chunk_iter is not None:
+            return self._read_chunked(n)
+        want = self._remaining if n < 0 else min(n, self._remaining)
+        out = bytearray()
+        while len(out) < want:
+            piece = self._rf.read(want - len(out))
+            if not piece:
+                self.truncated = True
+                raise ConnectionError(
+                    f"request body truncated: {self._remaining - len(out)}"
+                    f" bytes missing")
+            out += piece
+        self._remaining -= len(out)
+        return bytes(out)
+
+    def _read_chunked(self, n: int) -> bytes:
+        try:
+            data, self._buf, exhausted = _chunk_pump(
+                self._chunk_iter, self._buf, n)
+        except Exception:  # malformed/truncated framing mid-body
+            self.truncated = True
+            raise ConnectionError(
+                "chunked request body truncated") from None
+        if exhausted:
+            self._chunk_iter = None
+            self._remaining = 0
+        return data
+
+    def drain(self) -> None:
+        while True:
+            if not self.read(1 << 20):
+                return
+
+
 def _read_chunked(rf) -> bytes:
     """Minimal Transfer-Encoding: chunked body reader (whole body)."""
     return b"".join(_iter_chunks(rf))
@@ -178,12 +252,18 @@ class JsonHttpServer:
             self.serve_metrics_route(reg)
         return reg
 
-    def route(self, method: str, path: str, fn: Callable) -> None:
-        self.routes[(method, path)] = fn
+    def route(self, method: str, path: str, fn: Callable,
+              stream_body: bool = False) -> None:
+        self.routes[(method, path)] = (fn, stream_body)
 
-    def prefix_route(self, method: str, prefix: str, fn: Callable) -> None:
-        """fn(path, query, body) for paths starting with prefix."""
-        self.prefix_routes.append((method, prefix, fn))
+    def prefix_route(self, method: str, prefix: str, fn: Callable,
+                     stream_body: bool = False) -> None:
+        """fn(path, query, body) for paths starting with prefix.  With
+        stream_body=True the handler receives a BodyReader instead of
+        bytes — a multi-GB PUT is consumed incrementally instead of
+        ballooning RSS (the reference streams uploads,
+        filer_server_handlers_write_autochunk.go:188)."""
+        self.prefix_routes.append((method, prefix, fn, stream_body))
 
     def url(self) -> str:
         scheme = "https" if self.ssl_context else "http"
@@ -275,13 +355,7 @@ class JsonHttpServer:
             return False  # truncated request: never route it
         if headers.get("expect", "").lower() == "100-continue":
             conn.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
-        if headers.get("transfer-encoding", "").lower() == "chunked":
-            body = _read_chunked(rf)
-        else:
-            clen = int(headers.get("content-length") or 0)
-            body = rf.read(clen) if clen else b""
-            if clen and len(body) < clen:
-                return False  # truncated request
+        chunked = headers.get("transfer-encoding", "").lower() == "chunked"
         keep = (version == "HTTP/1.1"
                 and headers.get("connection", "").lower() != "close")
 
@@ -305,14 +379,31 @@ class JsonHttpServer:
             query["_raw_query"] = parsed.query
             query["_method"] = method
 
-        fn = self.routes.get((method, parsed.path))
-        args = (query, body)
+        hit = self.routes.get((method, parsed.path))
+        fn, stream = hit if hit else (None, False)
+        prefix_args = None
         if fn is None:
-            for m, prefix, pfn in self.prefix_routes:
+            for m, prefix, pfn, pstream in self.prefix_routes:
                 if m == method and parsed.path.startswith(prefix):
-                    fn = pfn
-                    args = (parsed.path, query, body)
+                    fn, stream = pfn, pstream
+                    prefix_args = parsed.path
                     break
+        # Read (or wrap) the body only after routing so a streaming
+        # route never sees it buffered.
+        if stream:
+            body = BodyReader(rf,
+                              None if chunked
+                              else int(headers.get("content-length") or 0),
+                              chunked)
+        elif chunked:
+            body = _read_chunked(rf)
+        else:
+            clen = int(headers.get("content-length") or 0)
+            body = rf.read(clen) if clen else b""
+            if clen and len(body) < clen:
+                return False  # truncated request
+        args = (prefix_args, query, body) if prefix_args is not None \
+            else (query, body)
         if fn is None:
             self._respond(conn, method, 404,
                           {"error": f"no route {method} {parsed.path}"},
@@ -324,10 +415,28 @@ class JsonHttpServer:
         try:
             result = fn(*args)
         except RpcError as e:
+            if not self._finish_stream_body(body):
+                keep = False
             self._respond(conn, method, e.status, {"error": e.message},
                           None, close=not keep)
             return keep
+        except ConnectionError as e:
+            if isinstance(body, BodyReader) and body.truncated:
+                # Truncated streaming body: the wire framing is gone,
+                # no reliable response is possible.
+                return False
+            # Otherwise this is an UPSTREAM peer failure (a dead
+            # master/volume behind rpc.call) — the client deserves a
+            # 500, exactly as before streaming existed.
+            if not self._finish_stream_body(body):
+                keep = False
+            self._respond(conn, method, 500,
+                          {"error": f"{type(e).__name__}: {e}"},
+                          None, close=not keep)
+            return keep
         except Exception as e:  # noqa: BLE001
+            if not self._finish_stream_body(body):
+                keep = False
             self._respond(conn, method, 500,
                           {"error": f"{type(e).__name__}: {e}"},
                           None, close=not keep)
@@ -341,6 +450,8 @@ class JsonHttpServer:
                 counter.inc(type=method)
                 hist.observe(time.perf_counter() - t0, type=method)
 
+        if not self._finish_stream_body(body):
+            keep = False
         extra = None
         if isinstance(result, tuple):
             if len(result) == 3:
@@ -352,6 +463,18 @@ class JsonHttpServer:
         self._respond(conn, method, status, payload, extra,
                       close=not keep)
         return keep
+
+    @staticmethod
+    def _finish_stream_body(body) -> bool:
+        """Drain whatever a streaming handler left unread so the next
+        keep-alive request parses; False = connection unusable."""
+        if not isinstance(body, BodyReader):
+            return True
+        try:
+            body.drain()
+            return not body.truncated
+        except ConnectionError:
+            return False
 
     def _respond(self, conn, method: str, status: int, payload,
                  extra=None, close: bool = False) -> None:
@@ -519,22 +642,14 @@ class _Resp:
     def _read_chunked_n(self, n: int) -> bytes:
         """Incremental chunked-body reader honoring the requested size
         (so call_to_file keeps its 1MB streaming for chunked upstreams),
-        driven by the shared _iter_chunks parser."""
+        driven by the shared _chunk_pump state machine."""
         if self._chunk_iter is None:
             self._chunk_iter = _iter_chunks(self._rf)
-        out = bytearray()
-        while n < 0 or len(out) < n:
-            if not self._chunk_buf:
-                try:
-                    self._chunk_buf = next(self._chunk_iter)
-                except StopIteration:
-                    self._done = True
-                    break
-            take = len(self._chunk_buf) if n < 0 \
-                else min(n - len(out), len(self._chunk_buf))
-            out += self._chunk_buf[:take]
-            self._chunk_buf = self._chunk_buf[take:]
-        return bytes(out)
+        data, self._chunk_buf, exhausted = _chunk_pump(
+            self._chunk_iter, self._chunk_buf, n)
+        if exhausted:
+            self._done = True
+        return data
 
 
 class _ConnPool:

@@ -41,11 +41,21 @@ class FilerProxy:
                 return None
             raise  # a filer 5xx is not "no such key"
 
-    def put(self, path: str, body: bytes, content_type: str = "") -> dict:
+    def put(self, path: str, body, content_type: str = "",
+            length: int | None = None) -> dict:
+        """Upload body (bytes or a file-like reader).  A reader streams:
+        with a known length it goes out as-is under Content-Length,
+        otherwise chunked transfer-encoding — either way the filer
+        consumes it incrementally (its upload route is stream_body)."""
         req = urllib.request.Request(self._q(path), data=body,
                                      method="POST")
         if content_type:
             req.add_header("Content-Type", content_type)
+        if hasattr(body, "read"):
+            if length is not None:
+                req.add_header("Content-Length", str(length))
+            else:
+                req.add_header("Transfer-Encoding", "chunked")
         with urllib.request.urlopen(req, timeout=600) as resp:
             return json.load(resp)
 

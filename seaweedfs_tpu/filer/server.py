@@ -72,8 +72,12 @@ class FilerServer:
                 self.metrics_registry)
         s.prefix_route("GET", "/", self._get)
         s.prefix_route("HEAD", "/", self._head)
-        s.prefix_route("POST", "/", self._post)
-        s.prefix_route("PUT", "/", self._post)
+        # Uploads consume the body incrementally: each chunk_size piece
+        # goes to a volume server as it arrives, so RSS stays O(chunk)
+        # however large the PUT (autochunk streaming,
+        # filer_server_handlers_write_autochunk.go:188).
+        s.prefix_route("POST", "/", self._post, stream_body=True)
+        s.prefix_route("PUT", "/", self._post, stream_body=True)
         s.prefix_route("DELETE", "/", self._delete)
 
     # -- lifecycle -----------------------------------------------------------
@@ -197,9 +201,13 @@ class FilerServer:
         raw = query.get("signatures", "")
         return [int(s) for s in raw.split(",") if s.strip()]
 
-    def _post(self, path: str, query: dict, body: bytes):
+    def _post(self, path: str, query: dict, body):
+        """body is a rpc.BodyReader (stream_body route): the metadata
+        branches read it fully (small JSON), the upload branch streams
+        it to volume servers chunk by chunk."""
         path = urllib.parse.unquote(path).rstrip("/") or "/"
         if query.get("entry") == "true":
+            body = body.read()
             # Raw entry create with an explicit chunk list — the filer
             # gRPC CreateEntry surface (used by S3 multipart completion
             # and filer.sync, which move chunks without re-uploading).
@@ -261,7 +269,14 @@ class FilerServer:
         writer = ChunkedWriter(
             self.client, chunk_size=self.chunk_size,
             collection=collection, replication=self.replication, ttl=ttl)
-        raw_chunks = writer.write(body)
+        raw_chunks: list = []
+        try:
+            writer.write(body, into=raw_chunks)
+        except Exception:
+            # Client died (or a volume write failed) mid-stream: the
+            # entry never existed, so free what already landed.
+            self._delete_file_ids([c.file_id for c in raw_chunks])
+            raise
         chunks = self._manifestize(raw_chunks, collection, ttl)
         attr = Attributes(
             mtime=time.time(), crtime=time.time(),

@@ -142,14 +142,18 @@ class ChunkedWriter:
         self.replication = replication
         self.ttl = ttl
 
-    def write(self, reader, offset: int = 0) -> list[FileChunk]:
+    def write(self, reader, offset: int = 0,
+              into: list[FileChunk] | None = None) -> list[FileChunk]:
         """Consume reader (bytes or file-like), upload chunk_size pieces,
-        return the FileChunk list starting at logical `offset`."""
+        return the FileChunk list starting at logical `offset`.  Pass
+        `into` to observe chunks as they land — on a mid-stream failure
+        (client died, volume error) the caller can roll back exactly
+        what was uploaded."""
         if isinstance(reader, (bytes, bytearray)):
             data = bytes(reader)
             import io
             reader = io.BytesIO(data)
-        chunks: list[FileChunk] = []
+        chunks = into if into is not None else []
         pos = offset
         while True:
             piece = reader.read(self.chunk_size)

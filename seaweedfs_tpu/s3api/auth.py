@@ -109,10 +109,17 @@ class IdentityAccessManagement:
 
     def authenticate(self, method: str, path: str, raw_query: str,
                      headers: dict[str, str],
-                     body: bytes) -> Identity | None:
+                     body: bytes | None) -> Identity | None:
         """Verify the v4 Authorization header; returns the Identity.
         With no identities configured every request is anonymous-admin
-        (the reference's default when no config is present)."""
+        (the reference's default when no config is present).
+
+        body=None means the payload is being streamed and is not
+        available for hashing: the signature is computed over the
+        DECLARED x-amz-content-sha256 (exactly what the reference does
+        — auth_signature_v4.go signs the header value and never
+        re-hashes the stream); the recompute cross-check below only
+        runs when the bytes are in hand."""
         if not self.enabled:
             return None
         auth = headers.get("authorization", "")
@@ -137,14 +144,15 @@ class IdentityAccessManagement:
         amz_date = headers.get("x-amz-date", "")
         self._check_date(amz_date, scope)
         payload_hash = headers.get("x-amz-content-sha256") or \
-            _sha256(body)
+            _sha256(body or b"")
         if payload_hash == "UNSIGNED-PAYLOAD":
             pass
         elif payload_hash.startswith("STREAMING-"):
             # aws-chunked uploads: trust the seed signature's presence
             # (chunk signature verification not implemented).
             pass
-        elif headers.get("x-amz-content-sha256") and \
+        elif body is not None and \
+                headers.get("x-amz-content-sha256") and \
                 _sha256(body) != payload_hash:
             raise AuthError("XAmzContentSHA256Mismatch",
                             "payload hash mismatch", 400)

@@ -56,31 +56,89 @@ def _iso(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
 
 
-def _decode_aws_chunked(body: bytes) -> bytes:
-    """Strip aws-chunked framing: repeated
-    '<hex-size>[;chunk-signature=...]\r\n<data>\r\n', 0-size terminates
-    (the SDKs' default signed streaming upload encoding)."""
-    out = bytearray()
-    pos = 0
-    while pos < len(body):
-        nl = body.find(b"\r\n", pos)
-        if nl < 0:
-            if pos == 0:  # no framing at all: body is plain
-                return bytes(body)
-            break
-        header = body[pos:nl]
-        size_hex = header.split(b";", 1)[0].strip()
-        try:
-            size = int(size_hex, 16)
-        except ValueError:
-            # Not actually chunk-framed: return as-is.
-            return bytes(body)
-        pos = nl + 2
-        if size == 0:
-            break
-        out += body[pos:pos + size]
-        pos += size + 2  # skip trailing CRLF
-    return bytes(out)
+def _as_bytes(body) -> bytes:
+    """Materialize a (possibly streaming) request body."""
+    return body.read() if hasattr(body, "read") else body
+
+
+class _AwsChunkedReader:
+    """Incrementally strips aws-chunked framing from a streaming body
+    — the streaming analog of _decode_aws_chunked, so a multi-GB SDK
+    upload never materializes (the reference wraps the request body in
+    a chunkedReader the same way)."""
+
+    def __init__(self, inner, decoded_length: int | None):
+        self._inner = inner
+        self.length = decoded_length
+        self._in_chunk = 0
+        self._done = False
+        self._line = b""
+
+    def _read_line(self) -> bytes:
+        out = bytearray()
+        while not out.endswith(b"\r\n") and len(out) < 8192:
+            b = self._inner.read(1)
+            if not b:
+                break
+            out += b
+        return bytes(out)
+
+    def read(self, n: int = -1) -> bytes:
+        out = bytearray()
+        while not self._done and (n < 0 or len(out) < n):
+            if self._in_chunk == 0:
+                header = self._read_line().strip()
+                if not header:
+                    # EOF where a chunk header belongs before the
+                    # 0-size terminator: the framing is truncated.  A
+                    # malformed stream must ERROR, never 200 as a
+                    # silently-truncated object.
+                    raise ConnectionError(
+                        "aws-chunked framing truncated")
+                size_hex = header.split(b";", 1)[0].strip()
+                try:
+                    size = int(size_hex, 16)
+                except ValueError:
+                    raise ConnectionError(
+                        f"malformed aws-chunked size line "
+                        f"{header[:32]!r}") from None
+                if size == 0:
+                    self._read_line()  # trailing CRLF / trailers
+                    self._done = True
+                    break
+                self._in_chunk = size
+            want = self._in_chunk if n < 0 \
+                else min(n - len(out), self._in_chunk)
+            piece = self._inner.read(want)
+            if not piece:
+                raise ConnectionError(
+                    "aws-chunked data truncated mid-chunk")
+            out += piece
+            self._in_chunk -= len(piece)
+            if self._in_chunk == 0:
+                self._inner.read(2)  # chunk-data CRLF
+        return bytes(out)
+
+
+class _HashingReader:
+    """Tee reader computing md5 as bytes flow through (streamed PUT
+    ETags without buffering)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.length = getattr(inner, "length", None)
+        self._md5 = hashlib.md5()
+        self.bytes_read = 0
+
+    def read(self, n: int = -1) -> bytes:
+        data = self._inner.read(n)
+        self._md5.update(data)
+        self.bytes_read += len(data)
+        return data
+
+    @property
+    def md5_hex(self) -> str:
+        return self._md5.hexdigest()
 
 
 def _valid_bucket_name(name: str) -> bool:
@@ -110,7 +168,8 @@ class S3ApiServer:
         self.server = rpc.JsonHttpServer(host, port, pass_headers=True,
                                          ssl_context=ssl_context)
         for method in ("GET", "HEAD", "PUT", "POST", "DELETE"):
-            self.server.prefix_route(method, "/", self._route)
+            self.server.prefix_route(method, "/", self._route,
+                                     stream_body=True)
         # Bucket names own the URL namespace, so /metrics lives on its
         # own port (the reference's -metricsPort behaves the same).
         self.metrics_registry = self.server.enable_metrics(
@@ -140,18 +199,37 @@ class S3ApiServer:
 
     # -- routing -------------------------------------------------------------
 
-    def _route(self, path: str, query: dict, body: bytes):
+    # Bodies at or below this size are buffered so the payload-hash
+    # cross-check still runs; larger signed PUTs stream and the
+    # signature covers the declared hash (reference behavior).
+    _VERIFY_BUFFER_MAX = 8 * 1024 * 1024
+
+    def _route(self, path: str, query: dict, body):
         method = query.get("_method", "GET")
         headers = query.get("_headers", {})
         raw_query = query.get("_raw_query", "")
         try:
-            identity = self.iam.authenticate(method, path, raw_query,
-                                             headers, body)
-            if headers.get("x-amz-content-sha256", "").startswith(
-                    "STREAMING-"):
-                # aws-chunked framing: strip the chunk headers/signatures
-                # or the framed wire bytes would be stored as content.
-                body = _decode_aws_chunked(body)
+            sha_hdr = headers.get("x-amz-content-sha256", "")
+            length = getattr(body, "length", None)
+            if self.iam.enabled and not sha_hdr:
+                # No declared hash: the signature needs the payload.
+                body = _as_bytes(body)
+            elif sha_hdr and sha_hdr != "UNSIGNED-PAYLOAD" \
+                    and not sha_hdr.startswith("STREAMING-") \
+                    and (length is None
+                         or length <= self._VERIFY_BUFFER_MAX):
+                body = _as_bytes(body)
+            identity = self.iam.authenticate(
+                method, path, raw_query, headers,
+                body if isinstance(body, (bytes, bytearray)) else None)
+            if sha_hdr.startswith("STREAMING-"):
+                # aws-chunked framing: strip the chunk headers and
+                # signatures or the framed wire bytes would be stored
+                # as content.  (STREAMING- payloads are never buffered
+                # by the branches above, so body is always a reader.)
+                decoded = headers.get("x-amz-decoded-content-length")
+                body = _AwsChunkedReader(
+                    body, int(decoded) if decoded else None)
             return self._dispatch(method, path, query, headers, body,
                                   identity)
         except AuthError as e:
@@ -162,12 +240,17 @@ class S3ApiServer:
                     {"Content-Type": "application/xml"})
 
     def _dispatch(self, method: str, path: str, query: dict,
-                  headers: dict, body: bytes,
+                  headers: dict, body,
                   identity: Identity | None):
         path = urllib.parse.unquote(path)
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
+        # Only object/part PUTs stream; every other operation's body is
+        # small control XML/JSON.
+        if not (method == "PUT" and key and "tagging" not in query
+                and not headers.get("x-amz-copy-source", "")):
+            body = _as_bytes(body)
         auth = lambda action: self.iam.authorize(identity, action, bucket)  # noqa: E731
 
         if not bucket:  # service level
@@ -304,20 +387,28 @@ class S3ApiServer:
     # -- objects -------------------------------------------------------------
 
     def _put_object(self, bucket: str, key: str, headers: dict,
-                    body: bytes):
+                    body):
         self._require_bucket(bucket)
         if key.endswith("/"):  # directory marker
+            _as_bytes(body)
             self.filer.mkdir(self._obj_path(bucket, key.rstrip("/")))
             return (200, b"", {"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'})
         ctype = headers.get("content-type",
                             "application/octet-stream")
         path = self._obj_path(bucket, key)
-        self.filer.put(path, body, ctype)
+        if hasattr(body, "read"):
+            # Stream straight through to the filer: RSS stays O(chunk)
+            # for however large the PUT.
+            tee = _HashingReader(body)
+            self.filer.put(path, tee, ctype, length=tee.length)
+            fallback_etag = tee.md5_hex
+        else:
+            self.filer.put(path, body, ctype)
+            fallback_etag = hashlib.md5(body).hexdigest()
         # Return the same ETag GET/HEAD will serve (computed from the
         # stored chunk list) so sync clients' change detection is stable.
         meta = self.filer.meta(path)
-        etag = self._entry_etag(meta) if meta else \
-            hashlib.md5(body).hexdigest()
+        etag = self._entry_etag(meta) if meta else fallback_etag
         return (200, b"", {"ETag": f'"{etag}"'})
 
     def _copy_object(self, bucket: str, key: str, src: str):
@@ -597,8 +688,13 @@ class S3ApiServer:
         if self.filer.meta(updir + "/.manifest") is None:
             raise S3Error(404, "NoSuchUpload", upload_id)
         path = f"{updir}/{part:05d}.part"
-        self.filer.put(path, body)
-        md5 = hashlib.md5(body).hexdigest()
+        if hasattr(body, "read"):
+            tee = _HashingReader(body)
+            self.filer.put(path, tee, length=tee.length)
+            md5 = tee.md5_hex
+        else:
+            self.filer.put(path, body)
+            md5 = hashlib.md5(body).hexdigest()
         return (200, b"", {"ETag": f'"{md5}"'})
 
     def _complete_multipart(self, bucket: str, key: str, query: dict,

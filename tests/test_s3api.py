@@ -300,13 +300,22 @@ def test_multipart_complete_empty_fails(stack):
 
 
 def test_aws_chunked_decode():
-    from seaweedfs_tpu.s3api.server import _decode_aws_chunked
+    import io
+
+    from seaweedfs_tpu.s3api.server import _AwsChunkedReader
     framed = (b"5;chunk-signature=abc\r\nhello\r\n"
               b"7;chunk-signature=def\r\n world!\r\n"
               b"0;chunk-signature=end\r\n\r\n")
-    assert _decode_aws_chunked(framed) == b"hello world!"
-    assert _decode_aws_chunked(b"not-chunked-at-all") == \
-        b"not-chunked-at-all"
+    r = _AwsChunkedReader(io.BytesIO(framed), 12)
+    assert r.read() == b"hello world!"
+    # Malformed/unframed input must error, never 200 as a silently
+    # truncated or mis-stored object.
+    bad = _AwsChunkedReader(io.BytesIO(b"not-chunked-at-all"), None)
+    with pytest.raises(ConnectionError):
+        bad.read()
+    torn = _AwsChunkedReader(io.BytesIO(b"5;sig=x\r\nhel"), None)
+    with pytest.raises(ConnectionError):
+        torn.read()
 
 
 def test_head_object_content_length(stack):
