@@ -70,16 +70,22 @@ def resolve_one_chunk_manifest(fetch_fn: FetchFn,
 
 
 def maybe_manifestize(save_fn: SaveFn, chunks: list[FileChunk],
-                      merge_factor: int = MANIFEST_BATCH
+                      merge_factor: int = MANIFEST_BATCH,
+                      created: list[FileChunk] | None = None
                       ) -> list[FileChunk]:
     """Collapse full merge_factor-sized batches of data chunks into
     manifest chunks; the remainder (and pre-existing manifest chunks)
-    pass through untouched (MaybeManifestize/doMaybeManifestize)."""
+    pass through untouched (MaybeManifestize/doMaybeManifestize).
+    Pass `created` to observe manifest blobs as they are uploaded — on
+    a mid-run failure the caller can roll back exactly what landed."""
     data = [c for c in chunks if not c.is_chunk_manifest]
     out = [c for c in chunks if c.is_chunk_manifest]
     i = 0
     while i + merge_factor <= len(data):
-        out.append(_merge_into_manifest(save_fn, data[i:i + merge_factor]))
+        m = _merge_into_manifest(save_fn, data[i:i + merge_factor])
+        if created is not None:
+            created.append(m)
+        out.append(m)
         i += merge_factor
     out.extend(data[i:])
     return out

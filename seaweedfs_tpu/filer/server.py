@@ -103,7 +103,8 @@ class FilerServer:
             except Exception:  # noqa: BLE001 — volume may be down/EC'd;
                 pass           # orphan blobs are vacuum's problem
 
-    def _manifestize(self, chunks, collection: str = "", ttl: str = ""):
+    def _manifestize(self, chunks, collection: str = "", ttl: str = "",
+                     created=None):
         """Collapse huge chunk lists before they hit the metadata store
         (filer_server_handlers_write_autochunk.go saveMetaData ->
         MaybeManifestize).  Manifest blobs are stored as single chunks
@@ -113,7 +114,8 @@ class FilerServer:
         return maybe_manifestize(
             lambda data: upload_blob(self.client, data,
                                      collection or self.collection,
-                                     self.replication, ttl), chunks)
+                                     self.replication, ttl), chunks,
+            created=created)
 
     # -- read ----------------------------------------------------------------
 
@@ -270,14 +272,18 @@ class FilerServer:
             self.client, chunk_size=self.chunk_size,
             collection=collection, replication=self.replication, ttl=ttl)
         raw_chunks: list = []
+        manifests: list = []
         try:
             writer.write(body, into=raw_chunks)
+            chunks = self._manifestize(raw_chunks, collection, ttl,
+                                       created=manifests)
         except Exception:
             # Client died (or a volume write failed) mid-stream: the
-            # entry never existed, so free what already landed.
-            self._delete_file_ids([c.file_id for c in raw_chunks])
+            # entry never existed, so free everything that landed —
+            # data chunks AND any manifest blobs already uploaded.
+            self._delete_file_ids([c.file_id for c in raw_chunks] +
+                                  [c.file_id for c in manifests])
             raise
-        chunks = self._manifestize(raw_chunks, collection, ttl)
         attr = Attributes(
             mtime=time.time(), crtime=time.time(),
             mime=query.get("_content_type",

@@ -72,11 +72,14 @@ class _AwsChunkedReader:
         self.length = decoded_length
         self._in_chunk = 0
         self._done = False
-        self._line = b""
+        self._decoded = 0
 
     def _read_line(self) -> bytes:
         out = bytearray()
-        while not out.endswith(b"\r\n") and len(out) < 8192:
+        while not out.endswith(b"\r\n"):
+            if len(out) >= 8192:
+                raise ConnectionError(
+                    "aws-chunked header line exceeds 8KB")
             b = self._inner.read(1)
             if not b:
                 break
@@ -105,6 +108,12 @@ class _AwsChunkedReader:
                 if size == 0:
                     self._read_line()  # trailing CRLF / trailers
                     self._done = True
+                    if self.length is not None and \
+                            self._decoded != self.length:
+                        raise ConnectionError(
+                            f"aws-chunked decoded {self._decoded} bytes "
+                            f"!= declared x-amz-decoded-content-length "
+                            f"{self.length}")
                     break
                 self._in_chunk = size
             want = self._in_chunk if n < 0 \
@@ -114,6 +123,13 @@ class _AwsChunkedReader:
                 raise ConnectionError(
                     "aws-chunked data truncated mid-chunk")
             out += piece
+            self._decoded += len(piece)
+            if self.length is not None and self._decoded > self.length:
+                # More payload than declared: storing it would truncate
+                # at the forwarded Content-Length — fail loudly instead.
+                raise ConnectionError(
+                    f"aws-chunked payload exceeds declared "
+                    f"x-amz-decoded-content-length {self.length}")
             self._in_chunk -= len(piece)
             if self._in_chunk == 0:
                 self._inner.read(2)  # chunk-data CRLF
@@ -216,8 +232,12 @@ class S3ApiServer:
                 body = _as_bytes(body)
             elif sha_hdr and sha_hdr != "UNSIGNED-PAYLOAD" \
                     and not sha_hdr.startswith("STREAMING-") \
-                    and (length is None
-                         or length <= self._VERIFY_BUFFER_MAX):
+                    and length is not None \
+                    and length <= self._VERIFY_BUFFER_MAX:
+                # Small declared-hash body: buffer so the recompute
+                # cross-check still runs.  Large or unknown-length
+                # (chunked TE) bodies stream — auth signs the declared
+                # hash, and RSS stays O(chunk).
                 body = _as_bytes(body)
             identity = self.iam.authenticate(
                 method, path, raw_query, headers,
@@ -396,20 +416,23 @@ class S3ApiServer:
         ctype = headers.get("content-type",
                             "application/octet-stream")
         path = self._obj_path(bucket, key)
-        if hasattr(body, "read"):
-            # Stream straight through to the filer: RSS stays O(chunk)
-            # for however large the PUT.
-            tee = _HashingReader(body)
-            self.filer.put(path, tee, ctype, length=tee.length)
-            fallback_etag = tee.md5_hex
-        else:
-            self.filer.put(path, body, ctype)
-            fallback_etag = hashlib.md5(body).hexdigest()
+        fallback_etag = self._put_body(path, body, ctype)
         # Return the same ETag GET/HEAD will serve (computed from the
         # stored chunk list) so sync clients' change detection is stable.
         meta = self.filer.meta(path)
         etag = self._entry_etag(meta) if meta else fallback_etag
         return (200, b"", {"ETag": f'"{etag}"'})
+
+    def _put_body(self, path: str, body, ctype: str = "") -> str:
+        """Store a request body (bytes or streaming reader) at a filer
+        path; returns its md5 hex.  Readers stream straight through —
+        RSS stays O(chunk) for however large the PUT."""
+        if hasattr(body, "read"):
+            tee = _HashingReader(body)
+            self.filer.put(path, tee, ctype, length=tee.length)
+            return tee.md5_hex
+        self.filer.put(path, body, ctype)
+        return hashlib.md5(body).hexdigest()
 
     def _copy_object(self, bucket: str, key: str, src: str):
         self._require_bucket(bucket)
@@ -688,13 +711,7 @@ class S3ApiServer:
         if self.filer.meta(updir + "/.manifest") is None:
             raise S3Error(404, "NoSuchUpload", upload_id)
         path = f"{updir}/{part:05d}.part"
-        if hasattr(body, "read"):
-            tee = _HashingReader(body)
-            self.filer.put(path, tee, length=tee.length)
-            md5 = tee.md5_hex
-        else:
-            self.filer.put(path, body)
-            md5 = hashlib.md5(body).hexdigest()
+        md5 = self._put_body(path, body)
         return (200, b"", {"ETag": f'"{md5}"'})
 
     def _complete_multipart(self, bucket: str, key: str, query: dict,

@@ -86,6 +86,19 @@ def test_aws_chunked_reader():
     assert r.read() == b""
 
 
+def test_aws_chunked_declared_length_mismatch():
+    """x-amz-decoded-content-length must match the decoded payload —
+    a mismatch errors instead of storing a truncated object (review
+    finding)."""
+    framed = b"5;sig=x\r\nhello\r\n0\r\n\r\n"
+    over = _AwsChunkedReader(_reader(framed, length=len(framed)), 3)
+    with pytest.raises(ConnectionError):
+        over.read()  # actual payload exceeds the declared 3
+    under = _AwsChunkedReader(_reader(framed, length=len(framed)), 9)
+    with pytest.raises(ConnectionError):
+        under.read()  # terminator arrives before the declared 9
+
+
 # -- e2e with RSS assertion --------------------------------------------------
 
 
