@@ -1,7 +1,7 @@
 """Per-scheme kernel throughput: RS(10,4) / RS(16,4) / RS(8,3), int8+bf16.
 
 Produces the measurement table in BASELINE.md's "Kernel roofline
-analysis" (execution-fenced, same harness as bench.py).  The column
+analysis" (execution-fenced via bench.py's shared harness).  The column
 rate it prints is the model quantity: throughput = k bytes/column x
 column rate, column rate <= 6.0e9/s on v5e whatever fraction of the
 128x128 MXU weight tile the (8r, 8k) bit-matrix fills.
@@ -10,19 +10,18 @@ Run on a real chip: python bench_schemes.py
 """
 import json
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bench import _make_timed, roofline_limit_mbps
 from seaweedfs_tpu.ops import rs_bitmatrix
 from seaweedfs_tpu.ops.coder_jax import plane_major
-from seaweedfs_tpu.ops.coder_pallas import apply_bitmatrix_pallas
 from seaweedfs_tpu.ops.coder_numpy import NumpyCoder
+from seaweedfs_tpu.ops.coder_pallas import apply_bitmatrix_pallas
 
 N = 64 * 1024 * 1024
-ITERS = 10
 BLOCK = 65536
 
 
@@ -30,25 +29,10 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-@jax.jit
-def _chain(acc, out):
-    return acc ^ out[:, :256].astype(jnp.uint32).sum()
-
-
-def timed(fn, *args, **kw):
-    out = fn(*args, **kw)
-    acc = _chain(jnp.uint32(0), out)
-    int(acc)
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        out = fn(*args, **kw)
-        acc = _chain(acc, out)
-    int(acc)
-    return (time.perf_counter() - t0) / ITERS
-
-
 def main():
-    log(f"device: {jax.devices()[0]}")
+    dev = jax.devices()[0]
+    log(f"device: {dev}")
+    timed = _make_timed()
     key = jax.random.PRNGKey(0)
     results = {}
     for k, r in ((10, 4), (16, 4), (8, 3)):
@@ -58,16 +42,22 @@ def main():
         data = jax.random.randint(key, (k, N), 0, 256,
                                   dtype=jnp.int32).astype(jnp.uint8)
         jax.block_until_ready(data)
-        # correctness gate per scheme
-        got = np.asarray(apply_bitmatrix_pallas(
-            pm, data[:, :BLOCK], r, k, block_n=BLOCK, mm="int8"))
-        ok = np.array_equal(got, NumpyCoder(k, r).encode(
-            np.asarray(data[:, :BLOCK])))
-        assert ok, f"RS({k},{r}) wrong"
+        want = NumpyCoder(k, r).encode(np.asarray(data[:, :BLOCK]))
+        limit = roofline_limit_mbps(r, k)
         for mm in ("int8", "bf16"):
+            # correctness gate per scheme AND dtype: an untested
+            # lowering must never publish a number.
+            got = np.asarray(apply_bitmatrix_pallas(
+                pm, data[:, :BLOCK], r, k, block_n=BLOCK, mm=mm))
+            assert np.array_equal(got, want), f"RS({k},{r}) {mm} wrong"
             dt = timed(apply_bitmatrix_pallas, pm, data, r, k,
                        block_n=BLOCK, mm=mm)
             mbps = data.nbytes / dt / 1e6
+            if dev.platform == "tpu" and mbps > 1.05 * limit:
+                log(f"RS({k:2d},{r}) {mm}: REJECT {mbps:.0f} MB/s — "
+                    f"exceeds the physical roofline {limit:.0f} MB/s "
+                    f"(harness bug, not a result)")
+                continue
             cols = (N / dt) / 1e9
             log(f"RS({k:2d},{r}) {mm}: {mbps:8.0f} MB/s "
                 f"({cols:.2f}e9 cols/s, {k}B/col)")

@@ -216,21 +216,22 @@ class FilerServer:
             d = json.loads(body)
             d["path"] = path
             entry = Entry.from_dict(d)
-            pre_fids = {c.file_id for c in entry.chunks}
             ttl_sec = entry.attributes.ttl_sec
-            entry.chunks = self._manifestize(
-                entry.chunks, entry.attributes.collection,
-                f"{ttl_sec}s" if ttl_sec else "")
+            manifests: list = []
             try:
+                entry.chunks = self._manifestize(
+                    entry.chunks, entry.attributes.collection,
+                    f"{ttl_sec}s" if ttl_sec else "", created=manifests)
                 with self.filer.with_signatures(self._signatures(query)):
                     e = self.filer.create_entry(entry)
-            except FilerError as err:
-                # The caller owns its chunks, but the manifest blobs we
-                # just uploaded belong to nobody now — free them.
-                self._delete_file_ids(
-                    [c.file_id for c in entry.chunks
-                     if c.is_chunk_manifest and c.file_id not in pre_fids])
-                raise rpc.RpcError(409, str(err)) from None
+            except Exception as err:
+                # The caller owns its chunks, but any manifest blobs we
+                # uploaded (even partially, mid-manifestize) belong to
+                # nobody now — free them.
+                self._delete_file_ids([c.file_id for c in manifests])
+                if isinstance(err, FilerError):
+                    raise rpc.RpcError(409, str(err)) from None
+                raise
             return e.to_dict()
         if "hardlink.from" in query:
             # `ln` through the HTTP surface: POST /new/name?hardlink.from=

