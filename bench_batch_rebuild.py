@@ -62,9 +62,10 @@ def main() -> None:
     log(f"{VOLUMES} volumes x {SHARD_BYTES >> 10}KB shards, "
         f"{chunk_v} volumes/step")
 
-    # One representative stacked batch, reused for every step (the
-    # gather is not what's being measured); volumes differ by a cheap
-    # roll so steps aren't byte-identical.
+    # One representative stacked batch, reused for every step — the
+    # gather is not what's being measured, and jit dispatch does not
+    # cache across identical calls (each step executes fully; the
+    # fenced block_until_ready proves it).
     stacked = rng.integers(0, 256, (chunk_v, 10, SHARD_BYTES),
                            dtype=np.uint8)
 

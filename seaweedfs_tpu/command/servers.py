@@ -125,23 +125,15 @@ def run_filer(flags: Flags, args: list[str]) -> int:
 
 def _s3_identities(config_path: str):
     """Load identities from the reference's JSON config shape
-    (s3api/auth_credentials.go: {"identities":[{name, credentials:
-    [{accessKey, secretKey}], actions}]})."""
+    (s3api/auth_credentials.go); None (no -config flag) lets the
+    gateway fall back to filer-backed IAM."""
     import json
 
-    from ..s3api.auth import Identity
+    from ..s3api.auth import identities_from_dict
     if not config_path:
         return None
     with open(config_path) as f:
-        cfg = json.load(f)
-    out = []
-    for ident in cfg.get("identities", []):
-        cred = (ident.get("credentials") or [{}])[0]
-        out.append(Identity(name=ident.get("name", ""),
-                            access_key=cred.get("accessKey", ""),
-                            secret_key=cred.get("secretKey", ""),
-                            actions=ident.get("actions", ["Admin"])))
-    return out
+        return identities_from_dict(json.load(f))
 
 
 def run_s3(flags: Flags, args: list[str]) -> int:

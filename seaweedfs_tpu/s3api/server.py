@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import threading
 import time
 import urllib.parse
 import urllib.request
@@ -173,14 +174,32 @@ class S3Error(Exception):
         self.message = message
 
 
+# Filer path holding the live S3 identities config (the reference's
+# filer-backed IAM: auth_credentials.go loads the same JSON shape from
+# the filer's /etc tree and reloads on change).
+IAM_CONFIG_PATH = "/etc/iam/identity.json"
+
+
 class S3ApiServer:
     def __init__(self, filer_url: str, host: str = "127.0.0.1",
                  port: int = 0,
                  identities: list[Identity] | None = None,
                  metrics_port: int | None = None,
-                 ssl_context=None):
+                 ssl_context=None,
+                 iam_refresh_seconds: float = 5.0):
         self.filer = FilerProxy(filer_url)
         self.iam = IdentityAccessManagement(identities)
+        # Filer-backed IAM: with no explicit identities, the config
+        # lives IN the cluster at /etc/iam/identity.json and hot-
+        # reloads — update the file through any filer and every S3
+        # gateway picks it up.
+        self._iam_from_filer = identities is None
+        self._iam_raw: bytes | None = None
+        self._iam_refresh = iam_refresh_seconds
+        self._iam_stop = threading.Event()
+        self._iam_thread = None
+        if self._iam_from_filer:
+            self._reload_iam()
         self.server = rpc.JsonHttpServer(host, port, pass_headers=True,
                                          ssl_context=ssl_context)
         for method in ("GET", "HEAD", "PUT", "POST", "DELETE"):
@@ -204,11 +223,67 @@ class S3ApiServer:
         self.server.start()
         if self.metrics_server is not None:
             self.metrics_server.start()
+        if self._iam_from_filer:
+            self._iam_thread = threading.Thread(
+                target=self._iam_reload_loop, daemon=True,
+                name="s3-iam-reload")
+            self._iam_thread.start()
 
     def stop(self) -> None:
+        self._iam_stop.set()
         if self.metrics_server is not None:
             self.metrics_server.stop()
         self.server.stop()
+
+    def _reload_iam(self) -> bool:
+        """Pull /etc/iam/identity.json from the filer; swap the
+        identity set when it changed.  A definitive 404 means IAM is
+        intentionally unconfigured (anonymous mode); any OTHER failure
+        before the first successful read fails CLOSED — a filer outage
+        at startup must not open the gateway to the world."""
+        import urllib.error
+        try:
+            with self.filer.get(IAM_CONFIG_PATH) as resp:
+                raw = resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                self.iam.fail_closed = False  # anonymous is intended
+                self._iam_raw = None
+                return False
+            self._iam_fetch_failed()
+            return False
+        except Exception:  # noqa: BLE001 — filer down / timeout
+            self._iam_fetch_failed()
+            return False
+        if raw == self._iam_raw:
+            self.iam.fail_closed = False
+            return False
+        try:
+            from .auth import identities_from_dict
+            idents = identities_from_dict(json.loads(raw))
+        except Exception as e:  # noqa: BLE001 — keep serving with the
+            from ..utils import glog  # last-good identities
+            glog.warningf("s3 iam: unparseable %s: %s",
+                          IAM_CONFIG_PATH, e)
+            return False
+        self._iam_raw = raw
+        self.iam.replace(idents)
+        self.iam.fail_closed = False
+        return True
+
+    def _iam_fetch_failed(self) -> None:
+        if self._iam_raw is None:
+            # Never successfully read the config: we cannot tell
+            # "anonymous intended" from "filer unreachable" — deny
+            # until a poll succeeds.
+            self.iam.fail_closed = True
+
+    def _iam_reload_loop(self) -> None:
+        while not self._iam_stop.wait(self._iam_refresh):
+            try:
+                self._reload_iam()
+            except Exception:  # noqa: BLE001
+                pass
 
     def url(self) -> str:
         return self.server.url()
@@ -225,6 +300,13 @@ class S3ApiServer:
         headers = query.get("_headers", {})
         raw_query = query.get("_raw_query", "")
         try:
+            if method == "POST" and headers.get(
+                    "content-type", "").startswith("multipart/form-data"):
+                # Browser-form upload: authentication is the signed
+                # POST policy inside the form, not a header
+                # (s3api/policy/post-policy.go).
+                return self._post_object(
+                    path, headers, _as_bytes(body))
             sha_hdr = headers.get("x-amz-content-sha256", "")
             length = getattr(body, "length", None)
             if self.iam.enabled and not sha_hdr:
@@ -422,6 +504,52 @@ class S3ApiServer:
         meta = self.filer.meta(path)
         etag = self._entry_etag(meta) if meta else fallback_etag
         return (200, b"", {"ETag": f'"{etag}"'})
+
+    def _post_object(self, path: str, headers: dict, body: bytes):
+        """POST-policy upload: multipart form to the bucket URL with a
+        signed policy; the file lands at the form's `key`
+        (s3api_object_handlers PostPolicyBucketHandler analog)."""
+        from .policy import PostPolicy, parse_multipart_form
+        bucket = urllib.parse.unquote(path).lstrip("/").split("/", 1)[0]
+        if not bucket:
+            raise S3Error(405, "MethodNotAllowed",
+                          "POST uploads go to a bucket URL")
+        fields, file_name, file_bytes, file_ctype = parse_multipart_form(
+            body, headers.get("content-type", ""))
+        key = fields.get("key", "")
+        if not key:
+            raise S3Error(400, "InvalidArgument",
+                          "POST form needs a key field")
+        # Substitute ${filename} BEFORE the policy runs: conditions
+        # must constrain the FINAL key, or an attacker-chosen filename
+        # escapes the signed prefix (post-policy.go substitutes first).
+        key = key.replace("${filename}", file_name)
+        # Authenticate before touching the bucket — a 404-vs-403 split
+        # for anonymous callers would be a bucket-existence oracle.
+        identity = self.iam.authenticate_policy(fields)
+        if self.iam.enabled:
+            self.iam.authorize(identity, ACTION_WRITE, bucket)
+            lower = {k.lower(): v for k, v in fields.items()}
+            PostPolicy.parse(lower["policy"]).check(
+                dict(fields, key=key), len(file_bytes))
+        self._require_bucket(bucket)
+        obj_path = self._obj_path(bucket, key)
+        etag = self._put_body(
+            obj_path, file_bytes,
+            fields.get("Content-Type") or file_ctype
+            or "application/octet-stream")
+        status = fields.get("success_action_status", "204")
+        loc = f"/{bucket}/{urllib.parse.quote(key)}"
+        if status == "201":
+            root = ET.Element("PostResponse")
+            _el(root, "Location", loc)
+            _el(root, "Bucket", bucket)
+            _el(root, "Key", key)
+            _el(root, "ETag", f'"{etag}"')
+            return (201, _xml(root),
+                    {"Content-Type": "application/xml"})
+        return (200 if status == "200" else 204, b"",
+                {"ETag": f'"{etag}"', "Location": loc})
 
     def _put_body(self, path: str, body, ctype: str = "") -> str:
         """Store a request body (bytes or streaming reader) at a filer

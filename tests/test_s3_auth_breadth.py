@@ -1,0 +1,403 @@
+"""S3 auth breadth: signature v2 (header + presigned), presigned v4,
+POST-policy uploads, filer-backed IAM.
+
+Reference: weed/s3api/auth_signature_v2.go, s3api/policy/,
+auth_credentials.go.
+"""
+
+import base64
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.s3api import Identity, S3ApiServer
+from seaweedfs_tpu.s3api.auth import (
+    AuthError,
+    IdentityAccessManagement,
+    canonical_string_v2,
+    compute_signature_v4,
+    derive_signing_key,
+    signature_v2,
+)
+from seaweedfs_tpu.s3api.policy import PostPolicy, parse_multipart_form
+
+ACCESS, SECRET = "V2ACCESSKEY", "v2-secret-key"
+IDENT = Identity("alice", ACCESS, SECRET, ["Admin"])
+
+
+@pytest.fixture
+def iam():
+    return IdentityAccessManagement([IDENT])
+
+
+# -- signature v2 ------------------------------------------------------------
+
+
+def _v2_sign(method, path, raw_query, headers):
+    date_field = "" if "x-amz-date" in headers else headers.get("date", "")
+    return signature_v2(SECRET, canonical_string_v2(
+        method, path, raw_query, headers, date_field))
+
+
+def test_v2_header_auth_roundtrip(iam):
+    headers = {"date": time.strftime("%a, %d %b %Y %H:%M:%S GMT",
+                                     time.gmtime()),
+               "content-type": "text/plain"}
+    sig = _v2_sign("PUT", "/bkt/key.txt", "", headers)
+    headers["authorization"] = f"AWS {ACCESS}:{sig}"
+    ident = iam.authenticate("PUT", "/bkt/key.txt", "", headers, b"x")
+    assert ident.name == "alice"
+    # Tampering with the path breaks it.
+    with pytest.raises(AuthError):
+        iam.authenticate("PUT", "/bkt/other.txt", "", headers, b"x")
+
+
+def test_v2_subresource_in_canonical_string(iam):
+    """?uploads participates in the canonical resource; ?prefix does
+    not (resourceList whitelist)."""
+    headers = {"date": "Mon, 01 Jan 2024 00:00:00 GMT"}
+    sig = _v2_sign("POST", "/bkt/key", "uploads", headers)
+    h = dict(headers, authorization=f"AWS {ACCESS}:{sig}")
+    assert iam.authenticate("POST", "/bkt/key", "uploads", h, b"")
+    # The same signature is NOT valid without the subresource...
+    with pytest.raises(AuthError):
+        iam.authenticate("POST", "/bkt/key", "", h, b"")
+    # ...but non-whitelisted params don't affect it.
+    assert iam.authenticate("POST", "/bkt/key", "uploads&prefix=zz",
+                            h, b"")
+
+
+def test_v2_presigned(iam):
+    expires = int(time.time()) + 60
+    sig = signature_v2(SECRET, canonical_string_v2(
+        "GET", "/bkt/file.bin", "", {}, str(expires)))
+    q = urllib.parse.urlencode({"AWSAccessKeyId": ACCESS,
+                                "Expires": str(expires),
+                                "Signature": sig})
+    assert iam.authenticate("GET", "/bkt/file.bin", q, {}, b"")
+    # Expired link.
+    old = int(time.time()) - 10
+    sig_old = signature_v2(SECRET, canonical_string_v2(
+        "GET", "/bkt/file.bin", "", {}, str(old)))
+    q_old = urllib.parse.urlencode({"AWSAccessKeyId": ACCESS,
+                                    "Expires": str(old),
+                                    "Signature": sig_old})
+    with pytest.raises(AuthError) as ei:
+        iam.authenticate("GET", "/bkt/file.bin", q_old, {}, b"")
+    assert "expired" in str(ei.value)
+
+
+def test_v4_presigned(iam):
+    now = time.gmtime()
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
+    scope = f"{time.strftime('%Y%m%d', now)}/us-east-1/s3/aws4_request"
+    params = [("X-Amz-Algorithm", "AWS4-HMAC-SHA256"),
+              ("X-Amz-Credential", f"{ACCESS}/{scope}"),
+              ("X-Amz-Date", amz_date),
+              ("X-Amz-Expires", "300"),
+              ("X-Amz-SignedHeaders", "host")]
+    raw = urllib.parse.urlencode(params)
+    headers = {"host": "s3.example:8333"}
+    sig = compute_signature_v4("GET", "/bkt/obj", raw, headers,
+                               ["host"], "UNSIGNED-PAYLOAD", amz_date,
+                               scope, SECRET)
+    full = raw + "&" + urllib.parse.urlencode({"X-Amz-Signature": sig})
+    assert iam.authenticate("GET", "/bkt/obj", full, headers, b"")
+    with pytest.raises(AuthError):
+        bad = raw + "&X-Amz-Signature=" + "0" * 64
+        iam.authenticate("GET", "/bkt/obj", bad, headers, b"")
+
+
+def test_v4_presigned_long_lived_link(iam):
+    """The whole point of presigning: a link used 20 minutes after
+    signing is VALID while X-Amz-Expires allows it — only the
+    expiry governs age, not the header-auth skew window."""
+    signed_at = time.gmtime(time.time() - 20 * 60)
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", signed_at)
+    scope = (f"{time.strftime('%Y%m%d', signed_at)}"
+             "/us-east-1/s3/aws4_request")
+    params = [("X-Amz-Algorithm", "AWS4-HMAC-SHA256"),
+              ("X-Amz-Credential", f"{ACCESS}/{scope}"),
+              ("X-Amz-Date", amz_date),
+              ("X-Amz-Expires", "3600"),
+              ("X-Amz-SignedHeaders", "host")]
+    raw = urllib.parse.urlencode(params)
+    headers = {"host": "h"}
+    sig = compute_signature_v4("GET", "/b/k", raw, headers, ["host"],
+                               "UNSIGNED-PAYLOAD", amz_date, scope,
+                               SECRET)
+    full = raw + "&" + urllib.parse.urlencode({"X-Amz-Signature": sig})
+    assert iam.authenticate("GET", "/b/k", full, headers, b"")
+    # ...but past its declared expiry it dies.
+    bad = [(k, ("60" if k == "X-Amz-Expires" else v))
+           for k, v in params]
+    raw2 = urllib.parse.urlencode(bad)
+    sig2 = compute_signature_v4("GET", "/b/k", raw2, headers, ["host"],
+                                "UNSIGNED-PAYLOAD", amz_date, scope,
+                                SECRET)
+    with pytest.raises(AuthError) as ei:
+        iam.authenticate(
+            "GET", "/b/k",
+            raw2 + "&" + urllib.parse.urlencode(
+                {"X-Amz-Signature": sig2}), headers, b"")
+    assert "expired" in str(ei.value)
+    # Malformed Expires is a clean 400, not a 500.
+    with pytest.raises(AuthError) as ei:
+        iam.authenticate(
+            "GET", "/b/k",
+            raw.replace("X-Amz-Expires=3600", "X-Amz-Expires=abc")
+            + "&X-Amz-Signature=" + sig, headers, b"")
+    assert ei.value.status == 400
+
+
+def test_iam_fail_closed():
+    iam = IdentityAccessManagement([])
+    iam.fail_closed = True
+    with pytest.raises(AuthError) as ei:
+        iam.authenticate("GET", "/", "", {}, b"")
+    assert ei.value.status == 503
+    with pytest.raises(AuthError):
+        iam.authenticate_policy({"policy": "x"})
+
+
+# -- POST policy -------------------------------------------------------------
+
+
+def _policy_b64(conditions, expires_in=120):
+    doc = {"expiration": time.strftime(
+        "%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(time.time() + expires_in)),
+        "conditions": conditions}
+    return base64.b64encode(json.dumps(doc).encode()).decode()
+
+
+def test_policy_signature_v2_and_conditions(iam):
+    policy = _policy_b64([{"bucket": "pics"},
+                          ["starts-with", "$key", "user/"],
+                          ["content-length-range", 1, 1024]])
+    form = {"policy": policy, "AWSAccessKeyId": ACCESS,
+            "Signature": signature_v2(SECRET, policy),
+            "key": "user/cat.jpg", "bucket": "pics"}
+    assert iam.authenticate_policy(form).name == "alice"
+    pol = PostPolicy.parse(policy)
+    pol.check(form, 512)
+    with pytest.raises(AuthError):  # over the size range
+        pol.check(form, 4096)
+    with pytest.raises(AuthError):  # key prefix violated
+        pol.check(dict(form, key="other/cat.jpg"), 512)
+    with pytest.raises(AuthError):  # field not covered by the policy
+        pol.check(dict(form, acl="public-read"), 512)
+    with pytest.raises(AuthError):  # bad signature
+        iam.authenticate_policy(dict(form, Signature="AAAA"))
+
+
+def test_policy_signature_v4(iam):
+    policy = _policy_b64([{"bucket": "pics"}])
+    now = time.gmtime()
+    scope = f"{time.strftime('%Y%m%d', now)}/us-east-1/s3/aws4_request"
+    key = derive_signing_key(SECRET, time.strftime("%Y%m%d", now),
+                             "us-east-1")
+    import hashlib
+    import hmac as hmac_mod
+    sig = hmac_mod.new(key, policy.encode(), hashlib.sha256).hexdigest()
+    form = {"policy": policy, "X-Amz-Credential": f"{ACCESS}/{scope}",
+            "X-Amz-Signature": sig, "bucket": "pics"}
+    assert iam.authenticate_policy(form).name == "alice"
+
+
+def test_policy_checks_final_key_after_filename_substitution(stack):
+    """${filename} substitutes BEFORE the policy runs, so a malicious
+    filename cannot escape the signed key prefix."""
+    master, vs, filer = stack
+    s3 = S3ApiServer(filer.url(), identities=[IDENT])
+    s3.start()
+    try:
+        headers = {"Date": time.strftime("%a, %d %b %Y %H:%M:%S GMT",
+                                         time.gmtime())}
+        sig = _v2_sign("PUT", "/polbkt", "",
+                       {k.lower(): v for k, v in headers.items()})
+        urllib.request.urlopen(urllib.request.Request(
+            f"{s3.url()}/polbkt", method="PUT",
+            headers=dict(headers,
+                         Authorization=f"AWS {ACCESS}:{sig}")),
+            timeout=30).read()
+        policy = _policy_b64([{"bucket": "polbkt"},
+                              ["eq", "$key", "safe/exact.txt"]])
+        fields = {"key": "safe/${filename}", "bucket": "polbkt",
+                  "policy": policy, "AWSAccessKeyId": ACCESS,
+                  "Signature": signature_v2(SECRET, policy)}
+        # filename that makes the FINAL key violate the eq condition
+        body, ctype = _form_body(fields, b"x", filename="evil.txt")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{s3.url()}/polbkt", body, ctype)
+        assert ei.value.code == 403
+        # the sanctioned filename passes
+        body, ctype = _form_body(fields, b"ok", filename="exact.txt")
+        with _post(f"{s3.url()}/polbkt", body, ctype) as r:
+            assert r.status == 204
+    finally:
+        s3.stop()
+
+
+def test_unknown_policy_operator_rejected():
+    policy = _policy_b64([["starts-with ", "$key", "x"]])  # typo'd op
+    with pytest.raises(AuthError) as ei:
+        PostPolicy.parse(policy).check({"key": "xyz"}, 1)
+    assert ei.value.status == 400
+
+
+def test_expired_policy_rejected(iam):
+    policy = _policy_b64([{"bucket": "b"}], expires_in=-5)
+    with pytest.raises(AuthError) as ei:
+        PostPolicy.parse(policy).check({"bucket": "b"}, 1)
+    assert "expired" in str(ei.value)
+
+
+def test_multipart_form_parser():
+    boundary = "xyzBOUNDARYxyz"
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="key"\r\n\r\n'
+        "docs/${filename}\r\n"
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="policy"\r\n\r\n'
+        "cG9saWN5\r\n"
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="file"; '
+        'filename="report.pdf"\r\n'
+        "Content-Type: application/pdf\r\n\r\n"
+        "PDFBYTES\x00MORE\r\n"
+        f"--{boundary}--\r\n").encode("latin-1")
+    fields, fname, fbytes, fctype = parse_multipart_form(
+        body, f"multipart/form-data; boundary={boundary}")
+    assert fields["key"] == "docs/${filename}"
+    assert fields["policy"] == "cG9saWN5"
+    assert fname == "report.pdf"
+    assert fbytes == b"PDFBYTES\x00MORE"
+    assert fctype == "application/pdf"
+    assert "Content-Type" not in fields  # file part != form field
+
+
+# -- e2e: browser POST upload + filer-backed IAM ----------------------------
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("s3-auth-stack")
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp / "vs")], pulse_seconds=60)
+    vs.start()
+    filer = FilerServer(master.url())
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def _form_body(fields: dict, file_bytes: bytes,
+               filename="up.bin") -> tuple[bytes, str]:
+    boundary = "testBoundary123"
+    parts = []
+    for k, v in fields.items():
+        parts.append(f"--{boundary}\r\nContent-Disposition: form-data; "
+                     f'name="{k}"\r\n\r\n{v}\r\n')
+    parts.append(f"--{boundary}\r\nContent-Disposition: form-data; "
+                 f'name="file"; filename="{filename}"\r\n'
+                 "Content-Type: application/octet-stream\r\n\r\n")
+    body = "".join(parts).encode() + file_bytes + \
+        f"\r\n--{boundary}--\r\n".encode()
+    return body, f"multipart/form-data; boundary={boundary}"
+
+
+def _post(url, body, ctype):
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers={"Content-Type": ctype})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_post_policy_upload_e2e(stack):
+    master, vs, filer = stack
+    s3 = S3ApiServer(filer.url(), identities=[IDENT])
+    s3.start()
+    try:
+        # create the bucket with sigv2 header auth — exercises v2 over
+        # the real wire too
+        headers = {"Date": time.strftime("%a, %d %b %Y %H:%M:%S GMT",
+                                         time.gmtime())}
+        sig = _v2_sign("PUT", "/postbkt", "",
+                       {k.lower(): v for k, v in headers.items()})
+        req = urllib.request.Request(
+            f"{s3.url()}/postbkt", method="PUT",
+            headers=dict(headers, Authorization=f"AWS {ACCESS}:{sig}"))
+        urllib.request.urlopen(req, timeout=30).read()
+
+        policy = _policy_b64([{"bucket": "postbkt"},
+                              ["starts-with", "$key", "in/"],
+                              ["content-length-range", 0, 65536]])
+        fields = {"key": "in/${filename}", "bucket": "postbkt",
+                  "policy": policy, "AWSAccessKeyId": ACCESS,
+                  "Signature": signature_v2(SECRET, policy),
+                  "success_action_status": "201"}
+        payload = b"browser upload bytes " * 99
+        body, ctype = _form_body(fields, payload, filename="pic.jpg")
+        with _post(f"{s3.url()}/postbkt", body, ctype) as r:
+            assert r.status == 201
+            assert b"<Key>in/pic.jpg</Key>" in r.read()
+        # The object is readable through the filer namespace.
+        with urllib.request.urlopen(
+                f"{filer.url()}/buckets/postbkt/in/pic.jpg",
+                timeout=30) as r:
+            assert r.read() == payload
+        # A form with a field the policy doesn't cover is rejected.
+        bad_fields = dict(fields, acl="public-read")
+        body, ctype = _form_body(bad_fields, b"x")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{s3.url()}/postbkt", body, ctype)
+        assert ei.value.code == 403
+    finally:
+        s3.stop()
+
+
+def test_filer_backed_iam_hot_reload(stack):
+    master, vs, filer = stack
+    cfg = {"identities": [{
+        "name": "filer-admin",
+        "credentials": [{"accessKey": "FILERKEY",
+                         "secretKey": "filersecret"}],
+        "actions": ["Admin"]}]}
+    req = urllib.request.Request(
+        f"{filer.url()}/etc/iam/identity.json",
+        data=json.dumps(cfg).encode(), method="POST")
+    urllib.request.urlopen(req, timeout=30).read()
+
+    s3 = S3ApiServer(filer.url(), iam_refresh_seconds=0.2)
+    s3.start()
+    try:
+        assert s3.iam.enabled
+        assert "FILERKEY" in s3.iam.identities
+        # Unauthenticated requests are rejected now.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{s3.url()}/", timeout=30)
+        assert ei.value.code == 403
+        # Update the config through the filer: the gateway hot-reloads.
+        cfg["identities"][0]["credentials"][0]["accessKey"] = "ROTATED"
+        req = urllib.request.Request(
+            f"{filer.url()}/etc/iam/identity.json",
+            data=json.dumps(cfg).encode(), method="POST")
+        urllib.request.urlopen(req, timeout=30).read()
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                "ROTATED" not in s3.iam.identities:
+            time.sleep(0.1)
+        assert "ROTATED" in s3.iam.identities
+        assert "FILERKEY" not in s3.iam.identities
+    finally:
+        s3.stop()
