@@ -96,7 +96,11 @@ class WeedClient:
             q.append(f"dataCenter={data_center}")
         return self._master_call("/dir/assign?" + "&".join(q))
 
-    def lookup(self, vid: int) -> list[dict]:
+    def lookup(self, vid: int, include_ec: bool = False) -> list[dict]:
+        """Volume locations.  include_ec adds EC shard holders — READ
+        targets only (any holder reconstructs across the cluster); they
+        are never cached and never offered to write/delete paths, which
+        must keep failing fast on EC'd volumes."""
         cached = self.cache.get(vid)
         if cached is not None:
             return cached
@@ -104,7 +108,12 @@ class WeedClient:
         locs = resp.get("locations", [])
         if locs:
             self.cache.put(vid, locs)
-        return locs
+            return locs
+        if include_ec:
+            urls = {d["url"] for dns in resp.get("ecShards", {}).values()
+                    for d in dns}
+            return [{"url": u} for u in sorted(urls)]
+        return []
 
     # -- object ops ----------------------------------------------------------
 
@@ -128,7 +137,7 @@ class WeedClient:
 
     def download(self, fid: str) -> bytes:
         vid, _key, _cookie = t.parse_file_id(fid)
-        locs = self.lookup(vid)
+        locs = self.lookup(vid, include_ec=True)
         if not locs:
             raise rpc.RpcError(404, f"volume {vid} has no locations")
         last_err: Exception | None = None

@@ -62,12 +62,25 @@ class MasterServer:
                  peers: list[str] | None = None,
                  jwt_signing_key: str = "",
                  jwt_expires_seconds: int = 10,
-                 ssl_context=None):
+                 ssl_context=None,
+                 admin_scripts: str = "",
+                 admin_script_interval: float = 17 * 60):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_seconds = jwt_expires_seconds
+        # Admin-script cron (master_server.go:187-263 startAdminScripts):
+        # master.toml maintenance scripts — one shell command per line —
+        # run on the leader every interval, wrapped in lock/unlock, so
+        # the EC lifecycle (ec.encode/rebuild/balance, volume.balance)
+        # runs unattended.
+        self.admin_scripts = [ln.strip() for ln in admin_scripts.split("\n")
+                              if ln.strip()]
+        self.admin_script_interval = admin_script_interval
+        # (started_at, line, ok, output-or-error) — observability for
+        # tests and the status endpoint.
+        self.admin_script_runs: list[tuple[float, str, bool, str]] = []
         if meta_dir:
             import os
             os.makedirs(meta_dir, exist_ok=True)
@@ -211,12 +224,53 @@ class MasterServer:
         self._sweeper.start()
         if self.raft is not None:
             self.raft.start()
+        if self.admin_scripts:
+            threading.Thread(target=self._admin_script_loop,
+                             daemon=True, name="master-cron").start()
 
     def stop(self) -> None:
         self._stop.set()
         if self.raft is not None:
             self.raft.stop()
         self.server.stop()
+
+    # -- admin-script cron (startAdminScripts) -------------------------------
+
+    def _admin_script_loop(self) -> None:
+        while not self._stop.wait(self.admin_script_interval):
+            if not self.is_leader():
+                continue
+            try:
+                self.run_admin_scripts()
+            except Exception:  # noqa: BLE001 — cron must never die
+                pass
+
+    def run_admin_scripts(self) -> list[tuple[float, str, bool, str]]:
+        """One cron round: lock, run every configured script line
+        through the shell dispatcher, unlock.  Returns this round's
+        (ts, line, ok, output) records (also appended to
+        admin_script_runs)."""
+        from ..shell import CommandEnv, run_command
+        from ..utils import glog
+        env = CommandEnv(self.url())
+        round_runs: list[tuple[float, str, bool, str]] = []
+        try:
+            lines = list(self.admin_scripts)
+            if not any(ln == "lock" for ln in lines):
+                lines = ["lock"] + lines + ["unlock"]
+            for line in lines:
+                ts = time.time()
+                try:
+                    out = run_command(env, line)
+                    round_runs.append((ts, line, True, out))
+                except Exception as e:  # noqa: BLE001 — next script
+                    glog.warningf("admin script %r: %s", line, e)
+                    round_runs.append((ts, line, False, str(e)))
+        finally:
+            env.close()
+            self.admin_script_runs.extend(round_runs)
+            del self.admin_script_runs[:-200]
+        return round_runs
 
     def url(self) -> str:
         return self.server.url()

@@ -52,9 +52,13 @@ def _wait_forever(servers: list) -> int:
 
 def run_master(flags: Flags, args: list[str]) -> int:
     from ..cluster.master import MasterServer as Master
+    from ..utils.config import load_configuration
     # -peers=host1:9333,host2:9333 turns on raft HA (raft_server.go).
     peers = [p if p.startswith("http") else f"http://{p}"
              for p in flags.get("peers", "").split(",") if p]
+    # master.toml [master.maintenance]: unattended EC/balance lifecycle
+    # (master_server.go startAdminScripts).
+    mcfg = load_configuration("master")
     m = Master(
         host=flags.get("ip", "127.0.0.1"),
         port=flags.get_int("port", 9333),
@@ -64,7 +68,10 @@ def run_master(flags: Flags, args: list[str]) -> int:
         garbage_threshold=flags.get_float("garbageThreshold", 0.3),
         peers=peers or None,
         jwt_signing_key=flags.get("jwt.key", ""),
-        ssl_context=_security("master"))
+        ssl_context=_security("master"),
+        admin_scripts=mcfg.get_string("master.maintenance.scripts"),
+        admin_script_interval=60 * mcfg.get_int(
+            "master.maintenance.sleep_minutes", 17))
     m.start()
     glog.infof("master serving at %s", m.server.url())
     return _wait_forever([m])

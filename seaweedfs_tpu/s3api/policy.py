@@ -136,10 +136,18 @@ def parse_multipart_form(body: bytes, content_type: str
     delim = b"--" + boundary.encode()
     fields: dict[str, str] = {}
     file_name, file_bytes, file_ctype = "", b"", ""
-    for part in body.split(delim):
-        part = part.strip(b"\r\n")
-        if not part or part == b"--":
-            continue
+    # Split on CRLF+delimiter so part content keeps its own trailing
+    # newlines byte-exact (RFC 2046: the CRLF before a boundary belongs
+    # to the boundary, not the content).  Normalize the first
+    # delimiter, which has no preceding CRLF.
+    if body.startswith(delim):
+        body = b"\r\n" + body
+    segments = body.split(b"\r\n" + delim)
+    for part in segments[1:]:  # [0] is the preamble
+        if part.startswith(b"--"):
+            break  # closing delimiter
+        if part.startswith(b"\r\n"):
+            part = part[2:]
         head, _, content = part.partition(b"\r\n\r\n")
         disp = ""
         ptype = ""

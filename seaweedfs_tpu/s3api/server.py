@@ -247,8 +247,15 @@ class S3ApiServer:
                 raw = resp.read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
-                self.iam.fail_closed = False  # anonymous is intended
-                self._iam_raw = None
+                # Definitive: the config does not exist.  If identities
+                # were previously loaded from the filer, the file's
+                # deletion revokes them (back to anonymous — the
+                # pre-config state).  _iam_raw keeps a sentinel so a
+                # LATER transient error doesn't flip to fail-closed.
+                if self._iam_raw not in (None, b""):
+                    self.iam.replace([])
+                self._iam_raw = b""
+                self.iam.fail_closed = False
                 return False
             self._iam_fetch_failed()
             return False
@@ -294,6 +301,8 @@ class S3ApiServer:
     # cross-check still runs; larger signed PUTs stream and the
     # signature covers the declared hash (reference behavior).
     _VERIFY_BUFFER_MAX = 8 * 1024 * 1024
+    # Browser-form POST uploads are parsed in memory; cap the body.
+    _POST_FORM_MAX = 256 * 1024 * 1024
 
     def _route(self, path: str, query: dict, body):
         method = query.get("_method", "GET")
@@ -304,9 +313,25 @@ class S3ApiServer:
                     "content-type", "").startswith("multipart/form-data"):
                 # Browser-form upload: authentication is the signed
                 # POST policy inside the form, not a header
-                # (s3api/policy/post-policy.go).
-                return self._post_object(
-                    path, headers, _as_bytes(body))
+                # (s3api/policy/post-policy.go).  The multipart body is
+                # buffered for parsing (the reference's
+                # ParseMultipartForm buffers/spills too) — capped so a
+                # giant form can't balloon RSS; large objects belong on
+                # the streaming PUT path.
+                length = getattr(body, "length", None)
+                if length is not None and length > self._POST_FORM_MAX:
+                    raise S3Error(413, "EntityTooLarge",
+                                  "POST form uploads are capped at "
+                                  f"{self._POST_FORM_MAX >> 20}MB; use "
+                                  "a signed PUT for larger objects")
+                data = body.read(self._POST_FORM_MAX + 1) \
+                    if hasattr(body, "read") else body
+                if len(data) > self._POST_FORM_MAX:
+                    raise S3Error(413, "EntityTooLarge",
+                                  "POST form uploads are capped at "
+                                  f"{self._POST_FORM_MAX >> 20}MB; use "
+                                  "a signed PUT for larger objects")
+                return self._post_object(path, headers, data)
             sha_hdr = headers.get("x-amz-content-sha256", "")
             length = getattr(body, "length", None)
             if self.iam.enabled and not sha_hdr:

@@ -259,6 +259,26 @@ def test_expired_policy_rejected(iam):
     assert "expired" in str(ei.value)
 
 
+def test_multipart_preserves_trailing_newlines():
+    """File content ending in newlines must round-trip byte-exact —
+    the framing CRLF belongs to the boundary, not the content
+    (review finding: text files were silently truncated)."""
+    boundary = "bnd"
+    payload = b"line1\nline2\n\r\n\r\n"  # hostile trailing bytes
+    body = (b"--bnd\r\n"
+            b'Content-Disposition: form-data; name="key"\r\n\r\n'
+            b"k\r\n"
+            b"--bnd\r\n"
+            b'Content-Disposition: form-data; name="file"; '
+            b'filename="t.txt"\r\n\r\n'
+            + payload +
+            b"\r\n--bnd--\r\n")
+    fields, _n, fbytes, _ct = parse_multipart_form(
+        body, f"multipart/form-data; boundary={boundary}")
+    assert fbytes == payload
+    assert fields["key"] == "k"
+
+
 def test_multipart_form_parser():
     boundary = "xyzBOUNDARYxyz"
     body = (
@@ -399,5 +419,16 @@ def test_filer_backed_iam_hot_reload(stack):
             time.sleep(0.1)
         assert "ROTATED" in s3.iam.identities
         assert "FILERKEY" not in s3.iam.identities
+        # Deleting the config revokes the loaded identities (back to
+        # the pre-config anonymous state) — it must not leave stale
+        # keys working forever.
+        urllib.request.urlopen(urllib.request.Request(
+            f"{filer.url()}/etc/iam/identity.json", method="DELETE"),
+            timeout=30).read()
+        deadline = time.time() + 5
+        while time.time() < deadline and s3.iam.identities:
+            time.sleep(0.1)
+        assert not s3.iam.identities
+        assert not s3.iam.fail_closed
     finally:
         s3.stop()
