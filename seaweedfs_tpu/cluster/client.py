@@ -58,6 +58,52 @@ class WeedClient:
         self._master_idx = 0
         self._secured: bool | None = None  # learned from responses
         self.cache = VidCache()
+        self._watch_stop: threading.Event | None = None
+
+    def start_location_watch(self):
+        """Subscribe to the master's /cluster/watch push stream (the
+        KeepConnected analog): volume-location changes invalidate the
+        vid cache the moment heartbeats land, instead of waiting out
+        the TTL.  Returns a stop() function; reconnects with backoff
+        while running."""
+        stop = threading.Event()
+        self._watch_stop = stop
+        holder: dict = {}
+
+        def loop():
+            while not stop.is_set():
+                try:
+                    handle = rpc.call_stream(
+                        f"{self.master_url}/cluster/watch",
+                        stop_event=stop)
+                    holder["handle"] = handle
+                    for doc in handle.events():
+                        if stop.is_set():
+                            return
+                        for vid in doc.get("new_vids", []) + \
+                                doc.get("deleted_vids", []):
+                            self.cache.forget(int(vid))
+                except rpc.RpcError as e:
+                    # A follower refuses watch streams (503): rotate to
+                    # the next seed until the leader answers.
+                    if e.status == 503 and len(self.masters) > 1:
+                        self._master_idx = (self._master_idx + 1) \
+                            % len(self.masters)
+                except Exception:  # noqa: BLE001 — master down; redial
+                    pass
+                finally:
+                    holder.pop("handle", None)
+                stop.wait(1.0)
+
+        threading.Thread(target=loop, daemon=True,
+                         name="vid-watch").start()
+
+        def stopper():
+            stop.set()
+            handle = holder.get("handle")
+            if handle is not None:
+                handle.close()
+        return stopper
 
     @property
     def master_url(self) -> str:

@@ -81,6 +81,13 @@ class MasterServer:
         # (started_at, line, ok, output-or-error) — observability for
         # tests and the status endpoint.
         self.admin_script_runs: list[tuple[float, str, bool, str]] = []
+        # Location push channels (/cluster/watch): the KeepConnected
+        # analog (pb/master.proto:10-13, master_grpc_server.go:178) —
+        # long-lived streams that receive volume-location changes the
+        # moment heartbeats land, so clients invalidate their vid maps
+        # without polling.
+        self._watchers: list = []
+        self._watchers_lock = threading.Lock()
         if meta_dir:
             import os
             os.makedirs(meta_dir, exist_ok=True)
@@ -101,6 +108,7 @@ class MasterServer:
         s.route("POST", "/dir/assign", self._assign)
         s.route("GET", "/dir/lookup", self._lookup)
         s.route("GET", "/dir/status", self._status)
+        s.route("GET", "/cluster/watch", self._cluster_watch)
         s.route("POST", "/vol/grow", self._grow)
         s.route("POST", "/vol/vacuum", self._vacuum)
         s.route("GET", "/col/list", self._col_list)
@@ -266,6 +274,12 @@ class MasterServer:
                 except Exception as e:  # noqa: BLE001 — next script
                     glog.warningf("admin script %r: %s", line, e)
                     round_runs.append((ts, line, False, str(e)))
+                    if line == "lock":
+                        # No exclusive lease (an operator holds it):
+                        # running maintenance concurrently with their
+                        # session is the exact race the lock prevents.
+                        # Abort the round; next tick retries.
+                        break
         finally:
             env.close()
             self.admin_script_runs.extend(round_runs)
@@ -310,6 +324,7 @@ class MasterServer:
                     return {"volume_size_limit":
                             self.topo.volume_size_limit}
                 dn.last_heartbeat_seq = seq
+            before = set(dn.volumes) | set(dn.ec_shards)
             if "volumes" in hb:  # full sync
                 volumes = [_vinfo_from_dict(v) for v in hb["volumes"]]
                 self.topo.sync_data_node_registration(volumes, dn)
@@ -324,7 +339,60 @@ class MasterServer:
                 self.topo.sync_data_node_ec_shards(
                     [(e["id"], e.get("collection", ""), e["shard_bits"])
                      for e in hb["ec_shards"]], dn)
+            after = set(dn.volumes) | set(dn.ec_shards)
+        if after != before:
+            # Push the delta to every /cluster/watch stream — clients
+            # drop their stale vid-map entries immediately
+            # (master_grpc_server.go:178 broadcast).
+            self._broadcast_locations({
+                "url": dn.url(), "public_url": dn.public_url,
+                "new_vids": sorted(after - before),
+                "deleted_vids": sorted(before - after)})
         return {"volume_size_limit": self.topo.volume_size_limit}
+
+    # -- location push (KeepConnected analog) --------------------------------
+
+    def _cluster_watch(self, query: dict, body: bytes):
+        """Long-lived location push stream: an initial snapshot of
+        every node's volumes, then deltas as heartbeats change them
+        (master_grpc_server.go KeepConnected broadcasting
+        VolumeLocation messages).  Followers refuse: their topology is
+        empty and a heartbeating-but-delta-free stream would silently
+        disable push invalidation; the client redials (rotating seeds)
+        until it finds the leader.  A deposed leader ends its streams
+        from the sweep loop for the same reason."""
+        if not self.is_leader():
+            raise rpc.RpcError(503, "not the leader; redial")
+        stream = rpc.EventStream()
+        with self._watchers_lock:
+            self._watchers.append(stream)
+        stream.on_close(lambda: self._drop_watcher(stream))
+        with self.topo._lock:
+            for dc in list(self.topo.children.values()):
+                for rack in list(dc.children.values()):
+                    for dn in list(rack.children.values()):
+                        vids = sorted(set(dn.volumes)
+                                      | set(dn.ec_shards))
+                        if vids:
+                            stream.push({"url": dn.url(),
+                                         "public_url": dn.public_url,
+                                         "new_vids": vids,
+                                         "deleted_vids": []})
+        return (200, stream, {"Content-Type": "application/x-ndjson"})
+
+    def _drop_watcher(self, stream) -> None:
+        with self._watchers_lock:
+            if stream in self._watchers:
+                self._watchers.remove(stream)
+
+    def _broadcast_locations(self, doc: dict) -> None:
+        with self._watchers_lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            try:
+                w.push(doc)
+            except Exception:  # noqa: BLE001 — a dying stream cleans
+                pass           # itself up via on_close
 
     def _option_from_query(self, query: dict) -> VolumeGrowOption:
         return VolumeGrowOption(
@@ -586,5 +654,24 @@ class MasterServer:
     def _sweep_loop(self) -> None:
         """Dead-node detection (CollectDeadNodeAndFullVolumes)."""
         while not self._stop.wait(self.topo.pulse_seconds):
+            if self.raft is not None and not self.is_leader():
+                # Deposed: heartbeats now land on the new leader, so
+                # our watch streams would heartbeat forever without
+                # deltas — end them; clients redial and find the
+                # leader.
+                with self._watchers_lock:
+                    doomed, self._watchers = self._watchers, []
+                for w in doomed:
+                    try:
+                        w.end()
+                    except Exception:  # noqa: BLE001
+                        pass
+                continue
             for dn in self.topo.collect_dead_nodes():
                 self.topo.unregister_data_node(dn)
+                # Dead node: every vid it held needs re-lookup.
+                vids = sorted(set(dn.volumes) | set(dn.ec_shards))
+                if vids:
+                    self._broadcast_locations({
+                        "url": dn.url(), "public_url": dn.public_url,
+                        "new_vids": [], "deleted_vids": vids})

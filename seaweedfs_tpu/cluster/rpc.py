@@ -128,6 +128,71 @@ def _chunk_pump(chunk_iter, buf: bytes, n: int):
     return bytes(out), buf, exhausted
 
 
+class EventStream:
+    """Unbounded push channel served as a chunked response — the
+    HTTP-plane analog of the reference's long-lived gRPC streams
+    (KeepConnected, SubscribeMetadata).  A handler returns one of
+    these; producer threads push() JSON-able docs, each going out as
+    one NDJSON line.  Blank-line heartbeats flow every `heartbeat`
+    seconds so a dead peer is detected by the send failing; close()
+    (run by the response writer on disconnect or end()) fires the
+    registered cleanups (unsubscribe hooks)."""
+
+    # A consumer that stops reading must not buffer the producer's
+    # events forever: past this bound the stream terminates and the
+    # client reconnects, resuming from its cursor (offsets make every
+    # push channel resumable, so ending early is always safe).
+    MAX_QUEUED = 65536
+
+    def __init__(self, heartbeat: float = 10.0):
+        import queue
+        self._q: "queue.Queue[bytes]" = queue.Queue()
+        self._empty = queue.Empty
+        self.heartbeat = heartbeat
+        self._cleanups: list = []
+        self._closed = False
+        self._overflowed = False
+
+    def push(self, doc: dict) -> None:
+        self.push_raw(json.dumps(doc).encode() + b"\n")
+
+    def push_raw(self, line: bytes) -> None:
+        if self._overflowed:
+            return
+        if self._q.qsize() >= self.MAX_QUEUED:
+            self._overflowed = True
+            self._q.put(b"")  # end: the slow consumer redials
+            return
+        self._q.put(line)
+
+    def end(self) -> None:
+        """Terminate the stream from the producer side."""
+        self._q.put(b"")
+
+    def on_close(self, fn) -> None:
+        self._cleanups.append(fn)
+
+    def read(self, n: int = -1) -> bytes:
+        if self._closed:
+            return b""
+        try:
+            return self._q.get(timeout=self.heartbeat)
+        except self._empty:
+            return b"\n"  # heartbeat keeps dead-peer detection alive
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._closed = True
+        for fn in self._cleanups:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                pass
+        return False
+
+
 class BodyReader:
     """Incremental request-body reader for stream_body routes.
 
@@ -484,14 +549,21 @@ class JsonHttpServer:
 
         if hasattr(payload, "read"):
             # Stream any file-like payload (open file, upstream HTTP
-            # response) without buffering it: O(1MB) memory per
-            # in-flight large read.
+            # response, or an unbounded push channel) without buffering
+            # it: O(1MB) memory per in-flight large read.  Payloads
+            # with a known size go out under Content-Length; sizeless
+            # ones (no fileno — e.g. a live event stream) use chunked
+            # transfer-encoding and end when read() returns b"".
             ctype = extra.pop("Content-Type", "application/octet-stream")
             size = extra.pop("Content-Length", None)
-            if size is None:
+            if size is None and hasattr(payload, "fileno"):
                 size = str(os.fstat(payload.fileno()).st_size)
             head.append(f"Content-Type: {ctype}")
-            head.append(f"Content-Length: {size}")
+            chunked = size is None
+            if chunked:
+                head.append("Transfer-Encoding: chunked")
+            else:
+                head.append(f"Content-Length: {size}")
             for k, v in extra.items():
                 head.append(f"{k}: {v}")
             if close:
@@ -503,7 +575,13 @@ class JsonHttpServer:
                         chunk = payload.read(1 << 20)
                         if not chunk:
                             break
-                        conn.sendall(chunk)
+                        if chunked:
+                            conn.sendall(b"%x\r\n" % len(chunk)
+                                         + chunk + b"\r\n")
+                        else:
+                            conn.sendall(chunk)
+                if chunked:
+                    conn.sendall(b"0\r\n\r\n")
             return
 
         if isinstance(payload, (bytes, bytearray)):
@@ -574,6 +652,14 @@ class _Conn:
         self.gen = gen
 
     def close(self) -> None:
+        # Shut the socket down FIRST: a reader blocked in recv() on
+        # another thread holds the buffered-reader lock, and rf.close()
+        # would wait for it (tens of seconds on an idle push stream);
+        # shutdown() forces that recv to return immediately.
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.rf.close()
         except OSError:
@@ -638,6 +724,25 @@ class _Resp:
                 f"incomplete read: peer closed with {self._remaining} "
                 f"of {self.headers.get('content-length')} bytes unread")
         return data
+
+    def read_any(self) -> bytes:
+        """Next available piece — for live push streams, where read(n)
+        would block accumulating n bytes that may never come.  Returns
+        one chunked frame (or buffered leftover), b"" at end."""
+        if self._done:
+            return b""
+        if self._chunks:
+            if self._chunk_iter is None:
+                self._chunk_iter = _iter_chunks(self._rf)
+            if self._chunk_buf:
+                out, self._chunk_buf = self._chunk_buf, b""
+                return out
+            try:
+                return next(self._chunk_iter)
+            except StopIteration:
+                self._done = True
+                return b""
+        return self.read(65536)
 
     def _read_chunked_n(self, n: int) -> bytes:
         """Incremental chunked-body reader honoring the requested size
@@ -841,6 +946,58 @@ def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:
     os.replace(tmp, path)
     _finish(conn, resp)
     return total
+
+
+class StreamHandle:
+    """A live NDJSON push stream (EventStream consumer side): iterate
+    `.events()` for parsed docs; `.close()` tears the connection down
+    IMMEDIATELY from any thread (urllib's close would block draining
+    the endless body).  An optional stop_event makes shutdown
+    deterministic even if close() races the handle's creation: the
+    server's ≤heartbeat-interval blank lines wake the reader, which
+    checks the event on every wakeup — not just on data."""
+
+    def __init__(self, resp, conn, stop_event=None):
+        self._resp = resp
+        self._conn = conn
+        self._stop = stop_event
+        self._closed = False
+
+    def close(self) -> None:
+        self._closed = True
+        self._conn.close()
+
+    def _should_stop(self) -> bool:
+        return self._closed or (self._stop is not None
+                                and self._stop.is_set())
+
+    def events(self):
+        buf = b""
+        try:
+            while not self._should_stop():
+                chunk = self._resp.read_any()
+                if not chunk or self._should_stop():
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        yield json.loads(line)
+        except (OSError, ConnectionError, ValueError):
+            return  # closed mid-read (including via close())
+        finally:
+            self._conn.close()
+
+
+def call_stream(url: str, timeout: float = 60.0,
+                stop_event=None) -> StreamHandle:
+    """Open a long-lived push stream (EventStream server side)."""
+    resp, conn = _request(url, "GET", None, timeout)
+    if resp.status >= 400:
+        data = resp.read()
+        conn.close()
+        _raise_rpc_error(resp, data)
+    return StreamHandle(resp, conn, stop_event)
 
 
 def call_json(url: str, method: str = "POST", payload: dict | None = None,

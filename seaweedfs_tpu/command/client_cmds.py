@@ -71,24 +71,29 @@ def run_shell(flags: Flags, args: list[str]) -> int:
 
 
 def run_watch(flags: Flags, args: list[str]) -> int:
-    """Tail filer metadata events (command/watch.go): poll
-    /.meta/subscribe from `now` and print each event as JSON."""
+    """Tail filer metadata events (command/watch.go) over the filer's
+    long-lived push stream — events print the moment they commit; the
+    connection redials on filer restarts."""
+    from ..filer.client import FilerProxy
     filer = flags.get("filer", "127.0.0.1:8888")
     filer = filer if filer.startswith("http") else f"http://{filer}"
     prefix = flags.get("pathPrefix", "/")
+    proxy = FilerProxy(filer)
     since_ns = int(time.time() * 1e9)
     while True:
-        url = f"{filer}/.meta/subscribe?since_ns={since_ns}"
-        with urllib.request.urlopen(url) as resp:
-            events = json.loads(resp.read()).get("events", [])
-        for ev in events:
-            since_ns = max(since_ns, ev.get("ts_ns", since_ns) + 1)
-            path = ev.get("directory", "") + "/" + (
-                (ev.get("new_entry") or ev.get("old_entry") or {})
-                .get("name", ""))
-            if path.startswith(prefix):
+        try:
+            _handle, events = proxy.meta_stream(since_ns=since_ns,
+                                                prefix=prefix)
+            for ev in events:
+                since_ns = max(since_ns, ev.get("ts_ns", since_ns))
+                if ev.get("_cursor_only"):
+                    continue
                 print(json.dumps(ev))
-        sys.stdout.flush()
+                sys.stdout.flush()
+        except KeyboardInterrupt:
+            return 130
+        except Exception:  # noqa: BLE001 — filer down; redial
+            pass
         time.sleep(flags.get_float("interval", 1.0))
 
 

@@ -126,6 +126,23 @@ class FilerProxy:
         assert isinstance(out, dict)
         return out
 
+    def meta_stream(self, since_ns: int = 0, exclude_signature: int = 0,
+                    prefix: str = "", stop_event=None):
+        """Long-lived push tail (?tail=true NDJSON stream): yields event
+        dicts the moment they commit on the filer — the
+        SubscribeMetadata gRPC stream analog; no polling.  Returns
+        (handle, generator): handle.close() stops tailing immediately
+        from any thread, and a stop_event ends the generator on its
+        next heartbeat wakeup."""
+        q = f"?tail=true&since_ns={since_ns}"
+        if exclude_signature:
+            q += f"&exclude_signature={exclude_signature}"
+        if prefix:
+            q += f"&prefix={urllib.parse.quote(prefix, safe='')}"
+        handle = rpc.call_stream(self.url + "/.meta/subscribe" + q,
+                                 stop_event=stop_event)
+        return handle, handle.events()
+
     def kv_get(self, key: str) -> bytes | None:
         req = urllib.request.Request(self.url + "/.kv/" +
                                      urllib.parse.quote(key, safe=""))

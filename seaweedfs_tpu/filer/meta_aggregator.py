@@ -5,10 +5,12 @@ deployment every filer subscribes to each peer's *local* meta log and
 merges the per-peer streams into one aggregated feed, so any single
 filer can serve a cluster-wide SubscribeMetadata.
 
-Here each peer is tailed by a poll thread against the peer's
-``/.meta/subscribe`` endpoint (our SubscribeLocalMetadata), with
-per-peer resume offsets; merged events are delivered to local
-subscribers tagged with the originating peer URL.
+Each peer is tailed over the filer's LONG-LIVED PUSH STREAM
+(``/.meta/subscribe?tail=true`` — the SubscribeLocalMetadata gRPC
+stream analog): events arrive the moment they commit on the peer, no
+polling; `reconnect_interval` only paces redials after a peer drops.
+Per-peer resume offsets survive reconnects; merged events are
+delivered to local subscribers tagged with the originating peer URL.
 """
 
 from __future__ import annotations
@@ -21,16 +23,21 @@ from .filer import MetaEvent
 
 
 class MetaAggregator:
-    def __init__(self, peers: list[str], poll_interval: float = 0.2,
-                 self_signature: int = 0):
+    def __init__(self, peers: list[str], reconnect_interval: float = 1.0,
+                 self_signature: int = 0,
+                 poll_interval: float | None = None):
         self.peers = [p.rstrip("/") for p in peers]
-        self.poll_interval = poll_interval
+        # poll_interval kept as a deprecated alias (pre-push-stream
+        # callers tuned it); it now paces reconnects only.
+        self.reconnect_interval = poll_interval \
+            if poll_interval is not None else reconnect_interval
         self.self_signature = self_signature
         self._offsets: dict[str, int] = {p: 0 for p in self.peers}
         self._subscribers: list[Callable[[str, MetaEvent], None]] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._streams: dict[str, object] = {}
 
     def subscribe(self, fn: Callable[[str, MetaEvent], None]) -> None:
         """fn(peer_url, event) on every aggregated mutation."""
@@ -50,11 +57,17 @@ class MetaAggregator:
         proxy = FilerProxy(peer)
         while not self._stop.is_set():
             try:
-                out = proxy.meta_events(
+                resp, events = proxy.meta_stream(
                     since_ns=self._offsets[peer],
-                    exclude_signature=self.self_signature)
-                events = out.get("events", [])
+                    exclude_signature=self.self_signature,
+                    stop_event=self._stop)
+                self._streams[peer] = resp
                 for d in events:
+                    if self._stop.is_set():
+                        break
+                    if d.get("_cursor_only"):
+                        self._offsets[peer] = d["ts_ns"]
+                        continue
                     ev = MetaEvent.from_dict(d)
                     with self._lock:
                         subs = list(self._subscribers)
@@ -63,11 +76,12 @@ class MetaAggregator:
                             fn(peer, ev)
                         except Exception:  # noqa: BLE001 — a bad
                             pass           # subscriber can't stall peers
-                self._offsets[peer] = out.get(
-                    "last_ns", self._offsets[peer])
-            except Exception:  # noqa: BLE001 — peer down; retry
+                    self._offsets[peer] = ev.ts_ns
+            except Exception:  # noqa: BLE001 — peer down; redial
                 pass
-            self._stop.wait(self.poll_interval)
+            finally:
+                self._streams.pop(peer, None)
+            self._stop.wait(self.reconnect_interval)
 
     def drain(self, timeout: float = 5.0) -> None:
         """Testing aid: wait until every peer tail is caught up to the
@@ -83,5 +97,12 @@ class MetaAggregator:
 
     def stop(self) -> None:
         self._stop.set()
+        # Closing the live responses unblocks threads waiting on the
+        # wire immediately (heartbeats alone would take seconds).
+        for resp in list(self._streams.values()):
+            try:
+                resp.close()
+            except Exception:  # noqa: BLE001
+                pass
         for t in self._threads:
             t.join(timeout=2)

@@ -27,6 +27,77 @@ from .filerstore import NotFound, store_for_path
 from .stream import ChunkedWriter, ChunkStreamer
 
 
+class _MetaTail:
+    """Streaming response body for ?tail=true: PAGED journal replay
+    (bounded memory, no log lock held while paging), then a gap-free
+    switch to live push under the log lock, then the live queue.
+
+    The poll endpoint this supersedes was bounded at 10k events per
+    request; this keeps the same bound per read() while serving the
+    whole history + live tail on one connection
+    (filer_grpc_server_sub_meta.go: replay from disk, then tail the
+    in-memory log buffer)."""
+
+    _PAGE = 1000
+
+    def __init__(self, filer, since_ns: int, excl: int, prefix: str):
+        self._filer = filer
+        self._cursor = since_ns
+        self._excl = excl
+        self._prefix = prefix
+        self._live = rpc.EventStream()
+        self._attached = False
+        self._unsubscribe = None
+
+    def _serialize(self, ev) -> bytes:
+        if (self._excl and self._excl in ev.signatures) or \
+                (self._prefix and not (ev.directory + "/").startswith(
+                    self._prefix.rstrip("/") + "/")):
+            # Filtered out — still advance the client's resume cursor,
+            # or a tail full of excluded events would pin it forever.
+            return json.dumps({"ts_ns": ev.ts_ns,
+                               "_cursor_only": True}).encode() + b"\n"
+        d = ev.to_dict()
+        d["_signature"] = self._filer.signature
+        return json.dumps(d).encode() + b"\n"
+
+    def read(self, n: int = -1) -> bytes:
+        if not self._attached:
+            page = self._filer.read_meta_events(self._cursor, self._PAGE)
+            if len(page) >= self._PAGE:
+                self._cursor = page[-1].ts_ns
+                return b"".join(self._serialize(ev) for ev in page)
+            # Nearly caught up: replay the small remainder and attach
+            # the live subscriber atomically under the log lock so no
+            # event falls between replay and tail.
+            with self._filer._log_lock:
+                gap = self._filer.read_meta_events(self._cursor,
+                                                   10 ** 9)
+                self._filer._subscribers.append(self._live_cb)
+            self._attached = True
+            self._unsubscribe = lambda: self._detach()
+            if gap:
+                self._cursor = gap[-1].ts_ns
+                return b"".join(self._serialize(ev) for ev in gap)
+        return self._live.read()
+
+    def _live_cb(self, ev) -> None:
+        self._live.push_raw(self._serialize(ev))
+
+    def _detach(self) -> None:
+        with self._filer._log_lock:
+            if self._live_cb in self._filer._subscribers:
+                self._filer._subscribers.remove(self._live_cb)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+        return False
+
+
 class FilerServer:
     def __init__(self, master_url: str | list[str],
                  host: str = "127.0.0.1",
@@ -86,8 +157,16 @@ class FilerServer:
         self.server.start()
         if self.metrics_server is not None:
             self.metrics_server.start()
+        # Live volume-location push from the master (KeepConnected):
+        # stale vid-map entries drop as heartbeats land.
+        try:
+            self._loc_watch_stop = self.client.start_location_watch()
+        except Exception:  # noqa: BLE001 — degrade to TTL cache
+            self._loc_watch_stop = None
 
     def stop(self) -> None:
+        if getattr(self, "_loc_watch_stop", None):
+            self._loc_watch_stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
         self.server.stop()
@@ -325,12 +404,20 @@ class FilerServer:
 
     # -- meta subscription ---------------------------------------------------
 
-    def _meta_subscribe(self, query: dict, body: bytes) -> dict:
-        """Poll-based metadata tail: events newer than since_ns, replayed
-        from the persistent journal (SubscribeMetadata; clients poll to
-        tail).  ?exclude_signature=N drops events already carrying that
+    def _meta_subscribe(self, query: dict, body: bytes):
+        """Metadata tail (SubscribeMetadata): events newer than
+        since_ns, replayed from the persistent journal.
+        ?exclude_signature=N drops events already carrying that
         signature — the filer.sync loop-breaker; ?prefix=/p filters by
-        directory prefix (SubscribeMetadata PathPrefix)."""
+        directory prefix (SubscribeMetadata PathPrefix).
+
+        Default is one poll page; ?tail=true upgrades to a LONG-LIVED
+        PUSH STREAM (NDJSON over chunked transfer-encoding): replay,
+        then every new mutation is pushed the moment it commits — the
+        reference's replay-then-tail gRPC stream
+        (filer_grpc_server_sub_meta.go), no polling."""
+        if query.get("tail") == "true":
+            return self._meta_subscribe_stream(query)
         since = int(query.get("since_ns", 0))
         limit = int(query.get("limit", 10000))
         excl = int(query.get("exclude_signature", 0))
@@ -354,6 +441,13 @@ class FilerServer:
         last = raw[-1].ts_ns if raw else max(since, head)
         return {"events": events, "last_ns": last,
                 "signature": self.filer.signature}
+
+    def _meta_subscribe_stream(self, query: dict):
+        since = int(query.get("since_ns", 0))
+        excl = int(query.get("exclude_signature", 0))
+        prefix = query.get("prefix", "")
+        return (200, _MetaTail(self.filer, since, excl, prefix),
+                {"Content-Type": "application/x-ndjson"})
 
     def _meta_info(self, query: dict, body: bytes) -> dict:
         return {"signature": self.filer.signature,

@@ -55,6 +55,29 @@ def test_cron_records_failures_and_continues(cluster):
     assert by_line["volume.list"] is True  # later scripts still ran
 
 
+def test_cron_aborts_round_when_lock_held(cluster):
+    """An operator holding the exclusive lease must stop the whole
+    round — running maintenance concurrently with their session is the
+    race the lock exists to prevent (review finding)."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    master, _vs = cluster
+    operator = CommandEnv(master.url())
+    run_command(operator, "lock")
+    try:
+        master.admin_scripts = ["volume.list"]
+        runs = master.run_admin_scripts()
+        lines = [line for _ts, line, _ok, _out in runs]
+        assert lines == ["lock"]  # aborted before any script
+        assert runs[0][2] is False
+    finally:
+        run_command(operator, "unlock")
+        operator.close()
+    # With the lease released the next round goes through.
+    runs = master.run_admin_scripts()
+    assert [line for _ts, line, ok, _out in runs if ok][:2] == \
+        ["lock", "volume.list"]
+
+
 def test_cron_thread_fires_on_interval(tmp_path):
     master = MasterServer(
         volume_size_limit_mb=64, meta_dir=str(tmp_path / "m2"),
