@@ -271,3 +271,55 @@ def test_cross_backend_shard_files_identical(ec_base, tmp_path):
     for i in range(TOTAL_SHARDS):
         assert open(work + to_ext(i), "rb").read() == \
             open(base + to_ext(i), "rb").read(), f"shard {i}"
+
+
+# -- golden byte-compatibility gate ------------------------------------------
+
+REF_EC = "/root/reference/weed/storage/erasure_coding"
+GOLDEN = os.path.join(os.path.dirname(__file__), "fixtures", "golden_ec")
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(REF_EC, "1.dat")),
+                    reason="reference fixture not present")
+def test_golden_manifest(tmp_path):
+    """Regenerate .ec00-.ec13/.ecx from the reference's committed 1.dat
+    at the reference test's block sizes and assert byte-for-byte
+    equality with the pinned manifest — freezing the matrix
+    construction, GF tables, stripe layout and .ecx sort (see
+    fixtures/golden_ec/README.md for validating the same hashes
+    against the Go reference)."""
+    import hashlib
+    import shutil
+    shutil.copy(os.path.join(REF_EC, "1.dat"), tmp_path / "1.dat")
+    shutil.copy(os.path.join(REF_EC, "1.idx"), tmp_path / "1.idx")
+    write_ec_files(str(tmp_path / "1"), large_block_size=LARGE,
+                   small_block_size=SMALL)
+    write_sorted_file_from_idx(str(tmp_path / "1"))
+    want = {}
+    with open(os.path.join(GOLDEN, "MANIFEST.sha256")) as f:
+        for line in f:
+            digest, size, name = line.split()
+            want[name] = (digest, int(size))
+    assert len(want) == 15
+    for name, (digest, size) in want.items():
+        blob = (tmp_path / name).read_bytes()
+        assert len(blob) == size, f"{name}: size {len(blob)} != {size}"
+        got = hashlib.sha256(blob).hexdigest()
+        assert got == digest, f"{name}: bytes drifted ({got[:16]}...)"
+
+
+def test_parity_matrix_pinned_constants():
+    """The RS(10,4) systematic matrix (klauspost buildMatrix: extended
+    Vandermonde x inverse of its top square) — the full 4x10 parity
+    coefficient block is frozen to the values this construction
+    produced at pin time, so any drift in the GF tables or the matrix
+    algebra fails loudly, independent of the file pipeline."""
+    from seaweedfs_tpu.ops.gf256 import build_systematic_matrix
+    m = build_systematic_matrix(10, 14)
+    assert np.array_equal(m[:10], np.eye(10, dtype=np.uint8))
+    assert m[10:].tolist() == [
+        [129, 150, 175, 184, 210, 196, 254, 232, 3, 2],
+        [150, 129, 184, 175, 196, 210, 232, 254, 2, 3],
+        [191, 214, 98, 10, 6, 111, 223, 183, 5, 4],
+        [214, 191, 10, 98, 111, 6, 183, 223, 4, 5],
+    ]
