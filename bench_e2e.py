@@ -73,6 +73,47 @@ def generate_volume(dir_: str, vid: int, size_mb: int) -> str:
     return base
 
 
+def _stage_breakdown(base: str, coder, chunk_mb: int) -> None:
+    """Per-stage MB/s of the encode pipeline (SURVEY §2.3): isolates
+    pread, the device round trip (host→device + kernel + device→host),
+    and shard writes, so the e2e number is attributable.  The pipeline
+    overlaps these stages, so e2e ≈ the slowest stage, not the sum.
+
+    Runs AFTER the timed e2e pass so its warm-up can't subsidize the
+    recorded number (the e2e measurement pays JIT compilation exactly
+    as earlier rounds did)."""
+    import numpy as np
+    chunk = chunk_mb * 1024 * 1024
+    fd = os.open(base + ".dat", os.O_RDONLY)
+    try:
+        t0 = time.perf_counter()
+        data = np.zeros((10, chunk), np.uint8)
+        for i in range(10):
+            raw = os.pread(fd, chunk, i * chunk)
+            data[i, :len(raw)] = np.frombuffer(raw, np.uint8)
+        t_read = time.perf_counter() - t0
+    finally:
+        os.close(fd)
+    np.asarray(coder.encode(data))  # warm this exact shape
+    t0 = time.perf_counter()
+    parity = np.asarray(coder.encode(data))
+    t_dev = time.perf_counter() - t0
+    with tempfile.TemporaryFile() as tf:
+        t0 = time.perf_counter()
+        for i in range(10):
+            tf.write(data[i].tobytes())
+        for p in range(parity.shape[0]):
+            tf.write(parity[p].tobytes())
+        tf.flush()
+        t_write = time.perf_counter() - t0
+    n = data.nbytes
+    log(f"  stages per {n >> 20}MB-stripe chunk: "
+        f"pread {n / t_read / 1e6:.0f} MB/s, "
+        f"device round-trip {n / t_dev / 1e6:.0f} MB/s, "
+        f"shard writes {n / t_write / 1e6:.0f} MB/s "
+        f"(pipeline overlaps all three)")
+
+
 def bench_ec_encode(base: str, backend: str, chunk_mb: int = 8) -> float:
     """Time write_ec_files + .ecx generation; returns dat MB/s."""
     from seaweedfs_tpu.ec.encoder import (write_ec_files,
@@ -92,6 +133,10 @@ def bench_ec_encode(base: str, backend: str, chunk_mb: int = 8) -> float:
     mbps = dat_size / dt / 1e6
     log(f"ec.encode[{backend}]: {dat_size / 1e6:.0f} MB in {dt:.2f}s "
         f"= {mbps:.1f} MB/s")
+    try:
+        _stage_breakdown(base, coder, chunk_mb)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not kill
+        log(f"  stage breakdown failed: {type(e).__name__}: {e}")
     return mbps
 
 

@@ -323,3 +323,44 @@ def test_parity_matrix_pinned_constants():
         [191, 214, 98, 10, 6, 111, 223, 183, 5, 4],
         [214, 191, 10, 98, 111, 6, 183, 223, 4, 5],
     ]
+
+
+def test_pipelined_encode_failure_propagates_promptly(tmp_path):
+    """A coder failure mid-stream must raise out of write_ec_files —
+    not deadlock the read-ahead thread on the full queue (review
+    finding, reproduced as a hang before the fix)."""
+    import threading
+    import time as _t
+
+    from seaweedfs_tpu.ops.coder_numpy import NumpyCoder
+
+    blob = os.urandom(LARGE * DATA_SHARDS * 3)
+    with open(tmp_path / "v.dat", "wb") as f:
+        f.write(blob)
+
+    class ExplodingCoder(NumpyCoder):
+        calls = 0
+
+        def encode(self, data):
+            type(self).calls += 1
+            if type(self).calls >= 2:
+                raise RuntimeError("device fell over")
+            return super().encode(data)
+
+    result: list = []
+
+    def run():
+        try:
+            write_ec_files(str(tmp_path / "v"),
+                           coder=ExplodingCoder(10, 4),
+                           large_block_size=LARGE, small_block_size=SMALL,
+                           chunk_size=LARGE)
+            result.append("no-error")
+        except RuntimeError as e:
+            result.append(str(e))
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    th.join(timeout=15)
+    assert not th.is_alive(), "write_ec_files deadlocked on coder failure"
+    assert result == ["device fell over"]
