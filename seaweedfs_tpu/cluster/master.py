@@ -109,6 +109,9 @@ class MasterServer:
         s.route("GET", "/dir/lookup", self._lookup)
         s.route("GET", "/dir/status", self._status)
         s.route("GET", "/cluster/watch", self._cluster_watch)
+        s.route("GET", "/ui", self._ui)
+        from ..utils.pprof import enable_pprof_routes
+        enable_pprof_routes(s)
         s.route("POST", "/vol/grow", self._grow)
         s.route("POST", "/vol/vacuum", self._vacuum)
         s.route("GET", "/col/list", self._col_list)
@@ -349,6 +352,51 @@ class MasterServer:
                 "new_vids": sorted(after - before),
                 "deleted_vids": sorted(before - after)})
         return {"volume_size_limit": self.topo.volume_size_limit}
+
+    def _ui(self, query: dict, body: bytes):
+        """Status page (the reference's master UI, server/master_ui):
+        leader, topology tree with per-node volume counts, admin-cron
+        history."""
+        from html import escape as esc
+        rows = []
+        with self.topo._lock:
+            for dc in list(self.topo.children.values()):
+                for rack in list(dc.children.values()):
+                    for dn in list(rack.children.values()):
+                        # Everything heartbeat- or client-supplied is
+                        # escaped: a hostile collection/rack name must
+                        # not script the operator's browser.
+                        rows.append(
+                            f"<tr><td>{esc(str(dc.id))}</td>"
+                            f"<td>{esc(str(rack.id))}</td>"
+                            f"<td>{esc(dn.url())}</td>"
+                            f"<td>{len(dn.volumes)}</td>"
+                            f"<td>{dn.max_volume_count}</td>"
+                            f"<td>{len(dn.ec_shards)}</td></tr>")
+        cron = "".join(
+            f"<tr><td>{time.strftime('%H:%M:%S', time.localtime(ts))}"
+            f"</td><td><code>{esc(line)}</code></td>"
+            f"<td>{'ok' if ok else 'FAIL'}</td></tr>"
+            for ts, line, ok, _out in self.admin_script_runs[-20:])
+        html = (
+            "<!doctype html><title>seaweedfs-tpu master</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+            "padding:4px 8px}</style>"
+            f"<h1>Master {self.url()}</h1>"
+            f"<p>leader: {self.is_leader()} &middot; "
+            f"max volume id: {self.topo.max_volume_id} &middot; "
+            f"volume size limit: "
+            f"{self.topo.volume_size_limit >> 20}MB</p>"
+            "<h2>Topology</h2><table><tr><th>DC</th><th>Rack</th>"
+            "<th>Node</th><th>Volumes</th><th>Max</th>"
+            "<th>EC shard groups</th></tr>" + "".join(rows) + "</table>"
+            + ("<h2>Admin cron (last 20)</h2><table><tr><th>at</th>"
+               "<th>command</th><th>result</th></tr>" + cron + "</table>"
+               if cron else "")
+            + "<p><a href='/dir/status'>JSON status</a></p>")
+        return (200, html.encode(),
+                {"Content-Type": "text/html; charset=utf-8"})
 
     # -- location push (KeepConnected analog) --------------------------------
 

@@ -84,6 +84,9 @@ class VolumeServer:
         s = self.server
         s.route("GET", "/admin/status", self._admin_status)
         s.route("POST", "/admin/status", self._admin_status)
+        s.route("GET", "/ui", self._ui)
+        from ..utils.pprof import enable_pprof_routes
+        enable_pprof_routes(s)
         s.route("POST", "/admin/assign_volume", self._admin_assign_volume)
         s.route("POST", "/admin/delete_volume", self._admin_delete_volume)
         s.route("POST", "/admin/readonly", self._admin_readonly)
@@ -488,6 +491,41 @@ class VolumeServer:
             except Exception:  # noqa: BLE001 — try next holder
                 continue
         return None
+
+    def _ui(self, query: dict, body: bytes):
+        """Status page (the reference's volume UI, server/volume_ui)."""
+        from html import escape as esc
+        rows = []
+        for loc in self.store.locations:
+            for v in list(loc.volumes.values()):
+                rows.append(
+                    f"<tr><td>{v.vid}</td>"
+                    f"<td>{esc(v.collection) or '-'}</td>"
+                    f"<td>{v.content_size() / 1e6:.1f}MB</td>"
+                    f"<td>{v.file_count()}</td>"
+                    f"<td>{'ro' if v.readonly else 'rw'}</td></tr>")
+        ec_rows = "".join(
+            f"<tr><td>{vid}</td><td>{sorted(ev.shards)}</td></tr>"
+            for vid, ev in sorted(self.ec_volumes.items()))
+        html = (
+            "<!doctype html><title>seaweedfs-tpu volume</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+            "padding:4px 8px}</style>"
+            f"<h1>Volume server {self.url()}</h1>"
+            f"<p>master: {esc(self.master_url)} &middot; "
+            f"rack: {esc(self.rack)} &middot; "
+            f"dc: {esc(self.data_center)}</p>"
+            "<h2>Volumes</h2><table><tr><th>id</th><th>collection</th>"
+            "<th>size</th><th>files</th><th>mode</th></tr>"
+            + "".join(rows) + "</table>"
+            + ("<h2>EC volumes</h2><table><tr><th>id</th>"
+               "<th>local shards</th></tr>" + ec_rows + "</table>"
+               if ec_rows else "")
+            + "<p><a href='/admin/status'>JSON status</a> &middot; "
+              "<a href='/metrics'>metrics</a></p>")
+        return (200, html.encode(),
+                {"Content-Type": "text/html; charset=utf-8"})
 
     def _check_write_jwt(self, path: str, query: dict) -> None:
         """JWT gate on the write path (volume_server_handlers.go

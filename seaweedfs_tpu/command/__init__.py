@@ -116,6 +116,12 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     flags, rest = parse_flags(args)
     glog.setup(verbosity=flags.get_int("v", 0))
+    # -cpuprofile/-memprofile on any subcommand (grace.SetupProfiling):
+    # begin profiling now, dump at process exit.
+    if flags.get("cpuprofile") or flags.get("memprofile"):
+        from ..utils.pprof import setup_profiling
+        setup_profiling(flags.get("cpuprofile", ""),
+                        flags.get("memprofile", ""))
     # Every cluster-dialing command — servers AND clients (upload,
     # shell, mount, …) — goes through the TLS plane when security.toml
     # configures [grpc.client], matching the reference where each

@@ -124,6 +124,9 @@ class FilerServer:
         s = self.server
         s.route("GET", "/.meta/subscribe", self._meta_subscribe)
         s.route("GET", "/.meta/info", self._meta_info)
+        s.route("GET", "/.ui", self._ui)
+        from ..utils.pprof import enable_pprof_routes
+        enable_pprof_routes(s)
         # Master proxies: mounts and other filer-only clients assign
         # file ids and resolve volumes through the filer (the filer
         # gRPC AssignVolume/LookupVolume surface, filer.proto:30-33).
@@ -448,6 +451,23 @@ class FilerServer:
         prefix = query.get("prefix", "")
         return (200, _MetaTail(self.filer, since, excl, prefix),
                 {"Content-Type": "application/x-ndjson"})
+
+    def _ui(self, query: dict, body: bytes):
+        """Status page (the reference's filer UI).  Lives at /.ui since
+        / is the user namespace."""
+        html = (
+            "<!doctype html><title>seaweedfs-tpu filer</title>"
+            "<style>body{font-family:sans-serif;margin:2em}</style>"
+            f"<h1>Filer {self.url()}</h1>"
+            f"<p>master: {__import__('html').escape(self.master_url)}"
+            " &middot; "
+            f"store: {type(self.filer.store).__name__} &middot; "
+            f"signature: {self.filer.signature} &middot; "
+            f"meta log head: {self.filer.meta_log.last_ts_ns()}</p>"
+            "<p><a href='/?limit=100'>browse /</a> &middot; "
+            "<a href='/.meta/info'>meta info</a></p>")
+        return (200, html.encode(),
+                {"Content-Type": "text/html; charset=utf-8"})
 
     def _meta_info(self, query: dict, body: bytes) -> dict:
         return {"signature": self.filer.signature,

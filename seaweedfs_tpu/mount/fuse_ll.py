@@ -145,6 +145,21 @@ class c_fuse_operations(ctypes.Structure):
     ]
 
 
+def _restore_sigpipe_ignore() -> None:
+    """libfuse's signal teardown (fuse_remove_signal_handlers) restores
+    SIG_DFL for SIGPIPE at the C level while Python's bookkeeping still
+    says "ignored" — any later write to a closed socket ANYWHERE in the
+    process would then be a silent SIGKILL-style death instead of
+    BrokenPipeError.  Re-assert SIG_IGN via the C library (the Python
+    signal module only works from the main thread; fuse_main usually
+    runs on a mount thread)."""
+    try:
+        libc = ctypes.CDLL(None)
+        libc.signal(13, ctypes.c_void_p(1))  # signal(SIGPIPE, SIG_IGN)
+    except Exception:  # noqa: BLE001 — best effort
+        pass
+
+
 def _errno_of(e: Exception) -> int:
     if isinstance(e, FuseError):
         return -e.errno
@@ -393,6 +408,7 @@ class FuseMount:
                 raise RuntimeError(f"fuse_main failed: {err}")
         finally:
             self.wfs.stop()
+            _restore_sigpipe_ignore()
 
     def mount_background(self, ready_timeout: float = 10.0) -> None:
         """Mount on a daemon thread; returns once the kernel mount is

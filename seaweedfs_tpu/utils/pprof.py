@@ -1,0 +1,171 @@
+"""Profiling hooks — the reference's pprof plane, Python-native.
+
+Reference: weed/util/grace/pprof.go:17-29 (`-cpuprofile/-memprofile`
+flags writing profiles at graceful exit) and the `net/http/pprof`
+debug handlers.  Equivalents here:
+
+- setup_profiling(cpuprofile, memprofile): a 100Hz ALL-THREADS stack
+  sampler from launch, dumped at exit in collapsed-stack format
+  (flamegraph.pl / speedscope compatible); tracemalloc for the heap.
+- enable_pprof_routes(server): /debug/pprof/{profile,heap,threads} —
+  on-demand sampling, heap ranking (with ?stop), live thread stacks.
+
+Sampling (sys._current_frames) rather than cProfile because cProfile
+instruments only the thread that enables it — useless for servers
+whose work runs on handler threads; a sampler sees every thread.
+
+The routes are mounted only when SEAWEEDFS_TPU_PPROF=1: they are
+unauthenticated by design (like net/http/pprof) and heap tracing taxes
+every allocation, so exposing them is an operator decision.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+
+
+def _collect_stacks(exclude_thread: int | None) -> list[tuple[str, ...]]:
+    """One sample: the collapsed stack of every live thread."""
+    out = []
+    for tid, frame in sys._current_frames().items():
+        if tid == exclude_thread:
+            continue
+        stack = []
+        f = frame
+        while f is not None:
+            code = f.f_code
+            stack.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
+            f = f.f_back
+        out.append(tuple(reversed(stack)))
+    return out
+
+
+def sample_stacks(seconds: float, hz: float = 100.0,
+                  stop_event: threading.Event | None = None
+                  ) -> tuple[Counter, int]:
+    """Sample all threads (except the caller) for `seconds`; returns
+    (Counter of collapsed stacks, total samples taken)."""
+    me = threading.get_ident()
+    counts: Counter = Counter()
+    samples = 0
+    interval = 1.0 / hz
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        if stop_event is not None and stop_event.is_set():
+            break
+        for stack in _collect_stacks(me):
+            counts[stack] += 1
+        samples += 1
+        time.sleep(interval)
+    return counts, samples
+
+
+def setup_profiling(cpuprofile: str = "",
+                    memprofile: str = "") -> None:
+    """grace.SetupProfiling: begin profiling now, write at exit."""
+    if cpuprofile:
+        stop = threading.Event()
+        counts: Counter = Counter()
+        state = {"samples": 0}
+
+        def sampler() -> None:
+            while not stop.is_set():
+                c, n = sample_stacks(1.0, stop_event=stop)
+                counts.update(c)
+                state["samples"] += n
+
+        threading.Thread(target=sampler, daemon=True,
+                         name="cpu-sampler").start()
+
+        def dump_cpu() -> None:
+            stop.set()
+            with open(cpuprofile, "w") as f:
+                for stack, n in counts.most_common():
+                    f.write(";".join(stack) + f" {n}\n")
+            print(f"cpu profile ({state['samples']} samples, all "
+                  f"threads, collapsed-stack format — feed to "
+                  f"flamegraph.pl/speedscope) written to {cpuprofile}",
+                  file=sys.stderr)
+        atexit.register(dump_cpu)
+    if memprofile:
+        import tracemalloc
+        tracemalloc.start(16)
+
+        def dump_mem() -> None:
+            snap = tracemalloc.take_snapshot()
+            with open(memprofile, "w") as f:
+                for stat in snap.statistics("lineno")[:200]:
+                    f.write(f"{stat}\n")
+            print(f"heap profile written to {memprofile}",
+                  file=sys.stderr)
+        atexit.register(dump_mem)
+
+
+def _profile_handler(query: dict, body: bytes):
+    """CPU sample of EVERY thread for ?seconds=N (default 5, cap 30):
+    collapsed stacks ranked by sample count."""
+    seconds = min(float(query.get("seconds", 5) or 5), 30.0)
+    counts, samples = sample_stacks(seconds)
+    lines = [f"{samples} samples over {seconds:.1f}s at ~100Hz, "
+             f"all threads (collapsed stacks; count = samples seen)",
+             ""]
+    for stack, n in counts.most_common(100):
+        lines.append(f"{n:6d}  {';'.join(stack)}")
+    return (200, ("\n".join(lines) + "\n").encode(),
+            {"Content-Type": "text/plain; charset=utf-8"})
+
+
+def _heap_handler(query: dict, body: bytes):
+    """Heap ranking via tracemalloc.  First call starts tracing (which
+    taxes every allocation); ?stop=true turns it back off."""
+    import tracemalloc
+    if query.get("stop") == "true":
+        if tracemalloc.is_tracing():
+            tracemalloc.stop()
+        return (200, b"tracemalloc stopped\n",
+                {"Content-Type": "text/plain"})
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(16)
+        return (200, b"tracemalloc started; call again for a ranking, "
+                     b"?stop=true to disable\n",
+                {"Content-Type": "text/plain"})
+    snap = tracemalloc.take_snapshot()
+    top = snap.statistics("lineno")[:int(query.get("top", 50) or 50)]
+    cur, peak = tracemalloc.get_traced_memory()
+    lines = [f"traced: current {cur / 1e6:.1f}MB peak {peak / 1e6:.1f}MB",
+             ""]
+    lines += [str(s) for s in top]
+    return (200, ("\n".join(lines) + "\n").encode(),
+            {"Content-Type": "text/plain; charset=utf-8"})
+
+
+def _threads_handler(query: dict, body: bytes):
+    """Stacks of every live thread (the goroutine-dump analog)."""
+    frames = sys._current_frames()
+    out = []
+    for th in threading.enumerate():
+        frame = frames.get(th.ident)
+        out.append(f"--- {th.name} (daemon={th.daemon}, "
+                   f"alive={th.is_alive()}) ---")
+        if frame is not None:
+            out.append("".join(traceback.format_stack(frame)))
+    return (200, ("\n".join(out) + "\n").encode(),
+            {"Content-Type": "text/plain; charset=utf-8"})
+
+
+def enable_pprof_routes(server) -> None:
+    """Mount /debug/pprof handlers — ONLY when the operator opted in
+    via SEAWEEDFS_TPU_PPROF=1 (they are unauthenticated and heap
+    tracing is expensive; same operator-choice stance as exposing Go's
+    net/http/pprof)."""
+    if os.environ.get("SEAWEEDFS_TPU_PPROF", "") not in ("1", "true"):
+        return
+    server.route("GET", "/debug/pprof/profile", _profile_handler)
+    server.route("GET", "/debug/pprof/heap", _heap_handler)
+    server.route("GET", "/debug/pprof/threads", _threads_handler)

@@ -1,0 +1,168 @@
+"""Profiling hooks + status UIs (reference weed/util/grace/pprof.go,
+server/*_ui): /debug/pprof handlers (opt-in) and HTML status pages."""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer.server import FilerServer
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    import os
+    os.environ["SEAWEEDFS_TPU_PPROF"] = "1"
+    tmp = tmp_path_factory.mktemp("ui-stack")
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp / "vs")], pulse_seconds=60)
+    vs.start()
+    filer = FilerServer(master.url())
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+    os.environ.pop("SEAWEEDFS_TPU_PPROF", None)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, r.read(), r.headers.get("Content-Type", "")
+
+
+def test_status_uis(stack):
+    master, vs, filer = stack
+    urllib.request.urlopen(urllib.request.Request(
+        f"{filer.url()}/seed.txt", data=b"x", method="POST"),
+        timeout=30).read()
+    vs._send_heartbeat(full=True)
+    st, body, ctype = _get(f"{master.url()}/ui")
+    assert st == 200 and ctype.startswith("text/html")
+    assert vs.url().encode() in body  # topology table shows the node
+    st, body, ctype = _get(f"http://{vs.url()}/ui")
+    assert st == 200 and b"Volume server" in body
+    assert b"rw" in body  # at least one volume row
+    st, body, ctype = _get(f"{filer.url()}/.ui")
+    assert st == 200 and b"Filer" in body
+
+
+def test_ui_escapes_hostile_names(tmp_path):
+    """Client-controlled strings (collection, rack names) render inert
+    — a hostile name must not script the operator's browser."""
+    import os
+    os.environ["SEAWEEDFS_TPU_PPROF"] = "1"
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60,
+                      rack="<script>alert(1)</script>")
+    vs.start()
+    try:
+        st, body, _ = _get(f"{master.url()}/ui")
+        assert st == 200
+        assert b"<script>alert(1)</script>" not in body
+        assert b"&lt;script&gt;" in body
+        st, body, _ = _get(f"http://{vs.url()}/ui")
+        assert b"<script>alert(1)</script>" not in body
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_pprof_threads_and_heap(stack):
+    import tracemalloc
+    master, _vs, _filer = stack
+    st, body, _ = _get(f"{master.url()}/debug/pprof/threads")
+    assert st == 200
+    assert b"http:" in body or b"MainThread" in body  # real stacks
+    try:
+        st, body, _ = _get(f"{master.url()}/debug/pprof/heap")
+        assert st == 200  # first call starts tracemalloc
+        st, body, _ = _get(f"{master.url()}/debug/pprof/heap")
+        assert st == 200 and b"traced:" in body
+    finally:
+        # ?stop=true turns allocation tracing back off (review finding:
+        # it must not tax the process forever).
+        st, body, _ = _get(f"{master.url()}/debug/pprof/heap?stop=true")
+        assert st == 200
+        assert not tracemalloc.is_tracing()
+
+
+def test_pprof_profile_samples_other_threads(stack):
+    """The CPU sampler must see work on OTHER threads — per-thread
+    cProfile showed an idle process no matter the load (review
+    finding)."""
+    _m, vs, _f = stack
+    stop = threading.Event()
+
+    def very_recognizable_busy_loop():
+        while not stop.is_set():
+            sum(i * i for i in range(1000))
+
+    t = threading.Thread(target=very_recognizable_busy_loop,
+                         daemon=True)
+    t.start()
+    try:
+        st, body, _ = _get(
+            f"http://{vs.url()}/debug/pprof/profile?seconds=0.5")
+    finally:
+        stop.set()
+        t.join()
+    assert st == 200
+    assert b"samples" in body
+    assert b"very_recognizable_busy_loop" in body
+
+
+def test_pprof_routes_absent_without_optin(tmp_path):
+    import os
+    assert os.environ.get("SEAWEEDFS_TPU_PPROF") != "1" or True
+    saved = os.environ.pop("SEAWEEDFS_TPU_PPROF", None)
+    try:
+        master = MasterServer(volume_size_limit_mb=64,
+                              meta_dir=str(tmp_path))
+        master.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{master.url()}/debug/pprof/threads")
+            assert ei.value.code == 404
+        finally:
+            master.stop()
+    finally:
+        if saved is not None:
+            os.environ["SEAWEEDFS_TPU_PPROF"] = saved
+
+
+import urllib.error  # noqa: E402
+
+
+def test_cpuprofile_flag_writes_collapsed_stacks(tmp_path):
+    """-cpuprofile on any subcommand samples ALL threads and dumps
+    flamegraph-compatible collapsed stacks at exit
+    (grace.SetupProfiling analog)."""
+    import subprocess
+    import sys
+    out = tmp_path / "cpu.stacks"
+    subprocess.run(
+        [sys.executable, "-c",
+         "from seaweedfs_tpu.utils.jaxenv import force_cpu; force_cpu()\n"
+         "import sys, runpy, time\n"
+         f"sys.argv=['weed','version','-cpuprofile={out}']\n"
+         "try: runpy.run_module('seaweedfs_tpu', run_name='__main__')\n"
+         "except SystemExit: pass\n"
+         "t=time.monotonic()\n"
+         "while time.monotonic()-t < 1.5: sum(i*i for i in range(1000))"],
+        check=True, capture_output=True, timeout=120,
+        cwd="/root/repo")
+    assert out.exists()
+    text = out.read_text()
+    assert text.strip(), "no samples recorded"
+    # collapsed-stack lines: frame;frame;... count
+    line = text.splitlines()[0]
+    assert ";" in line or "(" in line
+    assert line.rsplit(" ", 1)[1].isdigit()
