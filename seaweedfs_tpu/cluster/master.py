@@ -109,6 +109,12 @@ class MasterServer:
         s.route("GET", "/dir/lookup", self._lookup)
         s.route("GET", "/dir/status", self._status)
         s.route("GET", "/cluster/watch", self._cluster_watch)
+        s.route("POST", "/cluster/raft/add",
+                lambda q, b: self._raft_membership(
+                    dict(q, _action="add"), b))
+        s.route("POST", "/cluster/raft/remove",
+                lambda q, b: self._raft_membership(
+                    dict(q, _action="remove"), b))
         s.route("GET", "/ui", self._ui)
         from ..utils.pprof import enable_pprof_routes
         enable_pprof_routes(s)
@@ -173,6 +179,8 @@ class MasterServer:
                     f"address {me} (got {norm}); set -ip/-port to match")
             self.raft = RaftNode(
                 me, norm, apply_fn=self._raft_apply,
+                snapshot_fn=self._raft_snapshot,
+                restore_fn=self._raft_restore,
                 state_path=f"{meta_dir}/raft.json" if meta_dir else None)
             self.raft.mount(self.server)
             self.topo.next_volume_id_hook = self._next_volume_id_raft
@@ -182,6 +190,40 @@ class MasterServer:
     def _raft_apply(self, cmd: dict) -> None:
         if cmd.get("op") == "max_volume_id":
             self.topo.set_max_volume_id(cmd["value"])
+
+    def _raft_snapshot(self) -> dict:
+        """State-machine snapshot for raft log compaction: the whole
+        replicated state is the id watermark."""
+        with self.topo._lock:
+            return {"max_volume_id": max(self.topo._max_volume_id,
+                                         self.topo.max_volume_id)}
+
+    def _raft_restore(self, state: dict) -> None:
+        if state.get("max_volume_id"):
+            self.topo.set_max_volume_id(state["max_volume_id"])
+
+    def _raft_membership(self, query: dict, body: bytes) -> dict:
+        """POST /cluster/raft/{add,remove}?peer=host:port — one-server-
+        at-a-time membership change on the leader."""
+        if self.raft is None:
+            raise rpc.RpcError(400, "raft is not enabled (-peers)")
+        peer = query.get("peer", "")
+        if not peer:
+            raise rpc.RpcError(400, "missing ?peer=host:port")
+        if not peer.startswith("http"):
+            peer = f"http://{peer}"
+        from .raft import NotLeader
+        try:
+            if query.get("_action") == "remove":
+                self.raft.remove_server(peer)
+            else:
+                self.raft.add_server(peer)
+        except NotLeader as e:
+            raise rpc.RpcError(
+                503, f"not the leader (leader={e.leader})") from None
+        except (RuntimeError, ValueError) as e:
+            raise rpc.RpcError(409, str(e)) from None
+        return {"peers": sorted(self.raft.peers + [self.raft.id])}
 
     def _next_volume_id_raft(self) -> int:
         from .raft import NotLeader

@@ -455,11 +455,12 @@ class FilerServer:
     def _ui(self, query: dict, body: bytes):
         """Status page (the reference's filer UI).  Lives at /.ui since
         / is the user namespace."""
+        from html import escape as esc
         html = (
             "<!doctype html><title>seaweedfs-tpu filer</title>"
             "<style>body{font-family:sans-serif;margin:2em}</style>"
             f"<h1>Filer {self.url()}</h1>"
-            f"<p>master: {__import__('html').escape(self.master_url)}"
+            f"<p>master: {esc(self.master_url)}"
             " &middot; "
             f"store: {type(self.filer.store).__name__} &middot; "
             f"signature: {self.filer.signature} &middot; "

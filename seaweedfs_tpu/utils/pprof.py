@@ -80,11 +80,15 @@ def setup_profiling(cpuprofile: str = "",
                 counts.update(c)
                 state["samples"] += n
 
-        threading.Thread(target=sampler, daemon=True,
-                         name="cpu-sampler").start()
+        sampler_thread = threading.Thread(target=sampler, daemon=True,
+                                          name="cpu-sampler")
+        sampler_thread.start()
 
         def dump_cpu() -> None:
             stop.set()
+            # Join before reading: a concurrent counts.update() while
+            # iterating would RuntimeError and lose the whole profile.
+            sampler_thread.join(timeout=2.0)
             with open(cpuprofile, "w") as f:
                 for stack, n in counts.most_common():
                     f.write(";".join(stack) + f" {n}\n")
