@@ -113,6 +113,13 @@ class RaftNode:
 
         self._lock = threading.RLock()
         self._commit_cv = threading.Condition(self._lock)
+        # Serializes state-machine mutations (apply_fn batches vs
+        # restore_fn on InstallSnapshot).  Without it a snapshot can be
+        # restored between an apply batch's last_applied bump and the
+        # apply_fn calls, and the stale commands then land ON TOP of
+        # the newer snapshot state.  Ordering: _sm_lock before _lock,
+        # never the reverse.
+        self._sm_lock = threading.Lock()
         self._last_heartbeat = time.monotonic()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -530,7 +537,10 @@ class RaftNode:
         """InstallSnapshot (Raft §7): the leader ships its snapshot to
         a follower whose needed entries were compacted away."""
         req = json.loads(body)
-        with self._lock:
+        # _sm_lock first (same order as the apply loop): restore_fn
+        # must not run while an apply batch is mid-flight, or stale
+        # pre-snapshot commands would mutate the restored state.
+        with self._sm_lock, self._lock:
             if req["term"] < self.current_term:
                 return {"term": self.current_term, "success": False}
             if req["term"] > self.current_term or self.state != FOLLOWER:
@@ -799,14 +809,25 @@ class RaftNode:
                 end = self.commit_index
                 entries = self.log[start - self.log_base - 1:
                                    end - self.log_base]
-                self.last_applied = end
-            for e in entries:
-                if e["cmd"].get("op") in ("noop", "raft_config"):
-                    continue  # consensus bookkeeping, not app state
-                try:
-                    self.apply_fn(e["cmd"])
-                except Exception:  # noqa: BLE001 — state machine bug
-                    pass           # must not kill consensus
+            applied = False
+            with self._sm_lock:
+                with self._lock:
+                    # Re-check under the mutation lock: an
+                    # InstallSnapshot may have restored a newer state
+                    # while we were between locks — our batch is then
+                    # stale and must be dropped, not applied on top.
+                    if self.last_applied == start - 1:
+                        self.last_applied = end
+                        applied = True
+                if applied:
+                    for e in entries:
+                        if e["cmd"].get("op") in ("noop", "raft_config"):
+                            continue  # consensus bookkeeping only
+                        try:
+                            self.apply_fn(e["cmd"])
+                        except Exception:  # noqa: BLE001 — state
+                            pass           # machine bug must not kill
+                            #                consensus
             with self._lock:
                 try:
                     self._maybe_compact_locked()
