@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import threading
 import time
+import urllib.parse
 
 from ..core import types as t
 from . import rpc
@@ -167,21 +168,68 @@ class WeedClient:
                     replication: str | None = None, ttl: str = "",
                     name: str = "") -> str:
         """Assign + PUT. Returns the fid."""
+        return self.upload(data, collection=collection,
+                           replication=replication, ttl=ttl,
+                           name=name)["fid"]
+
+    def upload(self, data: bytes, collection: str = "",
+               replication: str | None = None, ttl: str = "",
+               name: str = "", mime: str = "",
+               compress: bool = True, cipher: bool = False) -> dict:
+        """Assign + PUT with the full upload pipeline of the
+        reference's operation.UploadData (operation/upload_content.go):
+        compressible content is gzipped when that shrinks it (sent with
+        Content-Encoding so the needle records the flag), and cipher=True
+        seals the bytes with a fresh AES-256-GCM key the caller keeps —
+        the volume server stores opaque data with no name/mime.
+
+        Returns {fid, url, size, etag, is_compressed, cipher_key}.
+        `size` is the logical (plaintext) size; cipher_key is b"" unless
+        cipher was requested.
+        """
+        size = len(data)
+        gzipped = False
+        key = b""
+        if cipher:
+            # Sealed uploads never double as gzip uploads: ciphertext
+            # doesn't compress, and the chunk metadata (not the needle)
+            # carries everything a reader needs.
+            from ..utils.cipher import encrypt
+            data, key = encrypt(data)
+        elif compress:
+            from ..utils.compression import maybe_gzip
+            data, gzipped = maybe_gzip(data, name, mime)
         a = self.assign(collection=collection, replication=replication,
                         ttl=ttl)
         fid = a["fid"]
         url = f"http://{a['url']}/{fid}"
         q = []
-        if name:
-            q.append(f"name={name}")
+        if name and not cipher:
+            q.append("name=" + urllib.parse.quote(name))
+        if mime and not cipher:
+            q.append("mime=" + urllib.parse.quote(mime))
         if a.get("auth"):  # master-minted write JWT (secured cluster)
             q.append(f"jwt={a['auth']}")
         if q:
             url += "?" + "&".join(q)
-        rpc.call(url, "POST", data)
-        return fid
+        resp = rpc.call(url, "POST", data,
+                        headers={"Content-Encoding": "gzip"}
+                        if gzipped else None)
+        etag = resp.get("eTag", "") if isinstance(resp, dict) else ""
+        return {"fid": fid, "url": a["url"], "size": size, "etag": etag,
+                "is_compressed": gzipped, "cipher_key": key}
 
-    def download(self, fid: str) -> bytes:
+    def download(self, fid: str, cipher_key: bytes = b"") -> bytes:
+        """Fetch a needle; opens sealed blobs when the caller holds the
+        chunk's cipher key (gzip is undone server-side — plain `call`
+        never advertises Accept-Encoding)."""
+        data = self._download_raw(fid)
+        if cipher_key:
+            from ..utils.cipher import decrypt
+            data = decrypt(data, cipher_key)
+        return data
+
+    def _download_raw(self, fid: str) -> bytes:
         vid, _key, _cookie = t.parse_file_id(fid)
         locs = self.lookup(vid, include_ec=True)
         if not locs:

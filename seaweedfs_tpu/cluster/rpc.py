@@ -426,9 +426,13 @@ class JsonHttpServer:
 
         parsed = urllib.parse.urlparse(target)
         # keep_blank_values: S3-style flag params (?uploads, ?tagging,
-        # ?delete) have no '=value'.
+        # ?delete) have no '=value'.  Underscore-prefixed keys are
+        # RESERVED for header-derived values below — a client must not
+        # be able to forge e.g. ?_content_encoding=gzip and get a
+        # plaintext needle stored with the compressed flag.
         query = {k: v[0] for k, v in urllib.parse.parse_qs(
-            parsed.query, keep_blank_values=True).items()}
+            parsed.query, keep_blank_values=True).items()
+            if not k.startswith("_")}
         # Select request headers handlers care about (Range for partial
         # reads, Content-Type for upload mime) ride along in the query
         # dict under reserved keys.
@@ -436,6 +440,13 @@ class JsonHttpServer:
             query["_range_header"] = headers["range"]
         if "content-type" in headers:
             query["_content_type"] = headers["content-type"]
+        # Compression negotiation (volume server gzip path): the upload
+        # side declares pre-compressed bodies, the read side declares
+        # whether it can take gzip back.
+        if "content-encoding" in headers:
+            query["_content_encoding"] = headers["content-encoding"]
+        if "accept-encoding" in headers:
+            query["_accept_encoding"] = headers["accept-encoding"]
         if self.pass_headers:
             # Full header dict + raw query string for handlers that
             # authenticate requests (S3 sig v4 needs the exact header
@@ -803,7 +814,7 @@ _pool = _ConnPool()
 
 
 def _request(url: str, method: str, body, timeout: float,
-             max_redirects: int = 3):
+             max_redirects: int = 3, req_headers: dict | None = None):
     """One pooled request; returns (_Resp, _Conn) with the body NOT yet
     read (callers stream or read()).  Retries exactly once on a stale
     reused keep-alive connection (failure before any response bytes)."""
@@ -816,9 +827,13 @@ def _request(url: str, method: str, body, timeout: float,
     path = u.path or "/"
     if u.query:
         path += "?" + u.query
+    extra = ""
+    for k, v in (req_headers or {}).items():
+        extra += f"{k}: {v}\r\n"
     req = (f"{method} {path} HTTP/1.1\r\n"
            f"Host: {host}:{port}\r\n"
            f"Content-Length: {len(body) if body else 0}\r\n"
+           f"{extra}"
            "\r\n").encode("latin-1")
     if body:
         req += body
@@ -862,7 +877,7 @@ def _request(url: str, method: str, body, timeout: float,
                     conn.close()
                 return _request(
                     urllib.parse.urljoin(url, location), method, body,
-                    timeout, max_redirects - 1)
+                    timeout, max_redirects - 1, req_headers)
         return resp, conn
     raise AssertionError("unreachable")
 
@@ -885,9 +900,10 @@ def _raise_rpc_error(resp: _Resp, data: bytes) -> None:
 
 
 def call(url: str, method: str = "GET", body: bytes | None = None,
-         timeout: float = 10.0):
+         timeout: float = 10.0, headers: dict | None = None):
     """HTTP call returning parsed JSON (dict) or raw bytes."""
-    resp, conn = _request(url, method, body, timeout)
+    resp, conn = _request(url, method, body, timeout,
+                          req_headers=headers)
     try:
         if method == "HEAD":
             data = b""         # no body follows a HEAD response even

@@ -289,7 +289,14 @@ class VolumeServer:
                 raise rpc.RpcError(404, str(e)) from None
             except VolumeError as e:
                 raise rpc.RpcError(403, str(e)) from None
-            return (200, b"", {"Content-Length": str(len(n.data))})
+            size = len(n.data)
+            if n.is_compressed() and size >= 4:
+                # A GET without Accept-Encoding serves the decompressed
+                # body; HEAD must agree.  The gzip ISIZE trailer (last 4
+                # bytes, little-endian) gives the plaintext length
+                # without inflating the needle.
+                size = int.from_bytes(n.data[-4:], "little")
+            return (200, b"", {"Content-Length": str(size)})
         # EC probe: locate-only (.ecx binary search + .ecj check) —
         # reports 404 for absent/deleted needles without reconstructing
         # any data.
@@ -306,15 +313,32 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             ev = self.ec_volumes.get(vid)
-            if ev is not None:
-                return self._ec_read(ev, key, cookie)
-            raise rpc.RpcError(404, f"volume {vid} not on this server")
-        try:
-            n = self.store.read_needle(vid, key, cookie)
-        except NotFoundError as e:
-            raise rpc.RpcError(404, str(e)) from None
-        except VolumeError as e:
-            raise rpc.RpcError(403, str(e)) from None
+            if ev is None:
+                raise rpc.RpcError(404,
+                                   f"volume {vid} not on this server")
+            n = self._ec_read(ev, key, cookie)
+        else:
+            try:
+                n = self.store.read_needle(vid, key, cookie)
+            except NotFoundError as e:
+                raise rpc.RpcError(404, str(e)) from None
+            except VolumeError as e:
+                raise rpc.RpcError(403, str(e)) from None
+        return self._serve_needle(n, query)
+
+    def _serve_needle(self, n: Needle, query: dict):
+        """Post-read pipeline shared by the replicated and EC paths:
+        gzip negotiation then optional image resize — storage layout
+        must never change read behavior."""
+        if n.is_compressed():
+            # Stored gzipped (volume_server_handlers_read.go): hand the
+            # raw bytes to readers that accept gzip, decompress for the
+            # rest.  Resize always needs the plain image bytes.
+            from ..utils.compression import ungzip_data
+            if "gzip" in query.get("_accept_encoding", "") and \
+                    "width" not in query and "height" not in query:
+                return (200, n.data, {"Content-Encoding": "gzip"})
+            n.data = ungzip_data(n.data)
         if "width" in query or "height" in query:
             # On-the-fly resize for image reads
             # (volume_server_handlers_read.go:219-243).  Malformed
@@ -334,10 +358,11 @@ class VolumeServer:
             return data
         return n.data
 
-    def _ec_read(self, ev: EcVolume, key: int, cookie: int):
+    def _ec_read(self, ev: EcVolume, key: int, cookie: int) -> Needle:
         """EC read path with the full distributed ladder (store_ec.go):
         local shard -> remote shard via peers -> on-the-fly reconstruction
-        gathering >=10 shard intervals from the cluster."""
+        gathering >=10 shard intervals from the cluster.  Returns the
+        parsed needle; response shaping lives in _serve_needle."""
         self._ensure_ec_version(ev)
         try:
             _offset, _size, intervals = ev.locate_needle(key)
@@ -351,7 +376,7 @@ class VolumeServer:
         n = Needle.from_bytes(blob, ev.version)
         if n.cookie != cookie:
             raise rpc.RpcError(403, "cookie mismatch")
-        return n.data
+        return n
 
     def _ensure_ec_version(self, ev: EcVolume) -> None:
         """Resolve the volume version over the cluster when local detection
@@ -549,12 +574,19 @@ class VolumeServer:
         if v is None:
             raise rpc.RpcError(404, f"volume {vid} not on this server")
         mime = query.get("mime", query.get("_content_type", ""))
-        if mime == "image/jpeg" and query.get("type") != "replicate":
+        gzipped = "gzip" in query.get("_content_encoding", "")
+        if mime == "image/jpeg" and not gzipped and \
+                query.get("type") != "replicate":
             # EXIF auto-orientation on JPEG upload (needle.go:100-105);
             # replicas receive the already-fixed bytes.
             from ..images import fix_jpeg_orientation
             body = fix_jpeg_orientation(body)
         n = Needle(cookie=cookie, id=key, data=body)
+        if gzipped:
+            # Pre-compressed upload (needle_parse_upload.go): store the
+            # gzip bytes as-is and remember it in the needle flags so
+            # reads can negotiate.
+            n.set_is_compressed()
         if "name" in query:
             n.set_name(query["name"].encode())
         if "mime" in query:
@@ -602,10 +634,15 @@ class VolumeServer:
         fwd = {k: v for k, v in query.items() if not k.startswith("_")}
         fwd["type"] = "replicate"
         qs = urllib.parse.urlencode(fwd)
+        # A pre-compressed body must reach replicas with the same
+        # Content-Encoding so their needle flags match the primary's.
+        hdrs = {"Content-Encoding": "gzip"} \
+            if "gzip" in query.get("_content_encoding", "") else None
 
         def send(url):
             try:
-                rpc.call(f"http://{url}{path}?{qs}", method, body)
+                rpc.call(f"http://{url}{path}?{qs}", method, body,
+                         headers=hdrs)
             except Exception as e:  # noqa: BLE001
                 errors.append(f"{url}: {e}")
 

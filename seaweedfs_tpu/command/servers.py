@@ -124,7 +124,8 @@ def run_filer(flags: Flags, args: list[str]) -> int:
         collection=flags.get("collection", ""),
         replication=flags.get("defaultReplicaPlacement") or None,
         metrics_port=flags.get_int("metricsPort", 0) or None,
-        ssl_context=_security("filer"))
+        ssl_context=_security("filer"),
+        cipher=flags.get_bool("encryptVolumeData", False))
     fs.start()
     glog.infof("filer serving at %s", fs.server.url())
     return _wait_forever([fs])

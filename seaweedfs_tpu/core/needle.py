@@ -115,6 +115,9 @@ class Needle:
     def is_chunked_manifest(self) -> bool:
         return bool(self.flags & FLAG_IS_CHUNK_MANIFEST)
 
+    def set_is_compressed(self) -> None:
+        self.flags |= FLAG_IS_COMPRESSED
+
     def set_name(self, name: bytes) -> None:
         self.name = name[:255]
         self.flags |= FLAG_HAS_NAME

@@ -27,6 +27,9 @@ class FileChunk:
     mtime: int
     etag: str = ""
     is_chunk_manifest: bool = False
+    # Hex AES-256-GCM key for chunks sealed by a cipher-enabled filer
+    # (filer.proto FileChunk.cipher_key); empty = plaintext needle.
+    cipher_key: str = ""
 
     def to_dict(self) -> dict:
         d = {"file_id": self.file_id, "offset": self.offset,
@@ -35,6 +38,8 @@ class FileChunk:
             d["etag"] = self.etag
         if self.is_chunk_manifest:
             d["is_chunk_manifest"] = True
+        if self.cipher_key:
+            d["cipher_key"] = self.cipher_key
         return d
 
     @classmethod
@@ -42,7 +47,8 @@ class FileChunk:
         return cls(file_id=d["file_id"], offset=d["offset"],
                    size=d["size"], mtime=d["mtime"],
                    etag=d.get("etag", ""),
-                   is_chunk_manifest=d.get("is_chunk_manifest", False))
+                   is_chunk_manifest=d.get("is_chunk_manifest", False),
+                   cipher_key=d.get("cipher_key", ""))
 
 
 @dataclass

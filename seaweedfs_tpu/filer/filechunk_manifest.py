@@ -25,8 +25,11 @@ from .entry import FileChunk
 # chunk (filechunk_manifest.go:18 ManifestBatch).
 MANIFEST_BATCH = 1000
 
-# fetch(file_id) -> bytes of the stored blob
-FetchFn = Callable[[str], bytes]
+# fetch(file_id, cipher_key_hex) -> opened bytes of the stored blob
+# (manifest blobs written by a cipher-enabled filer are sealed like any
+# other chunk — they hold every data chunk's key, so leaving them
+# plaintext would defeat encryption at rest)
+FetchFn = Callable[[str, str], bytes]
 # save(data) -> FileChunk for the uploaded blob (offset/size overwritten)
 SaveFn = Callable[[bytes], FileChunk]
 
@@ -60,7 +63,7 @@ def resolve_one_chunk_manifest(fetch_fn: FetchFn,
                                chunk: FileChunk) -> list[FileChunk]:
     if not chunk.is_chunk_manifest:
         return []
-    blob = fetch_fn(chunk.file_id)
+    blob = fetch_fn(chunk.file_id, chunk.cipher_key)
     try:
         doc = json.loads(bytes(blob))
     except Exception as e:  # noqa: BLE001

@@ -73,7 +73,7 @@ class Filer:
                  log_capacity: int = 4096,
                  meta_log_dir: str | None = None,
                  signature: int | None = None,
-                 fetch_chunk_fn: Callable[[str], bytes] | None = None):
+                 fetch_chunk_fn: Callable[..., bytes] | None = None):
         self.store = store or MemoryStore()
         # Serializes every hardlink-doc read-modify-write: the HTTP
         # server is thread-per-connection, and a lost counter update

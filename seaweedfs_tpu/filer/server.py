@@ -105,7 +105,7 @@ class FilerServer:
                  chunk_size: int = 4 * 1024 * 1024,
                  collection: str = "", replication: str | None = None,
                  metrics_port: int | None = None,
-                 ssl_context=None):
+                 ssl_context=None, cipher: bool = False):
         # Accepts an HA seed list; all master traffic (including the
         # /dir/* proxies mounts rely on) fails over via WeedClient.
         self.client = WeedClient(master_url)
@@ -113,6 +113,10 @@ class FilerServer:
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
+        # filer.toml `cipher`: every data chunk this filer uploads is
+        # sealed with a per-chunk AES-256-GCM key kept in the entry
+        # metadata (filer_server_handlers_write.go cipher option).
+        self.cipher = cipher
         meta_log_dir = store_path + ".metalog" if store_path else None
         self.streamer = ChunkStreamer(self.client)
         self.filer = Filer(store=store_for_path(store_path),
@@ -196,7 +200,8 @@ class FilerServer:
         return maybe_manifestize(
             lambda data: upload_blob(self.client, data,
                                      collection or self.collection,
-                                     self.replication, ttl), chunks,
+                                     self.replication, ttl,
+                                     cipher=self.cipher), chunks,
             created=created)
 
     # -- read ----------------------------------------------------------------
@@ -353,7 +358,8 @@ class FilerServer:
         ttl = query.get("ttl", "")
         writer = ChunkedWriter(
             self.client, chunk_size=self.chunk_size,
-            collection=collection, replication=self.replication, ttl=ttl)
+            collection=collection, replication=self.replication, ttl=ttl,
+            cipher=self.cipher)
         raw_chunks: list = []
         manifests: list = []
         try:
@@ -471,8 +477,12 @@ class FilerServer:
                 {"Content-Type": "text/html; charset=utf-8"})
 
     def _meta_info(self, query: dict, body: bytes) -> dict:
+        # `cipher` is the GetFilerConfiguration bit mounts honor
+        # (filer_grpc_server.go GetFilerConfiguration → wfs.go): clients
+        # writing through this filer must seal chunks the same way.
         return {"signature": self.filer.signature,
-                "last_ns": self.filer.meta_log.last_ts_ns()}
+                "last_ns": self.filer.meta_log.last_ts_ns(),
+                "cipher": self.cipher}
 
     def _proxy_assign(self, query: dict, body: bytes):
         import urllib.parse

@@ -57,10 +57,16 @@ class ChunkStreamer:
         self.client = client
         self.cache = cache or ChunkCache()
 
-    def _fetch(self, file_id: str) -> bytes:
+    def _fetch(self, file_id: str, cipher_key_hex: str = "") -> bytes:
+        """Chunk bytes, opened: sealed chunks are decrypted before they
+        enter the cache, so cache hits never re-pay the AES pass and
+        the key check happens exactly once per fetch."""
         data = self.cache.get(file_id)
         if data is None:
-            data = self.client.download(file_id)
+            data = self.client.download(
+                file_id,
+                cipher_key=bytes.fromhex(cipher_key_hex)
+                if cipher_key_hex else b"")
             self.cache.put(file_id, data)
         return data
 
@@ -86,8 +92,9 @@ class ChunkStreamer:
         if size <= 0:
             return b""
         out = bytearray(size)
+        keys = {c.file_id: c.cipher_key for c in chunks if c.cipher_key}
         for view in read_chunk_views(chunks, offset, size):
-            data = self._fetch(view.file_id)
+            data = self._fetch(view.file_id, keys.get(view.file_id, ""))
             piece = data[view.offset_in_chunk:
                          view.offset_in_chunk + view.size]
             lo = view.logical_offset - offset
@@ -113,20 +120,21 @@ class ChunkStreamer:
 
 def upload_blob(client: WeedClient, data: bytes, collection: str = "",
                 replication: str | None = None, ttl: str = "",
-                offset: int = 0) -> FileChunk:
-    """Assign a file id and upload one blob as a single chunk — the one
-    place the assign → POST (+JWT) sequence lives (upload_content.go)."""
-    from ..cluster import rpc
-    a = client.assign(collection=collection, replication=replication,
-                      ttl=ttl)
-    fid = a["fid"]
-    url = f"http://{a['url']}/{fid}"
-    if a.get("auth"):  # secured cluster write JWT
-        url += f"?jwt={a['auth']}"
-    resp = rpc.call(url, "POST", data)
-    etag = resp.get("eTag", "") if isinstance(resp, dict) else ""
-    return FileChunk(file_id=fid, offset=offset, size=len(data),
-                     mtime=time.time_ns(), etag=etag)
+                offset: int = 0, cipher: bool = False) -> FileChunk:
+    """Upload one blob as a single chunk via the client's upload
+    pipeline (upload_content.go) and wrap the result as a FileChunk.
+    With cipher=True the blob is sealed with a fresh AES-256-GCM key
+    that lives only in the returned chunk's metadata (the filer cipher
+    model, upload_content.go:150-170): volume servers hold ciphertext.
+    Chunks are never needle-gzipped: ranged reads slice chunks at
+    arbitrary offsets, which a compressed needle cannot serve."""
+    r = client.upload(data, collection=collection,
+                      replication=replication, ttl=ttl,
+                      compress=False, cipher=cipher)
+    return FileChunk(file_id=r["fid"], offset=offset, size=r["size"],
+                     mtime=time.time_ns(), etag=r["etag"],
+                     cipher_key=r["cipher_key"].hex()
+                     if r["cipher_key"] else "")
 
 
 class ChunkedWriter:
@@ -135,12 +143,13 @@ class ChunkedWriter:
 
     def __init__(self, client: WeedClient, chunk_size: int = 4 * 1024 * 1024,
                  collection: str = "", replication: str | None = None,
-                 ttl: str = ""):
+                 ttl: str = "", cipher: bool = False):
         self.client = client
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
         self.ttl = ttl
+        self.cipher = cipher
 
     def write(self, reader, offset: int = 0,
               into: list[FileChunk] | None = None) -> list[FileChunk]:
@@ -160,6 +169,7 @@ class ChunkedWriter:
             if not piece:
                 break
             chunks.append(upload_blob(self.client, piece, self.collection,
-                                      self.replication, self.ttl, pos))
+                                      self.replication, self.ttl, pos,
+                                      cipher=self.cipher))
             pos += len(piece)
         return chunks

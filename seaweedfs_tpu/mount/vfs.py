@@ -140,9 +140,19 @@ class WFS:
         # client speaks to the filer only (like the reference mount).
         self.client = WeedClient(filer_url)
         self.streamer = ChunkStreamer(self.client)
+        # Honor the filer's cipher configuration (wfs.go reads it from
+        # GetFilerConfiguration): a mount of a cipher-enabled filer must
+        # seal its chunks too, or writes through FUSE silently bypass
+        # encryption at rest.
+        # Strict: a mount cannot run without its filer anyway, and
+        # silently falling back to plaintext on a transient error would
+        # re-open the bypass.
+        self.cipher = cipher = bool(
+            self.proxy.meta_info().get("cipher", False))
         self.writer = ChunkedWriter(self.client, chunk_size=chunk_size,
                                     collection=collection,
-                                    replication=replication or None)
+                                    replication=replication or None,
+                                    cipher=cipher)
         self.meta_cache = MetaCache(filer_url)
         self.handles: dict[int, FileHandle] = {}
         self._next_fh = 1

@@ -1,0 +1,171 @@
+"""At-rest chunk encryption: AES-256-GCM over OpenSSL's libcrypto.
+
+Reference: weed/util/cipher.go (Encrypt/Decrypt used by the cipher
+upload option, weed/operation/upload_content.go:150-170) — the chunks a
+filer writes are sealed with a fresh random 256-bit key per chunk and
+the key lives only in the filer's metadata (FileChunk.cipher_key), so a
+volume server holds opaque bytes.
+
+The AES primitive comes from the system libcrypto through ctypes (the
+EVP interface) — a native code path, not a Python reimplementation.
+Wire format: 12-byte nonce || ciphertext || 16-byte GCM tag, matching
+Go's cipher.NewGCM layout of nonce + Seal output.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+import threading
+
+NONCE_SIZE = 12
+TAG_SIZE = 16
+KEY_SIZE = 32
+
+
+class CipherError(Exception):
+    """Encryption unavailable or decryption failed (tamper/wrong key)."""
+
+
+_lib = None
+_lib_err: str | None = None
+_lock = threading.Lock()
+
+
+def _crypto():
+    """Load libcrypto once and declare the EVP signatures we use."""
+    global _lib, _lib_err
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            if _lib is None:
+                raise CipherError(_lib_err)
+            return _lib
+        name = ctypes.util.find_library("crypto")
+        if not name:
+            _lib_err = ("libcrypto not found: the cipher upload option "
+                        "requires OpenSSL's libcrypto on the host")
+            raise CipherError(_lib_err)
+        try:
+            lib = ctypes.CDLL(name)
+            lib.EVP_CIPHER_CTX_new.restype = ctypes.c_void_p
+            lib.EVP_CIPHER_CTX_free.argtypes = [ctypes.c_void_p]
+            lib.EVP_aes_256_gcm.restype = ctypes.c_void_p
+            for fn in ("EVP_EncryptInit_ex", "EVP_DecryptInit_ex"):
+                getattr(lib, fn).argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_char_p, ctypes.c_char_p]
+            for fn in ("EVP_EncryptUpdate", "EVP_DecryptUpdate"):
+                getattr(lib, fn).argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p,
+                    ctypes.POINTER(ctypes.c_int), ctypes.c_char_p,
+                    ctypes.c_int]
+            for fn in ("EVP_EncryptFinal_ex", "EVP_DecryptFinal_ex"):
+                getattr(lib, fn).argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p,
+                    ctypes.POINTER(ctypes.c_int)]
+            lib.EVP_CIPHER_CTX_ctrl.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p]
+        except (OSError, AttributeError) as e:
+            _lib_err = f"libcrypto unusable: {e}"
+            raise CipherError(_lib_err) from None
+        _lib = lib
+        return _lib
+
+
+# EVP_CIPHER_CTX_ctrl commands (openssl/evp.h)
+_EVP_CTRL_GCM_SET_IVLEN = 0x9
+_EVP_CTRL_GCM_GET_TAG = 0x10
+_EVP_CTRL_GCM_SET_TAG = 0x11
+
+
+def available() -> bool:
+    try:
+        _crypto()
+        return True
+    except CipherError:
+        return False
+
+
+def new_key() -> bytes:
+    return os.urandom(KEY_SIZE)
+
+
+def encrypt(plaintext: bytes, key: bytes | None = None
+            ) -> tuple[bytes, bytes]:
+    """Seal plaintext; returns (nonce||ct||tag, key). A fresh random key
+    is minted when none is given (the per-chunk key model)."""
+    lib = _crypto()
+    if key is None:
+        key = new_key()
+    if len(key) != KEY_SIZE:
+        raise CipherError(f"key must be {KEY_SIZE} bytes")
+    nonce = os.urandom(NONCE_SIZE)
+    ctx = lib.EVP_CIPHER_CTX_new()
+    if not ctx:
+        raise CipherError("EVP_CIPHER_CTX_new failed")
+    try:
+        if not lib.EVP_EncryptInit_ex(ctx, lib.EVP_aes_256_gcm(),
+                                      None, None, None):
+            raise CipherError("EncryptInit(cipher) failed")
+        lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_GCM_SET_IVLEN,
+                                NONCE_SIZE, None)
+        if not lib.EVP_EncryptInit_ex(ctx, None, None, key, nonce):
+            raise CipherError("EncryptInit(key) failed")
+        out = ctypes.create_string_buffer(len(plaintext) or 1)
+        n = ctypes.c_int(0)
+        if not lib.EVP_EncryptUpdate(ctx, out, ctypes.byref(n),
+                                     plaintext, len(plaintext)):
+            raise CipherError("EncryptUpdate failed")
+        ct = out.raw[:n.value]
+        fin = ctypes.create_string_buffer(TAG_SIZE)
+        if not lib.EVP_EncryptFinal_ex(ctx, fin, ctypes.byref(n)):
+            raise CipherError("EncryptFinal failed")
+        ct += fin.raw[:n.value]
+        tag = ctypes.create_string_buffer(TAG_SIZE)
+        if not lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_GCM_GET_TAG,
+                                       TAG_SIZE, tag):
+            raise CipherError("GET_TAG failed")
+        return nonce + ct + tag.raw, key
+    finally:
+        lib.EVP_CIPHER_CTX_free(ctx)
+
+
+def decrypt(blob: bytes, key: bytes) -> bytes:
+    """Open nonce||ct||tag; raises CipherError on wrong key or tamper."""
+    lib = _crypto()
+    if len(key) != KEY_SIZE:
+        raise CipherError(f"key must be {KEY_SIZE} bytes")
+    if len(blob) < NONCE_SIZE + TAG_SIZE:
+        raise CipherError("ciphertext too short")
+    nonce = blob[:NONCE_SIZE]
+    tag = blob[-TAG_SIZE:]
+    ct = blob[NONCE_SIZE:-TAG_SIZE]
+    ctx = lib.EVP_CIPHER_CTX_new()
+    if not ctx:
+        raise CipherError("EVP_CIPHER_CTX_new failed")
+    try:
+        if not lib.EVP_DecryptInit_ex(ctx, lib.EVP_aes_256_gcm(),
+                                      None, None, None):
+            raise CipherError("DecryptInit(cipher) failed")
+        lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_GCM_SET_IVLEN,
+                                NONCE_SIZE, None)
+        if not lib.EVP_DecryptInit_ex(ctx, None, None, key, nonce):
+            raise CipherError("DecryptInit(key) failed")
+        out = ctypes.create_string_buffer(len(ct) or 1)
+        n = ctypes.c_int(0)
+        if not lib.EVP_DecryptUpdate(ctx, out, ctypes.byref(n),
+                                     ct, len(ct)):
+            raise CipherError("DecryptUpdate failed")
+        pt = out.raw[:n.value]
+        tag_buf = ctypes.create_string_buffer(tag, TAG_SIZE)
+        lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_GCM_SET_TAG,
+                                TAG_SIZE, tag_buf)
+        fin = ctypes.create_string_buffer(TAG_SIZE)
+        if lib.EVP_DecryptFinal_ex(ctx, fin, ctypes.byref(n)) <= 0:
+            raise CipherError("decryption failed: bad key or "
+                              "tampered ciphertext")
+        return pt + fin.raw[:n.value]
+    finally:
+        lib.EVP_CIPHER_CTX_free(ctx)

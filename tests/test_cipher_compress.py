@@ -1,0 +1,261 @@
+"""Gzip + cipher upload paths (operation lib parity, row §2.7).
+
+Reference behaviors under test:
+  - weed/util/cipher.go — AES-256-GCM seal/open
+  - weed/operation/upload_content.go — compress-when-it-shrinks,
+    Content-Encoding negotiation, cipher uploads with opaque needles
+  - weed/server/volume_server_handlers_read.go — stored-gzip needles
+    are decompressed for readers that don't accept gzip
+  - filer cipher option — chunk keys live only in entry metadata
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.utils import cipher
+from seaweedfs_tpu.utils.compression import (gzip_data, is_compressable,
+                                             maybe_gzip, ungzip_data)
+
+TEXT = b"the quick brown fox jumps over the lazy dog\n" * 200
+
+
+# -- primitives ------------------------------------------------------------
+
+def test_cipher_roundtrip_and_key_isolation():
+    blob, key = cipher.encrypt(TEXT)
+    assert blob != TEXT and len(key) == 32
+    assert cipher.decrypt(blob, key) == TEXT
+    # fresh key every call
+    blob2, key2 = cipher.encrypt(TEXT)
+    assert key2 != key and blob2 != blob
+
+
+def test_cipher_rejects_tamper_and_wrong_key():
+    blob, key = cipher.encrypt(TEXT)
+    bad = blob[:-1] + bytes([blob[-1] ^ 1])
+    with pytest.raises(cipher.CipherError):
+        cipher.decrypt(bad, key)
+    with pytest.raises(cipher.CipherError):
+        cipher.decrypt(blob, os.urandom(32))
+    with pytest.raises(cipher.CipherError):
+        cipher.decrypt(b"short", key)
+
+
+def test_cipher_empty_payload():
+    blob, key = cipher.encrypt(b"")
+    assert cipher.decrypt(blob, key) == b""
+
+
+def test_compressable_heuristic():
+    assert is_compressable("a.txt")
+    assert is_compressable("a.json")
+    assert is_compressable(mime="text/html; charset=utf-8")
+    assert is_compressable(mime="application/json")
+    assert not is_compressable("a.jpg")
+    assert not is_compressable("a.mp4", "video/mp4")
+    assert not is_compressable()
+
+
+def test_maybe_gzip_only_when_it_shrinks():
+    z, ok = maybe_gzip(TEXT, "fox.txt")
+    assert ok and len(z) < len(TEXT) and ungzip_data(z) == TEXT
+    rnd = os.urandom(8192)
+    same, ok2 = maybe_gzip(rnd, "noise.txt")
+    assert not ok2 and same == rnd
+    # non-compressable name: untouched even though it would shrink
+    same3, ok3 = maybe_gzip(TEXT, "fox.bin")
+    assert not ok3 and same3 == TEXT
+    # deterministic output (no mtime) so replicas stay byte-identical
+    assert gzip_data(TEXT) == gzip_data(TEXT)
+
+
+# -- cluster paths ---------------------------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_gzip_upload_transparent_read(cluster):
+    master, _ = cluster
+    client = WeedClient(master.url())
+    r = client.upload(TEXT, name="fox.txt")
+    assert r["is_compressed"] and r["size"] == len(TEXT)
+    # plain read: server decompresses
+    assert client.download(r["fid"]) == TEXT
+
+
+def test_gzip_passthrough_for_gzip_reader(cluster):
+    master, _ = cluster
+    client = WeedClient(master.url())
+    r = client.upload(TEXT, name="fox.txt")
+    vid = r["fid"].split(",")[0]
+    locs = client.lookup(int(vid))
+    resp, conn = rpc._request(
+        f"http://{locs[0]['url']}/{r['fid']}", "GET", None, 10.0,
+        req_headers={"Accept-Encoding": "gzip"})
+    raw = resp.read()
+    rpc._finish(conn, resp)
+    assert resp.getheader("content-encoding") == "gzip"
+    assert gzip.decompress(raw) == TEXT
+    assert len(raw) < len(TEXT)  # the wire bytes stayed compressed
+
+
+def test_gzip_upload_replicated(cluster):
+    """Replicas must store the same compressed bytes + flag: reads from
+    EVERY holder decompress correctly."""
+    master, servers = cluster
+    client = WeedClient(master.url())
+    a = client.assign(replication="001")
+    from seaweedfs_tpu.utils.compression import gzip_data as gz
+    url = f"http://{a['url']}/{a['fid']}?name=fox.txt"
+    rpc.call(url, "POST", gz(TEXT),
+             headers={"Content-Encoding": "gzip"})
+    locs = client.lookup(int(a["fid"].split(",")[0]))
+    assert len(locs) == 2
+    for loc in locs:
+        assert rpc.call(f"http://{loc['url']}/{a['fid']}") == TEXT
+
+
+def test_cipher_upload_opaque_on_volume_server(cluster):
+    master, _ = cluster
+    client = WeedClient(master.url())
+    r = client.upload(TEXT, name="secret.txt", cipher=True)
+    assert r["cipher_key"] and not r["is_compressed"]
+    # raw needle bytes are ciphertext, name never reached the server
+    raw = client.download(r["fid"])
+    assert raw != TEXT and TEXT not in raw
+    # holder of the key reads plaintext
+    assert client.download(r["fid"], cipher_key=r["cipher_key"]) == TEXT
+
+
+def test_spoofed_content_encoding_query_param_ignored(cluster):
+    """?_content_encoding=gzip in the URL must NOT set the compressed
+    flag — reserved underscore keys come from headers only.  A forged
+    one would store an unreadable needle on the primary while replicas
+    (which strip _ keys) stored it fine."""
+    master, _ = cluster
+    client = WeedClient(master.url())
+    a = client.assign()
+    rpc.call(f"http://{a['url']}/{a['fid']}?_content_encoding=gzip",
+             "POST", b"plain bytes, not gzip")
+    assert client.download(a["fid"]) == b"plain bytes, not gzip"
+
+
+def test_head_reports_logical_size_for_gzipped_needle(cluster):
+    master, _ = cluster
+    client = WeedClient(master.url())
+    r = client.upload(TEXT, name="fox.txt")
+    assert r["is_compressed"]
+    locs = client.lookup(int(r["fid"].split(",")[0]))
+    resp, conn = rpc._request(f"http://{locs[0]['url']}/{r['fid']}",
+                              "HEAD", None, 10.0)
+    resp._done = True
+    rpc._finish(conn, resp)
+    assert int(resp.getheader("content-length")) == len(TEXT)
+
+
+def test_mount_honors_filer_cipher(cluster, tmp_path):
+    """A WFS pointed at a cipher-enabled filer must seal its chunks
+    (wfs.go reads the cipher bit from GetFilerConfiguration)."""
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.mount.vfs import WFS
+    master, _ = cluster
+    fs = FilerServer(master.url(), port=0,
+                     store_path=str(tmp_path / "fmnt.db"), cipher=True)
+    fs.start()
+    try:
+        wfs = WFS(fs.url())
+        assert wfs.cipher and wfs.writer.cipher
+        chunks = wfs.writer.write(TEXT[:1000])
+        assert chunks and all(c.cipher_key for c in chunks)
+        # sealed on the volume server, opened by the streamer
+        client = WeedClient(master.url())
+        assert TEXT[:64] not in client.download(chunks[0].file_id)
+        assert wfs.streamer.read(chunks) == TEXT[:1000]
+    finally:
+        fs.stop()
+
+
+def test_cipher_manifest_blob_is_sealed(cluster, tmp_path):
+    """A manifest blob holds every data chunk's key — a cipher filer
+    must seal it too, or encryption at rest is defeated for any file
+    big enough to manifestize."""
+    from seaweedfs_tpu.filer.entry import FileChunk
+    from seaweedfs_tpu.filer.server import FilerServer
+    master, _ = cluster
+    fs = FilerServer(master.url(), port=0,
+                     store_path=str(tmp_path / "fm.db"), cipher=True)
+    fs.start()
+    try:
+        fake = [FileChunk(file_id=f"9,{i:x}00000000", offset=i * 10,
+                          size=10, mtime=i + 1,
+                          cipher_key=os.urandom(32).hex())
+                for i in range(1000)]
+        out = fs._manifestize(list(fake))
+        manifest = [c for c in out if c.is_chunk_manifest]
+        assert len(manifest) == 1 and manifest[0].cipher_key
+        client = WeedClient(master.url())
+        raw = client.download(manifest[0].file_id)
+        # the plaintext manifest would contain chunk keys as hex JSON
+        assert fake[0].cipher_key.encode() not in raw
+        assert b"cipher_key" not in raw
+        # the streamer opens it transparently
+        resolved = fs.streamer.resolve(out)
+        assert [c.file_id for c in resolved] == \
+            [c.file_id for c in fake]
+    finally:
+        fs.stop()
+
+
+def test_filer_cipher_roundtrip(cluster, tmp_path):
+    from seaweedfs_tpu.filer.server import FilerServer
+    master, _ = cluster
+    fs = FilerServer(master.url(), port=0,
+                     store_path=str(tmp_path / "filer.db"),
+                     chunk_size=512, cipher=True)
+    fs.start()
+    try:
+        base = fs.url()  # already scheme-qualified
+        payload = TEXT[:2000]  # several 512-byte chunks
+        rpc.call(f"{base}/docs/secret.txt", "POST", payload)
+        # entry metadata carries per-chunk keys
+        meta = rpc.call(f"{base}/docs/secret.txt?metadata=true")
+        if isinstance(meta, (bytes, bytearray)):
+            meta = json.loads(meta)
+        chunks = meta.get("chunks", [])
+        assert chunks and all(c.get("cipher_key") for c in chunks)
+        # chunk needles on the volume server are opaque
+        client = WeedClient(master.url())
+        raw = client.download(chunks[0]["file_id"])
+        assert payload[:len(raw)] != raw and payload[:64] not in raw
+        # the filer read path decrypts transparently
+        assert rpc.call(f"{base}/docs/secret.txt") == payload
+        # ranged read through the decrypting streamer
+        resp, conn = rpc._request(f"{base}/docs/secret.txt", "GET",
+                                  None, 10.0,
+                                  req_headers={"Range": "bytes=100-299"})
+        part = resp.read()
+        rpc._finish(conn, resp)
+        assert part == payload[100:300]
+    finally:
+        fs.stop()

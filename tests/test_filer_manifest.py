@@ -44,7 +44,7 @@ class _BlobStore:
         return FileChunk(file_id=fid, offset=0, size=len(data),
                          mtime=time.time_ns())
 
-    def fetch(self, fid: str) -> bytes:
+    def fetch(self, fid: str, cipher_key: str = "") -> bytes:
         return self.blobs[fid]
 
 
