@@ -290,13 +290,19 @@ class VolumeServer:
             except VolumeError as e:
                 raise rpc.RpcError(403, str(e)) from None
             size = len(n.data)
+            hdrs = {}
             if n.is_compressed() and size >= 4:
-                # A GET without Accept-Encoding serves the decompressed
-                # body; HEAD must agree.  The gzip ISIZE trailer (last 4
-                # bytes, little-endian) gives the plaintext length
-                # without inflating the needle.
-                size = int.from_bytes(n.data[-4:], "little")
-            return (200, b"", {"Content-Length": str(size)})
+                # HEAD must mirror GET's negotiation: a gzip-accepting
+                # client would receive the stored bytes (report that
+                # length + encoding), anyone else the inflated body —
+                # sized by the gzip ISIZE trailer (last 4 bytes, LE)
+                # without actually inflating the needle.
+                if "gzip" in query.get("_accept_encoding", ""):
+                    hdrs["Content-Encoding"] = "gzip"
+                else:
+                    size = int.from_bytes(n.data[-4:], "little")
+            hdrs["Content-Length"] = str(size)
+            return (200, b"", hdrs)
         # EC probe: locate-only (.ecx binary search + .ecj check) —
         # reports 404 for absent/deleted needles without reconstructing
         # any data.

@@ -85,7 +85,8 @@ def run_filer_sync(flags: Flags, args: list[str]) -> int:
 def run_filer_replicate(flags: Flags, args: list[str]) -> int:
     """filer.replicate -filer=... -source.dir=/bucket -sink=<spec>
 
-    Sink specs: filer://host:port/dir, local:///path, s3://host/bucket.
+    Sink specs: filer://host:port/dir, local:///path, s3://host/bucket,
+    gcs://bucket/dir, b2://bucket/dir, azure://account/container/dir.
     Consumes the filer's meta stream (notification input) and replays it
     on the sink; checkpoints its offset in the source filer KV."""
     from ..filer.client import FilerProxy
@@ -97,9 +98,20 @@ def run_filer_replicate(flags: Flags, args: list[str]) -> int:
     if not spec:
         print("missing -sink=<spec>", file=sys.stderr)
         return 1
-    sink = sink_for_spec(spec, access_key=flags.get("s3.access_key", ""),
-                         secret_key=flags.get("s3.secret_key", "")) \
-        if spec.startswith("s3") else sink_for_spec(spec)
+    scheme = spec.partition("://")[0]
+    kw = {}
+    if scheme in ("s3", "gcs", "b2"):
+        kw = {"access_key": flags.get("s3.access_key", ""),
+              "secret_key": flags.get("s3.secret_key", "")}
+        if flags.get("s3.region"):
+            kw["region"] = flags.get("s3.region")
+    elif scheme == "azure":
+        kw = {"account_key": flags.get("azure.account_key", "")}
+    # -sink.endpoint: point a cloud sink at an emulator or
+    # S3-interop proxy instead of the vendor default host.
+    if scheme in ("gcs", "b2", "azure") and flags.get("sink.endpoint"):
+        kw["endpoint"] = flags.get("sink.endpoint")
+    sink = sink_for_spec(spec, **kw)
     repl = Replicator(src, src_dir, sink)
     proxy = FilerProxy(src)
     ck_key = f"replicate.offset.{spec}"
@@ -143,5 +155,5 @@ register(Command(
 register(Command(
     "filer.replicate",
     "filer.replicate -filer=host:8888 -sink=local:///backup",
-    "replicate filer changes to a sink (filer/local/s3)",
+    "replicate filer changes to a sink (filer/local/s3/gcs/b2/azure)",
     run_filer_replicate))

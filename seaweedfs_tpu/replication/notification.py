@@ -5,10 +5,12 @@ GCP Pub/Sub, gocdk) feeding weed/replication/sub/.  The filer publishes
 every meta event to the configured queue; `filer.replicate` consumes the
 queue and drives sinks.
 
-Kafka/SQS/PubSub need network egress + SDKs, so here the in-process
-MemoryQueue and the durable FileQueue (JSONL spool, resumable by offset)
-are real, and the cloud queues are registry stubs behind the same
-interface.
+The in-process MemoryQueue and the durable FileQueue (JSONL spool,
+resumable by offset) are always available; SqsQueue speaks the real AWS
+SQS query API with stdlib HTTP + the in-repo sig v4 signer (no SDK —
+weed/notification/aws_sqs/aws_sqs_pub.go, replication/sub/
+notification_aws_sqs.go).  Kafka and Pub/Sub need broker protocols /
+OAuth SDKs and remain registry stubs behind the same interface.
 """
 
 from __future__ import annotations
@@ -16,6 +18,10 @@ from __future__ import annotations
 import json
 import os
 import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
 from typing import Callable
 
 
@@ -102,16 +108,103 @@ class FileQueue(NotificationQueue):
                     of.write(str(pos))
 
 
-_STUB_QUEUES = ("kafka", "sqs", "pubsub", "gocdk")
+def _xml_findall(root, tag: str):
+    """Namespace-agnostic element search (SQS responses carry the
+    doc namespace; a fake test endpoint may not)."""
+    return [el for el in root.iter() if el.tag.split("}")[-1] == tag]
 
 
-def queue_for_spec(spec: str) -> NotificationQueue:
-    """'memory://', 'file:///path/spool.jsonl'."""
+class SqsQueue(NotificationQueue):
+    """AWS SQS over its HTTP query API — stdlib urllib + the in-repo
+    sig v4 signer, no SDK (weed/notification/aws_sqs).
+
+    Messages carry the same JSON envelope as FileQueue:
+    {"key": ..., "message": ...} so the replicate worker is
+    queue-agnostic.  consume() drains with short-poll ReceiveMessage
+    batches and deletes each message only after fn() returns —
+    at-least-once, like the reference's sqs consumer."""
+
+    API_VERSION = "2012-11-05"
+
+    def __init__(self, queue_url: str, access_key: str = "",
+                 secret_key: str = "", region: str = "us-east-1",
+                 wait_seconds: int = 0):
+        self.queue_url = queue_url.rstrip("/")
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.wait_seconds = wait_seconds
+
+    def _call(self, params: dict) -> ET.Element:
+        body = urllib.parse.urlencode(
+            {**params, "Version": self.API_VERSION}).encode()
+        headers = {"Content-Type":
+                   "application/x-www-form-urlencoded"}
+        if self.access_key:
+            from ..s3api.sigv4 import sign_request
+            headers = sign_request("POST", self.queue_url, headers,
+                                   body, self.access_key,
+                                   self.secret_key, region=self.region,
+                                   service="sqs")
+        req = urllib.request.Request(self.queue_url, data=body,
+                                     method="POST", headers=headers)
+        with urllib.request.urlopen(req, timeout=70) as resp:
+            return ET.fromstring(resp.read() or b"<empty/>")
+
+    def publish(self, key: str, message: dict) -> None:
+        self._call({
+            "Action": "SendMessage",
+            "MessageBody": json.dumps({"key": key, "message": message},
+                                      separators=(",", ":"))})
+
+    def consume(self, fn: Callable[[str, dict], None]) -> None:
+        while True:
+            root = self._call({"Action": "ReceiveMessage",
+                               "MaxNumberOfMessages": "10",
+                               "WaitTimeSeconds":
+                               str(self.wait_seconds)})
+            messages = _xml_findall(root, "Message")
+            if not messages:
+                return
+            for msg in messages:
+                bodies = _xml_findall(msg, "Body")
+                handles = _xml_findall(msg, "ReceiptHandle")
+                if not bodies or not handles:
+                    continue
+                try:
+                    item = json.loads(bodies[0].text or "")
+                except json.JSONDecodeError:
+                    item = None
+                # Anything not carrying our {key, message} envelope is
+                # a poison message (foreign publisher on the same
+                # queue): deliver nothing but still delete, or it
+                # reappears after the visibility timeout and wedges
+                # every future consume() on the same crash.
+                if isinstance(item, dict) and "key" in item \
+                        and "message" in item:
+                    fn(item["key"], item["message"])
+                # Delete AFTER delivery: a crash mid-fn redelivers
+                # (at-least-once), never drops.
+                self._call({"Action": "DeleteMessage",
+                            "ReceiptHandle": handles[0].text or ""})
+
+
+_STUB_QUEUES = ("kafka", "pubsub", "gocdk")
+
+
+def queue_for_spec(spec: str, **kw) -> NotificationQueue:
+    """'memory://', 'file:///path/spool.jsonl',
+    'sqs://sqs.us-east-1.amazonaws.com/123456789012/queue' (keyword
+    args: access_key/secret_key/region; http_endpoint=True for a
+    plain-http test endpoint)."""
     scheme, _, rest = spec.partition("://")
     if scheme == "memory":
         return MemoryQueue()
     if scheme == "file":
         return FileQueue("/" + rest.lstrip("/"))
+    if scheme == "sqs":
+        proto = "http" if kw.pop("http_endpoint", False) else "https"
+        return SqsQueue(f"{proto}://{rest}", **kw)
     if scheme in _STUB_QUEUES:
         raise NotImplementedError(
             f"{scheme} queue needs a broker SDK + egress; add it behind "

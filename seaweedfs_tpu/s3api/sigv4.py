@@ -18,14 +18,16 @@ from .auth import compute_signature_v4
 def sign_request(method: str, url: str, headers: dict[str, str],
                  payload: bytes, access_key: str, secret_key: str,
                  region: str = "us-east-1",
-                 payload_hash: str | None = None) -> dict[str, str]:
+                 payload_hash: str | None = None,
+                 service: str = "s3") -> dict[str, str]:
     """Returns headers + the sig v4 Authorization set for this request.
     Pass a precomputed payload_hash to sign a streamed body without
-    materializing it."""
+    materializing it.  `service` scopes the credential — the same
+    signing core serves S3 and SQS (the notification queue client)."""
     parsed = urllib.parse.urlparse(url)
     amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     date = amz_date[:8]
-    scope = f"{date}/{region}/s3/aws4_request"
+    scope = f"{date}/{region}/{service}/aws4_request"
     if payload_hash is None:
         payload_hash = hashlib.sha256(payload).hexdigest()
     out = dict(headers)

@@ -167,11 +167,18 @@ def test_head_reports_logical_size_for_gzipped_needle(cluster):
     r = client.upload(TEXT, name="fox.txt")
     assert r["is_compressed"]
     locs = client.lookup(int(r["fid"].split(",")[0]))
-    resp, conn = rpc._request(f"http://{locs[0]['url']}/{r['fid']}",
-                              "HEAD", None, 10.0)
+    url = f"http://{locs[0]['url']}/{r['fid']}"
+    resp, conn = rpc._request(url, "HEAD", None, 10.0)
     resp._done = True
     rpc._finish(conn, resp)
     assert int(resp.getheader("content-length")) == len(TEXT)
+    # a gzip-accepting HEAD mirrors the gzip-passthrough GET instead
+    resp, conn = rpc._request(url, "HEAD", None, 10.0,
+                              req_headers={"Accept-Encoding": "gzip"})
+    resp._done = True
+    rpc._finish(conn, resp)
+    assert resp.getheader("content-encoding") == "gzip"
+    assert int(resp.getheader("content-length")) < len(TEXT)
 
 
 def test_mount_honors_filer_cipher(cluster, tmp_path):
