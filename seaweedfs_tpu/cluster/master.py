@@ -16,6 +16,7 @@ HTTP surface mirrors the reference master's API
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 
@@ -645,9 +646,11 @@ class MasterServer:
         return {"deleted_replicas": deleted}
 
     def _cluster_status(self, query: dict, body: bytes) -> dict:
+        from ..stats.sysstats import proc_cpu_seconds
         out = {"leader": self.leader_url(),
                "is_leader": self.is_leader(),
-               "volume_size_limit": self.topo.volume_size_limit}
+               "volume_size_limit": self.topo.volume_size_limit,
+               "cpu_seconds": proc_cpu_seconds(), "pid": os.getpid()}
         if self.raft is not None:
             out["peers"] = [self.url()] + self.raft.peers
             out["raft"] = {"state": self.raft.state,

@@ -676,10 +676,13 @@ class VolumeServer:
                     "garbage_ratio": v.garbage_ratio(),
                     "read_only": v.readonly,
                 })
+        from ..stats.sysstats import proc_cpu_seconds
         return {"volumes": volumes,
                 "ec_volumes": [
                     {"id": vid, "shards": sorted(ev.shards)}
-                    for vid, ev in self.ec_volumes.items()]}
+                    for vid, ev in self.ec_volumes.items()],
+                "cpu_seconds": proc_cpu_seconds(),
+                "pid": os.getpid()}
 
     def _admin_assign_volume(self, query: dict, body: bytes) -> dict:
         req = json.loads(body)

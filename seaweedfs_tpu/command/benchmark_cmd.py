@@ -37,7 +37,8 @@ class _Stats:
         with self.lock:
             self.errors += 1
 
-    def report(self, title: str, wall: float) -> dict:
+    def report(self, title: str, wall: float,
+               cpu: dict | None = None) -> dict:
         lat = sorted(self.latencies_ms)
         n = len(lat)
 
@@ -56,6 +57,17 @@ class _Stats:
                 "max": round(lat[-1], 2) if n else 0.0,
             },
         }
+        if cpu is not None:
+            total = cpu.get("client_s", 0.0) + cpu.get("server_s", 0.0)
+            out["cpu"] = {
+                "client_s": round(cpu.get("client_s", 0.0), 3),
+                "server_s": round(cpu.get("server_s", 0.0), 3),
+                "total_s": round(total, 3),
+                "req_per_core_sec": round(n / total, 1)
+                if total > 0 else 0.0,
+                "cpu_us_per_req": round(total / n * 1e6, 1)
+                if n else 0.0,
+            }
         print(f"\n--- {title} ---")
         print(f"requests      {n}  (errors {self.errors})")
         print(f"time          {out['seconds']} s")
@@ -64,6 +76,12 @@ class _Stats:
         lm = out["latency_ms"]
         print(f"latency ms    avg {lm['avg']}  p50 {lm['p50']}  "
               f"p90 {lm['p90']}  p99 {lm['p99']}  max {lm['max']}")
+        if cpu is not None and out.get("cpu"):
+            c = out["cpu"]
+            print(f"cpu           client {c['client_s']}s + servers "
+                  f"{c['server_s']}s = {c['total_s']}s  ->  "
+                  f"{c['req_per_core_sec']} req/core-sec  "
+                  f"({c['cpu_us_per_req']} us CPU/req)")
         return out
 
 
@@ -112,14 +130,57 @@ def _mp_worker(outq, barrier, master: str, phase: str, count: int,
             target=w_read, args=(c, random.Random(seed * 1000 + i)),
             daemon=True) for i, c in enumerate(counts) if c]
     barrier.wait()
+    import resource
+    ru0 = resource.getrusage(resource.RUSAGE_SELF)
     t0 = time.perf_counter()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    ru1 = resource.getrusage(resource.RUSAGE_SELF)
     outq.put({"lat": stats.latencies_ms, "bytes": stats.bytes,
               "errors": stats.errors, "fids": fids,
-              "wall": time.perf_counter() - t0})
+              "wall": time.perf_counter() - t0,
+              "cpu": (ru1.ru_utime + ru1.ru_stime)
+              - (ru0.ru_utime + ru0.ru_stime)})
+
+
+def _server_cpus(master: str) -> dict[int, float]:
+    """pid -> cpu_seconds for every reachable server process (master +
+    volume servers from /vol/list).  Keyed by pid so co-located roles
+    (weed server all-in-one, in-process tests) are never double-counted.
+    The per-request CPU breakdown is what makes the reference's
+    multi-core req/s comparable to a 1-core run (BASELINE.md)."""
+    from ..cluster import rpc
+    out: dict[int, float] = {}
+    try:
+        st = rpc.call(f"{master}/cluster/status")
+        if "pid" in st:
+            out[st["pid"]] = st["cpu_seconds"]
+    except Exception:  # noqa: BLE001 — cpu sampling is best-effort
+        pass
+    try:
+        vl = rpc.call(f"{master}/vol/list")
+        urls = {n["url"]
+                for dc in vl.get("topology", {}).get("data_centers", [])
+                for rack in dc.get("racks", [])
+                for n in rack.get("nodes", [])}
+        for u in urls:
+            try:
+                st = rpc.call(f"http://{u}/admin/status")
+                if "pid" in st:
+                    out[st["pid"]] = st["cpu_seconds"]
+            except Exception:  # noqa: BLE001
+                pass
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def _cpu_delta(before: dict[int, float],
+               after: dict[int, float]) -> float:
+    return sum(after[pid] - before[pid]
+               for pid in after if pid in before)
 
 
 def run_benchmark(flags: Flags, args: list[str],
@@ -133,10 +194,12 @@ def run_benchmark(flags: Flags, args: list[str],
     procs = flags.get_int("procs", 4 if concurrency >= 8 else 1)
     do_write = flags.get("write", "true").lower() != "false"
     do_read = flags.get("read", "true").lower() != "false"
+    sample_cpu = flags.get("cpu", "true").lower() != "false"
     collection = flags.get("collection", "")
     if procs > 1:
         return _run_benchmark_mp(master, n, size, concurrency, procs,
-                                 do_write, do_read, collection, reports)
+                                 do_write, do_read, collection, reports,
+                                 sample_cpu)
     client = WeedClient(master)
     payload = random.Random(7).randbytes(size)
     fids: list[str] = []
@@ -169,6 +232,7 @@ def run_benchmark(flags: Flags, args: list[str],
             stats.add(time.perf_counter() - t0, len(data))
 
     def run_phase(fn, title: str, extra_args=()) -> None:
+        import resource
         stats = _Stats()
         per = n // concurrency
         counts = [per + (1 if i < n % concurrency else 0)
@@ -176,12 +240,30 @@ def run_benchmark(flags: Flags, args: list[str],
         threads = [threading.Thread(
             target=fn, args=(c, stats, *extra_args), daemon=True)
             for c in counts if c]
+        import os
+        srv0 = _server_cpus(master) if sample_cpu else {}
+        ru0 = resource.getrusage(resource.RUSAGE_SELF)
         t0 = time.perf_counter()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        out = stats.report(title, time.perf_counter() - t0)
+        wall = time.perf_counter() - t0
+        cpu = None
+        if sample_cpu:
+            ru1 = resource.getrusage(resource.RUSAGE_SELF)
+            srv1 = _server_cpus(master)
+            client_cpu = (ru1.ru_utime + ru1.ru_stime) \
+                - (ru0.ru_utime + ru0.ru_stime)
+            me = os.getpid()
+            if me in srv1:
+                # In-process servers (tests): their CPU is already
+                # inside the client rusage; don't count twice.
+                srv0.pop(me, None)
+                srv1.pop(me, None)
+            cpu = {"client_s": client_cpu,
+                   "server_s": _cpu_delta(srv0, srv1)}
+        out = stats.report(title, wall, cpu)
         if reports is not None:
             reports.append(out)
 
@@ -200,8 +282,8 @@ def run_benchmark(flags: Flags, args: list[str],
 
 def _run_benchmark_mp(master: str, n: int, size: int, concurrency: int,
                       procs: int, do_write: bool, do_read: bool,
-                      collection: str,
-                      reports: list | None) -> int:
+                      collection: str, reports: list | None,
+                      sample_cpu: bool = True) -> int:
     """Spawn `procs` load processes per phase and merge their stats."""
     import multiprocessing as mp
     ctx = mp.get_context("spawn")  # safe even if the parent touched jax
@@ -224,21 +306,28 @@ def _run_benchmark_mp(master: str, n: int, size: int, concurrency: int,
         for w in workers:
             w.start()
         barrier.wait()  # everyone imported and connected; go
+        srv0 = _server_cpus(master) if sample_cpu else {}
         t0 = time.perf_counter()
         stats = _Stats()
         fids: list[str] = []
+        client_cpu = 0.0
         for _ in workers:
             out = outq.get()
             stats.latencies_ms.extend(out["lat"])
             stats.bytes += out["bytes"]
             stats.errors += out["errors"]
             fids.extend(out["fids"])
+            client_cpu += out.get("cpu", 0.0)
         wall = time.perf_counter() - t0
         for w in workers:
             w.join()
+        cpu = None
+        if sample_cpu:
+            cpu = {"client_s": client_cpu,
+                   "server_s": _cpu_delta(srv0, _server_cpus(master))}
         title = "write" if phase == "write" else "random read"
         rep = stats.report(f"{title} ({procs} procs x "
-                           f"{nthreads} threads)", wall)
+                           f"{nthreads} threads)", wall, cpu)
         if reports is not None:
             reports.append(rep)
         return fids

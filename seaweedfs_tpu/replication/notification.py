@@ -17,8 +17,8 @@ from __future__ import annotations
 
 import json
 import os
+import queue as _queue
 import threading
-import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
@@ -125,6 +125,13 @@ class SqsQueue(NotificationQueue):
     at-least-once, like the reference's sqs consumer."""
 
     API_VERSION = "2012-11-05"
+    # publish() must never block the caller on the network: the filer
+    # publishes under its meta-log lock, so a slow endpoint would stall
+    # every namespace mutation.  Sends ride an in-order spool drained by
+    # one background thread; past this bound events are dropped (with a
+    # counter) rather than backpressuring the filer — the durable
+    # FileQueue is the right choice when loss is unacceptable.
+    SPOOL_MAX = 65536
 
     def __init__(self, queue_url: str, access_key: str = "",
                  secret_key: str = "", region: str = "us-east-1",
@@ -134,6 +141,11 @@ class SqsQueue(NotificationQueue):
         self.secret_key = secret_key
         self.region = region
         self.wait_seconds = wait_seconds
+        self.dropped = 0
+        self._spool: "_queue.Queue[dict | None]" = \
+            _queue.Queue(maxsize=self.SPOOL_MAX)
+        self._sender: threading.Thread | None = None
+        self._sender_lock = threading.Lock()
 
     def _call(self, params: dict) -> ET.Element:
         body = urllib.parse.urlencode(
@@ -151,13 +163,62 @@ class SqsQueue(NotificationQueue):
         with urllib.request.urlopen(req, timeout=70) as resp:
             return ET.fromstring(resp.read() or b"<empty/>")
 
+    def _ensure_sender(self) -> None:
+        with self._sender_lock:
+            if self._sender is None or not self._sender.is_alive():
+                self._sender = threading.Thread(
+                    target=self._send_loop, daemon=True,
+                    name="sqs-sender")
+                self._sender.start()
+
+    def _send_loop(self) -> None:
+        while True:
+            item = self._spool.get()
+            if item is None:
+                return
+            try:
+                self._call(item)
+            except Exception:  # noqa: BLE001 — a dead endpoint drops
+                self.dropped += 1  # the event; never wedges the loop
+            finally:
+                self._spool.task_done()
+
     def publish(self, key: str, message: dict) -> None:
-        self._call({
+        params = {
             "Action": "SendMessage",
             "MessageBody": json.dumps({"key": key, "message": message},
-                                      separators=(",", ":"))})
+                                      separators=(",", ":"))}
+        self._ensure_sender()
+        try:
+            self._spool.put_nowait(params)
+        except _queue.Full:
+            self.dropped += 1
+
+    def flush(self, timeout: float | None = None) -> None:
+        """Block until every spooled publish has been attempted (tests,
+        graceful shutdown).  `timeout` bounds the wait."""
+        if timeout is None:
+            self._spool.join()
+            return
+        deadline = threading.Event()
+        t = threading.Thread(target=lambda: (self._spool.join(),
+                                             deadline.set()),
+                             daemon=True)
+        t.start()
+        deadline.wait(timeout)
+
+    def close(self) -> None:
+        if self._sender is not None and self._sender.is_alive():
+            self.flush(timeout=5.0)
+            self._spool.put(None)
 
     def consume(self, fn: Callable[[str, dict], None]) -> None:
+        # Short polling (wait_seconds=0) samples a subset of SQS
+        # backend hosts and can return empty while messages remain, so
+        # "drained" needs consecutive empty receives; one empty long
+        # poll is already authoritative.
+        drained_after = 1 if self.wait_seconds > 0 else 3
+        empty = 0
         while True:
             root = self._call({"Action": "ReceiveMessage",
                                "MaxNumberOfMessages": "10",
@@ -165,7 +226,11 @@ class SqsQueue(NotificationQueue):
                                str(self.wait_seconds)})
             messages = _xml_findall(root, "Message")
             if not messages:
-                return
+                empty += 1
+                if empty >= drained_after:
+                    return
+                continue
+            empty = 0
             for msg in messages:
                 bodies = _xml_findall(msg, "Body")
                 handles = _xml_findall(msg, "ReceiptHandle")

@@ -214,9 +214,12 @@ class GcsSink(S3Sink):
 
     def __init__(self, bucket: str, directory: str = "/",
                  access_key: str = "", secret_key: str = "",
-                 endpoint: str = "https://storage.googleapis.com"):
+                 endpoint: str = "https://storage.googleapis.com",
+                 region: str = "auto"):
+        # GCS's interop endpoint accepts any scope region; "auto" is
+        # the documented default for sig v4 against storage.googleapis.
         super().__init__(endpoint, bucket, directory,
-                         access_key, secret_key)
+                         access_key, secret_key, region=region)
 
 
 class B2Sink(S3Sink):

@@ -18,6 +18,17 @@ def disk_status(path: str) -> dict:
             "percent_used": (used / total * 100.0) if total else 0.0}
 
 
+def proc_cpu_seconds() -> float:
+    """CPU seconds (user+system) consumed by this process so far.
+    Exposed by every server's status endpoint so `weed benchmark
+    -cpu=true` can sample server-side cost around a load phase and
+    report requests per core-second — the hardware-independent number
+    the multi-core reference baseline is compared against."""
+    import resource
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return ru.ru_utime + ru.ru_stime
+
+
 def memory_status() -> dict:
     """Process memory from /proc/self/status (memory.go)."""
     out = {"rss": 0, "vms": 0}

@@ -140,3 +140,31 @@ def test_benchmark_command(stack):
     flags, rest = parse_flags(
         [f"-master={host}", "-n=32", "-size=256", "-c=4"])
     assert COMMANDS["benchmark"].run(flags, rest) == 0
+
+
+def test_benchmark_cpu_accounting(stack):
+    """-cpu=true (default) reports requests per core-second — the
+    hardware-independent number BASELINE.md compares against the
+    reference's multi-core req/s."""
+    from seaweedfs_tpu.command.benchmark_cmd import run_benchmark
+    from seaweedfs_tpu.command import parse_flags
+    master, _vs, _c = stack
+    host = master.url().replace("http://", "")
+    flags, rest = parse_flags(
+        [f"-master={host}", "-n=24", "-size=256", "-c=4", "-procs=1"])
+    reports: list = []
+    assert run_benchmark(flags, rest, reports) == 0
+    assert len(reports) == 2  # write + read
+    for rep in reports:
+        cpu = rep["cpu"]
+        # In-process servers: all cost lands in client CPU (pid-deduped)
+        assert cpu["total_s"] > 0
+        assert cpu["req_per_core_sec"] > 0
+        assert cpu["cpu_us_per_req"] > 0
+    # -cpu=false suppresses the section
+    flags, rest = parse_flags(
+        [f"-master={host}", "-n=8", "-size=64", "-c=2", "-procs=1",
+         "-cpu=false"])
+    reports2: list = []
+    assert run_benchmark(flags, rest, reports2) == 0
+    assert all("cpu" not in r for r in reports2)

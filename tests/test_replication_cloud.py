@@ -72,6 +72,7 @@ def test_sqs_publish_signs_and_sends(endpoint):
     q = SqsQueue(f"http://127.0.0.1:{srv.port}/12345/events",
                  access_key=AK, secret_key=SK, region="us-east-1")
     q.publish("/buckets/b/x.txt", {"op": "create"})
+    q.flush(timeout=10.0)
     path, query, body = seen[0]
     assert path == "/12345/events"
     params = dict(p.split("=", 1) for p in
@@ -114,6 +115,19 @@ def test_sqs_consume_delivers_then_deletes(endpoint):
     assert ("DeleteMessage", "rh-42") in actions
     # delete came AFTER the delivery receive
     assert actions[0][0] == "ReceiveMessage"
+
+
+def test_sqs_publish_never_blocks_caller():
+    """The filer publishes under its meta-log lock: a dead/black-holed
+    endpoint must not stall the caller — sends ride the async spool."""
+    import time
+    q = SqsQueue("http://10.255.255.1:9/1/q", access_key=AK,
+                 secret_key=SK)
+    t0 = time.perf_counter()
+    for i in range(50):
+        q.publish(f"/k{i}", {"n": i})
+    assert time.perf_counter() - t0 < 0.5
+    q.close()
 
 
 def test_queue_spec_routing(tmp_path):
@@ -275,6 +289,7 @@ def test_replicate_through_sqs(endpoint, tmp_path):
     q = SqsQueue(f"http://127.0.0.1:{srv.port}/1/q",
                  access_key=AK, secret_key=SK)
     q.publish("/x.txt", {"event": "create"})
+    q.flush(timeout=10.0)
     # replay what the fake captured as a ReceiveMessage response
     params = dict(p.split("=", 1) for p in
                   seen[0][2].decode().split("&") if "=" in p)
