@@ -288,6 +288,11 @@ def store_for_path(path: str | None) -> FilerStore:
     if cfg.get_bool("ordered_kv.enabled"):
         from .ordered_kv import OrderedKvStore
         return OrderedKvStore(cfg.get_string("ordered_kv.dir") or path)
+    if cfg.get_bool("sharded_kv.enabled"):
+        # The leveldb2 analog: 8-way dir-hash sharding for spread
+        # compaction/write load on big namespaces.
+        from .ordered_kv import ShardedKvStore
+        return ShardedKvStore(cfg.get_string("sharded_kv.dir") or path)
     if cfg.get_bool("sqlite.enabled"):
         return SqliteStore(cfg.get_string("sqlite.file") or path)
     import os

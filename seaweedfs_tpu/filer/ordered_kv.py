@@ -22,6 +22,7 @@ The filer KV plane rides the same engine under ``K<key>``.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import struct
@@ -303,3 +304,70 @@ class OrderedKvStore(FilerStore):
 
     def close(self) -> None:
         self.kv.close()
+
+
+class ShardedKvStore(FilerStore):
+    """N OrderedKv stores sharded by parent-directory hash — the
+    reference's leveldb2 backend (weed/filer/leveldb2/leveldb2_store.go:
+    md5(dir) picks one of 8 dbs).  A directory's direct children always
+    colocate, so finds and listings touch exactly one shard while write
+    load and compaction spread across all of them.  Subtree deletes fan
+    the range delete to every shard: descendants live wherever their own
+    parent hashed."""
+
+    name = "sharded_kv"
+    SHARDS = 8
+
+    def __init__(self, directory: str, shards: int = SHARDS, **kw):
+        os.makedirs(directory, exist_ok=True)
+        self.shards = [OrderedKvStore(os.path.join(directory, f"{i:02d}"),
+                                      **kw)
+                       for i in range(shards)]
+
+    def _shard_for_dir(self, d: str) -> OrderedKvStore:
+        h = hashlib.md5(d.encode()).digest()
+        return self.shards[h[0] % len(self.shards)]
+
+    def _shard(self, path: str) -> OrderedKvStore:
+        path = _norm(path)
+        d = "/" if path == "/" else (path.rsplit("/", 1)[0] or "/")
+        return self._shard_for_dir(d)
+
+    def insert_entry(self, entry: Entry) -> None:
+        self._shard(entry.path).insert_entry(entry)
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Entry:
+        return self._shard(path).find_entry(path)
+
+    def delete_entry(self, path: str) -> None:
+        self._shard(path).delete_entry(path)
+
+    def delete_folder_children(self, path: str) -> None:
+        for s in self.shards:
+            s.delete_folder_children(path)
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str,
+                               include_start: bool,
+                               limit: int) -> list[Entry]:
+        return self._shard_for_dir(_norm(dir_path)) \
+            .list_directory_entries(dir_path, start_file_name,
+                                    include_start, limit)
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        self._kv_shard(key).kv_put(key, value)
+
+    def kv_get(self, key: str) -> bytes | None:
+        return self._kv_shard(key).kv_get(key)
+
+    def kv_delete(self, key: str) -> None:
+        self._kv_shard(key).kv_delete(key)
+
+    def _kv_shard(self, key: str) -> OrderedKvStore:
+        h = hashlib.md5(key.encode()).digest()
+        return self.shards[h[0] % len(self.shards)]
+
+    def close(self) -> None:
+        for s in self.shards:
+            s.close()

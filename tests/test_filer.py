@@ -118,7 +118,8 @@ def test_total_size():
 
 # -- store conformance -------------------------------------------------------
 
-@pytest.fixture(params=["memory", "sqlite", "sqlite-file", "ordered_kv"])
+@pytest.fixture(params=["memory", "sqlite", "sqlite-file", "ordered_kv",
+                        "sharded_kv"])
 def store(request, tmp_path):
     if request.param == "memory":
         s = MemoryStore()
@@ -127,6 +128,9 @@ def store(request, tmp_path):
     elif request.param == "ordered_kv":
         from seaweedfs_tpu.filer.ordered_kv import OrderedKvStore
         s = OrderedKvStore(str(tmp_path / "okv"))
+    elif request.param == "sharded_kv":
+        from seaweedfs_tpu.filer.ordered_kv import ShardedKvStore
+        s = ShardedKvStore(str(tmp_path / "skv"), shards=4)
     else:
         s = SqliteStore(str(tmp_path / "filer.db"))
     yield s
