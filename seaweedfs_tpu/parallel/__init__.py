@@ -8,4 +8,8 @@ Maps the reference's distributed mechanisms onto a TPU pod mesh
   -> `lax.all_to_all` resharding of survivor rows over ICI
 - batched multi-volume rebuild (shell ec.rebuild over many volumes)
   -> one pjit'd batched GF(2) matmul, volumes data-parallel over the mesh
+- few-shard rebuild with shard-major survivors
+  -> `sharded_codec.ring_reconstruct`: ppermute ring reduce-scatter of
+     partial products (the ring-attention rotate-and-accumulate shape);
+     moves W·N instead of (K/D)·N per chip — wins for W small
 """
