@@ -159,6 +159,7 @@ class MasterServer:
         # leader owns id issuance, followers proxy mutating requests
         # (server/raft_server.go, master_server.go:155).
         self.raft = None
+        self._seq_ceiling = 0  # raft-committed file-id ceiling
         self._raft_id = f"http://{self.server.host}:{self.server.port}"
         self._id_lock = threading.Lock()
         if peers:
@@ -185,23 +186,48 @@ class MasterServer:
                 state_path=f"{meta_dir}/raft.json" if meta_dir else None)
             self.raft.mount(self.server)
             self.topo.next_volume_id_hook = self._next_volume_id_raft
+            # HA file-id issuance: swap in the consensus-backed block
+            # sequencer (the etcd-sequencer analog) so a failover can
+            # never re-issue a committed id range.
+            from ..topology.sequence import RaftSequencer
+            self.topo.sequencer = RaftSequencer(self._alloc_seq_block)
 
     # -- raft ----------------------------------------------------------------
 
     def _raft_apply(self, cmd: dict) -> None:
         if cmd.get("op") == "max_volume_id":
             self.topo.set_max_volume_id(cmd["value"])
+        elif cmd.get("op") == "seq_ceiling":
+            self._seq_ceiling = max(self._seq_ceiling, cmd["value"])
+
+    def _alloc_seq_block(self, min_start: int, n: int) -> int:
+        """Commit a file-id block [start, start+n) through the raft log
+        (RaftSequencer's alloc_fn).  Same fencing discipline as volume
+        ids: barrier first so a fresh leader sees every inherited
+        ceiling before computing the next one."""
+        from .raft import NotLeader
+        with self._id_lock:
+            if not self.raft.is_leader():
+                raise NotLeader(self.raft.leader())
+            self.raft.barrier()
+            start = max(self._seq_ceiling, min_start)
+            self.raft.propose({"op": "seq_ceiling", "value": start + n})
+            return start
 
     def _raft_snapshot(self) -> dict:
-        """State-machine snapshot for raft log compaction: the whole
-        replicated state is the id watermark."""
+        """State-machine snapshot for raft log compaction: the
+        replicated state is the two id watermarks."""
         with self.topo._lock:
             return {"max_volume_id": max(self.topo._max_volume_id,
-                                         self.topo.max_volume_id)}
+                                         self.topo.max_volume_id),
+                    "seq_ceiling": self._seq_ceiling}
 
     def _raft_restore(self, state: dict) -> None:
         if state.get("max_volume_id"):
             self.topo.set_max_volume_id(state["max_volume_id"])
+        if state.get("seq_ceiling"):
+            self._seq_ceiling = max(self._seq_ceiling,
+                                    state["seq_ceiling"])
 
     def _raft_membership(self, query: dict, body: bytes) -> dict:
         """POST /cluster/raft/{add,remove}?peer=host:port — one-server-
@@ -514,7 +540,16 @@ class MasterServer:
                     if grown == 0:
                         raise rpc.RpcError(
                             406, "no free volumes and cannot grow")
-        fid, count, locs = self.topo.pick_for_write(count, option)
+        try:
+            fid, count, locs = self.topo.pick_for_write(count, option)
+        except NotLeader:
+            # The RaftSequencer's block alloc can discover lost
+            # leadership (exactly the failover window it exists for):
+            # hand the request to the new leader like the grow path.
+            return self._proxy_to_leader("/dir/assign", query, body)
+        except TimeoutError as e:
+            raise rpc.RpcError(
+                503, f"file-id allocation not committed: {e}") from None
         dn = locs[0]
         out = {"fid": fid, "count": count,
                "url": dn.url(), "publicUrl": dn.public_url,

@@ -94,6 +94,35 @@ def batched_reconstruct(stacked, present: tuple[int, ...],
     return _reconstruct_batch(pm, stacked, len(wanted))
 
 
+def _shard_major_prep(stacked, present, wanted, mesh,
+                      data_shards, parity_shards, matrix_kind):
+    """Shared prologue for the shard-major reconstruction paths:
+    decode bit-matrix in plane-major bf16, survivors validated and
+    placed (vol, col, None) on the mesh.  Returns
+    (pm, stacked, n_axis_chips, chunk_bytes)."""
+    total = data_shards + parity_shards
+    bmat, _used = rs_bitmatrix.decode_bitmatrix(
+        data_shards, total, tuple(present), tuple(wanted), matrix_kind)
+    pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted),
+                                 data_shards), jnp.bfloat16)
+    n_axis = mesh.shape["col"]
+    if data_shards % n_axis != 0:
+        raise ValueError(
+            f"data_shards {data_shards} must divide over mesh col axis "
+            f"{n_axis}")
+    stacked = jnp.asarray(stacked, jnp.uint8)
+    _v, s, n = stacked.shape
+    if s != data_shards:
+        raise ValueError(
+            f"stacked must carry the {data_shards} used survivor rows, "
+            f"got {s}")
+    if n % n_axis != 0:
+        raise ValueError(f"byte length {n} must divide over {n_axis}")
+    stacked = jax.device_put(
+        stacked, NamedSharding(mesh, P("vol", "col", None)))
+    return pm, stacked, n_axis, n // n_axis
+
+
 def all_to_all_reconstruct(stacked, present: tuple[int, ...],
                            wanted: tuple[int, ...], mesh: Mesh,
                            data_shards: int = 10, parity_shards: int = 4,
@@ -108,30 +137,11 @@ def all_to_all_reconstruct(stacked, present: tuple[int, ...],
     then each chip solves its column block locally and the output comes
     back column-sharded.
     """
-    total = data_shards + parity_shards
-    bmat, _used = rs_bitmatrix.decode_bitmatrix(
-        data_shards, total, tuple(present), tuple(wanted), matrix_kind)
-    pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted), data_shards),
-                     jnp.bfloat16)
-
-    n_shard_chips = mesh.shape["col"]
-    if data_shards % n_shard_chips != 0:
-        raise ValueError(
-            f"data_shards {data_shards} must divide over mesh col axis "
-            f"{n_shard_chips}")
-
-    stacked = jnp.asarray(stacked, jnp.uint8)
-    v, s, n = stacked.shape
-    if s != data_shards:
-        raise ValueError(
-            f"stacked must carry the {data_shards} used survivor rows, "
-            f"got {s}")
-    if n % n_shard_chips != 0:
-        raise ValueError(f"byte length {n} must divide over {n_shard_chips}")
-    stacked = jax.device_put(
-        stacked, NamedSharding(mesh, P("vol", "col", None)))
-
+    pm, stacked, n_shard_chips, _chunk = _shard_major_prep(
+        stacked, present, wanted, mesh, data_shards, parity_shards,
+        matrix_kind)
     wanted_count = len(wanted)
+    s = data_shards
 
     def local(block):  # block: (v_loc, s/D, N) on each chip
         # Reshard: split columns D-ways, trade shard rows for column blocks.
@@ -177,31 +187,11 @@ def ring_reconstruct(stacked, present: tuple[int, ...],
     all_to_all on a D=4 axis at K=10.  Compute is also strictly local:
     each chip does 1/D of the matmul, no redundant work.
     """
-    total = data_shards + parity_shards
-    bmat, _used = rs_bitmatrix.decode_bitmatrix(
-        data_shards, total, tuple(present), tuple(wanted), matrix_kind)
-    pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted),
-                                 data_shards), jnp.bfloat16)
+    pm, stacked, n_ring, chunk = _shard_major_prep(
+        stacked, present, wanted, mesh, data_shards, parity_shards,
+        matrix_kind)
     wanted_count = len(wanted)
-
-    n_ring = mesh.shape["col"]
-    if data_shards % n_ring != 0:
-        raise ValueError(
-            f"data_shards {data_shards} must divide over mesh col axis "
-            f"{n_ring}")
     rows_local = data_shards // n_ring
-
-    stacked = jnp.asarray(stacked, jnp.uint8)
-    v, s, n = stacked.shape
-    if s != data_shards:
-        raise ValueError(
-            f"stacked must carry the {data_shards} used survivor rows, "
-            f"got {s}")
-    if n % n_ring != 0:
-        raise ValueError(f"byte length {n} must divide over {n_ring}")
-    chunk = n // n_ring
-    stacked = jax.device_put(
-        stacked, NamedSharding(mesh, P("vol", "col", None)))
 
     # Plane-major columns are s*K + j; reshaped (8W, 8, K) the last axis
     # is the input-shard index, so a chip's row block [d*L, (d+1)*L) is

@@ -420,3 +420,23 @@ def test_membership_add_and_remove_server(tmp_path):
         except Exception:
             pass
         s4.stop()
+
+
+def test_master_ha_file_id_sequencer_across_failover(ha_cluster):
+    """RaftSequencer (the etcd-sequencer analog): file-id blocks commit
+    through the raft log, so a new leader never re-issues ids the old
+    leader handed out — even with no heartbeat max_file_key floor."""
+    from seaweedfs_tpu.topology.sequence import RaftSequencer
+    masters, _vs = ha_cluster
+    leader = _wait_master_leader(masters)
+    assert isinstance(leader.topo.sequencer, RaftSequencer)
+    first = [leader.topo.sequencer.next_file_id() for _ in range(5)]
+    assert sorted(set(first)) == first  # strictly increasing, unique
+    # fail the leader over
+    leader.stop()
+    rest = [m for m in masters if m is not leader]
+    new_leader = _wait_master_leader(rest)
+    second = [new_leader.topo.sequencer.next_file_id()
+              for _ in range(5)]
+    assert min(second) > max(first), (first, second)
+    assert len(set(first + second)) == len(first) + len(second)
