@@ -8,9 +8,10 @@ queue and drives sinks.
 The in-process MemoryQueue and the durable FileQueue (JSONL spool,
 resumable by offset) are always available; SqsQueue speaks the real AWS
 SQS query API with stdlib HTTP + the in-repo sig v4 signer (no SDK —
-weed/notification/aws_sqs/aws_sqs_pub.go, replication/sub/
-notification_aws_sqs.go).  Kafka and Pub/Sub need broker protocols /
-OAuth SDKs and remain registry stubs behind the same interface.
+weed/notification/aws_sqs/aws_sqs_pub.go), and KafkaQueue (kafka.py)
+speaks the Kafka wire protocol directly over TCP.  Pub/Sub needs
+OAuth/RSA service-account auth and remains a registry stub behind the
+same interface.
 """
 
 from __future__ import annotations
@@ -254,11 +255,12 @@ class SqsQueue(NotificationQueue):
                             "ReceiptHandle": handles[0].text or ""})
 
 
-_STUB_QUEUES = ("kafka", "pubsub", "gocdk")
+_STUB_QUEUES = ("pubsub", "gocdk")
 
 
 def queue_for_spec(spec: str, **kw) -> NotificationQueue:
     """'memory://', 'file:///path/spool.jsonl',
+    'kafka://broker:9092/topic',
     'sqs://sqs.us-east-1.amazonaws.com/123456789012/queue' (keyword
     args: access_key/secret_key/region; http_endpoint=True for a
     plain-http test endpoint)."""
@@ -267,11 +269,15 @@ def queue_for_spec(spec: str, **kw) -> NotificationQueue:
         return MemoryQueue()
     if scheme == "file":
         return FileQueue("/" + rest.lstrip("/"))
+    if scheme == "kafka":
+        bootstrap, _, topic = rest.partition("/")
+        from .kafka import KafkaQueue
+        return KafkaQueue(bootstrap, topic or "seaweedfs", **kw)
     if scheme == "sqs":
         proto = "http" if kw.pop("http_endpoint", False) else "https"
         return SqsQueue(f"{proto}://{rest}", **kw)
     if scheme in _STUB_QUEUES:
         raise NotImplementedError(
-            f"{scheme} queue needs a broker SDK + egress; add it behind "
-            f"NotificationQueue (see weed/notification/{scheme})")
+            f"{scheme} queue needs an OAuth/RSA SDK + egress; add it "
+            f"behind NotificationQueue (see weed/notification/{scheme})")
     raise ValueError(f"unknown queue spec: {spec}")

@@ -134,9 +134,8 @@ def test_queue_spec_routing(tmp_path):
     q = queue_for_spec("sqs://h/1/q", access_key=AK, secret_key=SK,
                        http_endpoint=True)
     assert isinstance(q, SqsQueue) and q.queue_url == "http://h/1/q"
-    for stub in ("kafka://b/t", "pubsub://p/t"):
-        with pytest.raises(NotImplementedError):
-            queue_for_spec(stub)
+    with pytest.raises(NotImplementedError):
+        queue_for_spec("pubsub://p/t")
 
 
 # -- sinks -----------------------------------------------------------------
