@@ -207,7 +207,9 @@ def decode_record_batches(buf: bytes,
             klen = rr.varint()
             key = None if klen < 0 else rr.raw(klen)
             vlen = rr.varint()
-            value = rr.raw(vlen)
+            # vlen < 0 is a tombstone (compacted-topic delete): raw(-1)
+            # would slurp the rest of the record as the "value".
+            value = None if vlen < 0 else rr.raw(vlen)
             # headers skipped (count then pairs) — we produce none and
             # ignore any a foreign producer added
             out.append((base_offset + off_delta, key, value))
@@ -434,18 +436,23 @@ class KafkaQueue(NotificationQueue):
             for offset, _key, value in batch:
                 if offset < self._offset:
                     continue  # broker returns from batch start
-                try:
-                    doc = json.loads(value)
-                except json.JSONDecodeError:
-                    doc = None
+                doc = None
+                if value is not None:  # tombstones aren't our envelope
+                    try:
+                        doc = json.loads(value)
+                    except json.JSONDecodeError:
+                        pass
                 if isinstance(doc, dict) and "key" in doc \
                         and "message" in doc:
                     fn(doc["key"], doc["message"])
                 self._offset = offset + 1
                 delivered = True
-                self._save_offset()
             if not delivered:
                 return
+            # One checkpoint per drained batch: a crash mid-batch
+            # redelivers the batch (at-least-once), and the hot loop
+            # isn't N file rewrites for N records.
+            self._save_offset()
 
     def _earliest_offset(self) -> int:
         """ListOffsets v1 with timestamp=-2 (earliest)."""

@@ -8,10 +8,12 @@ queue and drives sinks.
 The in-process MemoryQueue and the durable FileQueue (JSONL spool,
 resumable by offset) are always available; SqsQueue speaks the real AWS
 SQS query API with stdlib HTTP + the in-repo sig v4 signer (no SDK —
-weed/notification/aws_sqs/aws_sqs_pub.go), and KafkaQueue (kafka.py)
-speaks the Kafka wire protocol directly over TCP.  Pub/Sub needs
-OAuth/RSA service-account auth and remains a registry stub behind the
-same interface.
+weed/notification/aws_sqs/aws_sqs_pub.go), KafkaQueue (kafka.py)
+speaks the Kafka wire protocol directly over TCP, and PubSubQueue
+(pubsub.py) speaks the Pub/Sub REST API with RS256 service-account
+auth from libcrypto — all three broker queues are real.  gocdk, the
+reference's Go-Cloud-Development-Kit portability shim over those same
+brokers, stays a registry stub (it is Go-ecosystem glue, not a broker).
 """
 
 from __future__ import annotations
@@ -255,7 +257,7 @@ class SqsQueue(NotificationQueue):
                             "ReceiptHandle": handles[0].text or ""})
 
 
-_STUB_QUEUES = ("pubsub", "gocdk")
+_STUB_QUEUES = ("gocdk",)
 
 
 def queue_for_spec(spec: str, **kw) -> NotificationQueue:
@@ -276,8 +278,12 @@ def queue_for_spec(spec: str, **kw) -> NotificationQueue:
     if scheme == "sqs":
         proto = "http" if kw.pop("http_endpoint", False) else "https"
         return SqsQueue(f"{proto}://{rest}", **kw)
+    if scheme == "pubsub":
+        project, _, topic = rest.partition("/")
+        from .pubsub import PubSubQueue
+        return PubSubQueue(project, topic or "seaweedfs", **kw)
     if scheme in _STUB_QUEUES:
         raise NotImplementedError(
-            f"{scheme} queue needs an OAuth/RSA SDK + egress; add it "
-            f"behind NotificationQueue (see weed/notification/{scheme})")
+            f"{scheme} queue is a registry stub; add it behind "
+            f"NotificationQueue (see weed/notification/{scheme})")
     raise ValueError(f"unknown queue spec: {spec}")

@@ -169,3 +169,94 @@ def decrypt(blob: bytes, key: bytes) -> bytes:
         return pt + fin.raw[:n.value]
     finally:
         lib.EVP_CIPHER_CTX_free(ctx)
+
+
+# -- RS256 (service-account JWT signing) -------------------------------------
+#
+# Google service accounts authenticate with an RS256-signed JWT grant
+# (the Pub/Sub notification queue needs one); the RSA-SHA256 primitive
+# comes from the same libcrypto the AES path uses.
+
+def _crypto_rsa():
+    lib = _crypto()
+    if getattr(lib, "_rsa_ready", False):
+        return lib
+    lib.BIO_new_mem_buf.restype = ctypes.c_void_p
+    lib.BIO_new_mem_buf.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.BIO_free.argtypes = [ctypes.c_void_p]
+    lib.PEM_read_bio_PrivateKey.restype = ctypes.c_void_p
+    lib.PEM_read_bio_PrivateKey.argtypes = [ctypes.c_void_p] + \
+        [ctypes.c_void_p] * 3
+    lib.PEM_read_bio_PUBKEY.restype = ctypes.c_void_p
+    lib.PEM_read_bio_PUBKEY.argtypes = [ctypes.c_void_p] + \
+        [ctypes.c_void_p] * 3
+    lib.EVP_PKEY_free.argtypes = [ctypes.c_void_p]
+    lib.EVP_MD_CTX_new.restype = ctypes.c_void_p
+    lib.EVP_MD_CTX_free.argtypes = [ctypes.c_void_p]
+    lib.EVP_sha256.restype = ctypes.c_void_p
+    lib.EVP_DigestSignInit.argtypes = [ctypes.c_void_p] * 5
+    lib.EVP_DigestSign.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p,
+        ctypes.c_size_t]
+    lib.EVP_DigestVerifyInit.argtypes = [ctypes.c_void_p] * 5
+    lib.EVP_DigestVerify.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t]
+    lib._rsa_ready = True
+    return lib
+
+
+def _load_pem(pem: bytes, public: bool):
+    lib = _crypto_rsa()
+    bio = lib.BIO_new_mem_buf(pem, len(pem))
+    if not bio:
+        raise CipherError("BIO_new_mem_buf failed")
+    try:
+        fn = lib.PEM_read_bio_PUBKEY if public \
+            else lib.PEM_read_bio_PrivateKey
+        pkey = fn(bio, None, None, None)
+        if not pkey:
+            raise CipherError("could not parse PEM key")
+        return pkey
+    finally:
+        lib.BIO_free(bio)
+
+
+def rs256_sign(pem_private_key: bytes, data: bytes) -> bytes:
+    """RSASSA-PKCS1-v1_5 over SHA-256 (JWT alg RS256)."""
+    lib = _crypto_rsa()
+    pkey = _load_pem(pem_private_key, public=False)
+    ctx = lib.EVP_MD_CTX_new()
+    try:
+        if lib.EVP_DigestSignInit(ctx, None, lib.EVP_sha256(),
+                                  None, pkey) != 1:
+            raise CipherError("DigestSignInit failed")
+        n = ctypes.c_size_t(0)
+        if lib.EVP_DigestSign(ctx, None, ctypes.byref(n),
+                              data, len(data)) != 1:
+            raise CipherError("DigestSign(size) failed")
+        sig = ctypes.create_string_buffer(n.value)
+        if lib.EVP_DigestSign(ctx, sig, ctypes.byref(n),
+                              data, len(data)) != 1:
+            raise CipherError("DigestSign failed")
+        return sig.raw[:n.value]
+    finally:
+        lib.EVP_MD_CTX_free(ctx)
+        lib.EVP_PKEY_free(pkey)
+
+
+def rs256_verify(pem_public_key: bytes, data: bytes,
+                 signature: bytes) -> bool:
+    lib = _crypto_rsa()
+    pkey = _load_pem(pem_public_key, public=True)
+    ctx = lib.EVP_MD_CTX_new()
+    try:
+        if lib.EVP_DigestVerifyInit(ctx, None, lib.EVP_sha256(),
+                                    None, pkey) != 1:
+            raise CipherError("DigestVerifyInit failed")
+        return lib.EVP_DigestVerify(ctx, signature, len(signature),
+                                    data, len(data)) == 1
+    finally:
+        lib.EVP_MD_CTX_free(ctx)
+        lib.EVP_PKEY_free(pkey)

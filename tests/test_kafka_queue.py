@@ -311,3 +311,51 @@ def test_kafka_offset_out_of_range_resets_to_log_start(broker):
     q.consume(lambda k, m: got.append(k))   # offset 0 -> err 1 -> reset
     assert got == ["/new"]
     q.close()
+
+
+def test_tombstone_record_decoded_as_none(broker):
+    """Null-value records (compacted-topic deletes) decode to
+    value=None and are skipped by consume without wedging."""
+    import struct as _s
+    from seaweedfs_tpu.replication.kafka import (_w_varint, _w_i8,
+                                                 _w_i16, _w_i32,
+                                                 _w_i64)
+    # hand-build a batch with one tombstone record (value length -1)
+    rec = bytearray()
+    _w_i8(rec, 0)
+    _w_varint(rec, 0)
+    _w_varint(rec, 0)
+    _w_varint(rec, 1)
+    rec += b"k"
+    _w_varint(rec, -1)        # null value
+    _w_varint(rec, 0)
+    body = bytearray()
+    _w_i16(body, 0)
+    _w_i32(body, 0)
+    _w_i64(body, 0)
+    _w_i64(body, 0)
+    _w_i64(body, -1)
+    _w_i16(body, -1)
+    _w_i32(body, -1)
+    _w_i32(body, 1)
+    _w_varint(body, len(rec))
+    body += rec
+    batch = bytearray()
+    _w_i64(batch, 0)
+    _w_i32(batch, 9 + len(body))
+    _w_i32(batch, -1)
+    _w_i8(batch, 2)
+    batch += _s.pack(">I", crc32c(bytes(body)))
+    batch += body
+    out = decode_record_batches(bytes(batch))
+    assert out == [(0, b"k", None)]
+    # consume skips it and continues to real messages
+    broker.log.append(bytes(batch))
+    broker.base_offsets.append(broker.next_offset)
+    broker.next_offset += 1
+    q = KafkaQueue(f"127.0.0.1:{broker.port}", "events")
+    q.publish("/after-tombstone", {"n": 1})
+    got = []
+    q.consume(lambda k, m: got.append(k))
+    assert got == ["/after-tombstone"]
+    q.close()

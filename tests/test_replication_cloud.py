@@ -135,7 +135,7 @@ def test_queue_spec_routing(tmp_path):
                        http_endpoint=True)
     assert isinstance(q, SqsQueue) and q.queue_url == "http://h/1/q"
     with pytest.raises(NotImplementedError):
-        queue_for_spec("pubsub://p/t")
+        queue_for_spec("gocdk://x")
 
 
 # -- sinks -----------------------------------------------------------------
