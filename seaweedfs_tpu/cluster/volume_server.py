@@ -90,6 +90,8 @@ class VolumeServer:
         s.route("POST", "/admin/assign_volume", self._admin_assign_volume)
         s.route("POST", "/admin/delete_volume", self._admin_delete_volume)
         s.route("POST", "/admin/readonly", self._admin_readonly)
+        s.route("POST", "/admin/configure_replication",
+                self._admin_configure_replication)
         s.route("POST", "/admin/vacuum", self._admin_vacuum)
         s.route("POST", "/admin/ec/generate", self._ec_generate)
         s.route("POST", "/admin/ec/mount", self._ec_mount)
@@ -709,6 +711,20 @@ class VolumeServer:
         req = json.loads(body)
         self.store.mark_volume_readonly(req["volume"],
                                         req.get("readonly", True))
+        self._send_heartbeat(full=True)
+        return {}
+
+    def _admin_configure_replication(self, query: dict,
+                                     body: bytes) -> dict:
+        """VolumeConfigure (volume_grpc_admin.go:104): rewrite the
+        superblock's replica placement; the follow-up full heartbeat
+        re-registers the volume under its new layout."""
+        req = json.loads(body)
+        try:
+            self.store.configure_volume(req["volume"],
+                                        req["replication"])
+        except (VolumeError, ValueError) as e:
+            raise rpc.RpcError(400, str(e)) from None
         self._send_heartbeat(full=True)
         return {}
 

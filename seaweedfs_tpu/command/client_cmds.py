@@ -189,13 +189,33 @@ enabled = false
 dir = "."
 ''',
     "notification": '''\
-# notification.toml
+# notification.toml — the filer publishes every meta event to the
+# first enabled queue; `weed filer.replicate` consumes it.
 [notification.log]
 enabled = false
 
 [notification.file_queue]
 enabled = false
 dir = "/tmp/weed_notify"
+
+[notification.kafka]
+enabled = false
+hosts = "localhost:9092"
+topic = "seaweedfs_filer"
+
+[notification.aws_sqs]
+enabled = false
+region = "us-east-1"
+sqs_queue_url = "https://sqs.us-east-1.amazonaws.com/1234/queue"
+aws_access_key_id = ""
+aws_secret_access_key = ""
+
+[notification.google_pub_sub]
+enabled = false
+project_id = ""
+topic = "seaweedfs_filer"
+subscription = ""
+google_application_credentials = ""
 ''',
     "replication": '''\
 # replication.toml

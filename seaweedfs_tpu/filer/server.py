@@ -123,6 +123,16 @@ class FilerServer:
                            delete_file_id_fn=self._delete_file_ids,
                            meta_log_dir=meta_log_dir,
                            fetch_chunk_fn=self.streamer._fetch)
+        # notification.toml: publish every meta event to the configured
+        # queue (filer_notify.go + notification/configuration.go).
+        try:
+            from ..replication.notification import queue_from_config
+            from ..utils.config import load_configuration
+            self.filer.notification_queue = queue_from_config(
+                load_configuration("notification"))
+        except Exception as e:  # noqa: BLE001 — a broken notification
+            from ..utils import glog  # config must not kill the filer
+            glog.warningf("notification queue disabled: %s", e)
         self.server = rpc.JsonHttpServer(host, port,
                                          ssl_context=ssl_context)
         s = self.server

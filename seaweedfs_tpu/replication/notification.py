@@ -287,3 +287,133 @@ def queue_for_spec(spec: str, **kw) -> NotificationQueue:
             f"{scheme} queue is a registry stub; add it behind "
             f"NotificationQueue (see weed/notification/{scheme})")
     raise ValueError(f"unknown queue spec: {spec}")
+
+
+class AsyncPublisher(NotificationQueue):
+    """Decorator that takes publish() off the caller's thread: the
+    filer publishes under its meta-log lock, so a networked queue
+    (Kafka TCP, Pub/Sub HTTP) must never block it.  Sends ride an
+    in-order bounded spool drained by one background thread; past the
+    bound events are dropped (counted) rather than backpressuring
+    namespace mutations.  consume()/close() delegate to the inner
+    queue.  (SqsQueue carries its own identical spool.)"""
+
+    SPOOL_MAX = 65536
+
+    def __init__(self, inner: NotificationQueue):
+        self.inner = inner
+        self.dropped = 0
+        self._spool: "_queue.Queue[tuple | None]" = \
+            _queue.Queue(maxsize=self.SPOOL_MAX)
+        self._sender: threading.Thread | None = None
+        self._sender_lock = threading.Lock()
+
+    def _ensure_sender(self) -> None:
+        with self._sender_lock:
+            if self._sender is None or not self._sender.is_alive():
+                self._sender = threading.Thread(
+                    target=self._send_loop, daemon=True,
+                    name="notify-sender")
+                self._sender.start()
+
+    def _send_loop(self) -> None:
+        while True:
+            item = self._spool.get()
+            if item is None:
+                return
+            try:
+                self.inner.publish(*item)
+            except Exception:  # noqa: BLE001 — dead endpoint drops the
+                self.dropped += 1  # event; never wedges the loop
+            finally:
+                self._spool.task_done()
+
+    def publish(self, key: str, message: dict) -> None:
+        self._ensure_sender()
+        try:
+            self._spool.put_nowait((key, message))
+        except _queue.Full:
+            self.dropped += 1
+
+    def flush(self, timeout: float | None = None) -> None:
+        if timeout is None:
+            self._spool.join()
+            return
+        done = threading.Event()
+        threading.Thread(target=lambda: (self._spool.join(),
+                                         done.set()),
+                         daemon=True).start()
+        done.wait(timeout)
+
+    def consume(self, fn: Callable[[str, dict], None]) -> None:
+        self.inner.consume(fn)
+
+    def close(self) -> None:
+        if self._sender is not None and self._sender.is_alive():
+            self.flush(timeout=5.0)
+            self._spool.put(None)
+        self.inner.close()
+
+
+class LogQueue(NotificationQueue):
+    """notification.log: events go to the process log — the reference's
+    debugging sink (weed/notification/log/log_queue.go).  consume() is
+    a no-op drain; nothing is stored."""
+
+    def publish(self, key: str, message: dict) -> None:
+        from ..utils import glog
+        glog.infof("notify %s: %s", key,
+                   json.dumps(message, separators=(",", ":"))[:512])
+
+    def consume(self, fn: Callable[[str, dict], None]) -> None:
+        return
+
+
+def queue_from_config(cfg) -> NotificationQueue | None:
+    """Build the filer's notification queue from notification.toml
+    (weed/notification/configuration.go LoadConfiguration: the first
+    `enabled = true` section wins)."""
+    if cfg is None:
+        return None
+    if cfg.get_bool("notification.file_queue.enabled"):
+        d = cfg.get_string("notification.file_queue.dir",
+                           "/tmp/weed_notify")
+        return FileQueue(os.path.join(d, "events.jsonl"))
+    if cfg.get_bool("notification.kafka.enabled"):
+        from .kafka import KafkaQueue
+        return AsyncPublisher(KafkaQueue(
+            cfg.get_string("notification.kafka.hosts",
+                           "localhost:9092").split(",")[0],
+            cfg.get_string("notification.kafka.topic", "seaweedfs")))
+    if cfg.get_bool("notification.aws_sqs.enabled"):
+        return SqsQueue(
+            cfg.get_string("notification.aws_sqs.sqs_queue_url"),
+            access_key=cfg.get_string(
+                "notification.aws_sqs.aws_access_key_id"),
+            secret_key=cfg.get_string(
+                "notification.aws_sqs.aws_secret_access_key"),
+            region=cfg.get_string("notification.aws_sqs.region",
+                                  "us-east-1"))
+    if cfg.get_bool("notification.google_pub_sub.enabled"):
+        from .pubsub import PubSubQueue
+        sa = None
+        creds = cfg.get_string(
+            "notification.google_pub_sub.google_application_credentials")
+        if creds:
+            with open(creds) as f:
+                sa = json.load(f)
+        kw = {}
+        endpoint = cfg.get_string(
+            "notification.google_pub_sub.endpoint")
+        if endpoint:
+            kw["endpoint"] = endpoint
+        return AsyncPublisher(PubSubQueue(
+            cfg.get_string("notification.google_pub_sub.project_id"),
+            cfg.get_string("notification.google_pub_sub.topic",
+                           "seaweedfs"),
+            subscription=cfg.get_string(
+                "notification.google_pub_sub.subscription", ""),
+            service_account=sa, **kw))
+    if cfg.get_bool("notification.log.enabled"):
+        return LogQueue()
+    return None

@@ -116,13 +116,21 @@ class PubSubQueue(NotificationQueue):
     def consume(self, fn) -> None:
         sub = f"projects/{self.project}/subscriptions/" \
               f"{self.subscription}"
+        # returnImmediately pulls may return empty while a backlog
+        # exists (why Google deprecated the flag): require consecutive
+        # empty pulls before declaring the queue drained.
+        empty = 0
         while True:
             out = self._call(f"{sub}:pull",
                              {"maxMessages": 10,
                               "returnImmediately": True})
             received = out.get("receivedMessages", [])
             if not received:
-                return
+                empty += 1
+                if empty >= 3:
+                    return
+                continue
+            empty = 0
             ack_ids = []
             for rm in received:
                 raw = base64.b64decode(

@@ -227,6 +227,50 @@ class FsMetaLoad(Command):
 
 
 @register
+class FsMetaNotify(Command):
+    """Walk a subtree and publish one create event per entry to the
+    notification queue (command_fs_meta_notify.go) — bootstraps a
+    freshly-attached replication sink with the existing namespace."""
+    name = "fs.meta.notify"
+    help = ("fs.meta.notify [-queue=<spec>] [dir] — publish a create "
+            "event per entry (queue from notification.toml when no "
+            "-queue)")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        from ..replication.notification import (queue_for_spec,
+                                                queue_from_config)
+        flags, rest = self.parse_flags(args)
+        root = env.resolve(rest[0] if rest else "")
+        spec = flags.get("queue", "")
+        if spec:
+            queue = queue_for_spec(spec)
+        else:
+            from ..utils.config import load_configuration
+            queue = queue_from_config(
+                load_configuration("notification"))
+            if queue is None:
+                raise ShellError(
+                    "no notification queue: enable one in "
+                    "notification.toml or pass -queue=<spec>")
+        proxy = env.filer()
+        count = 0
+        stack = [root]
+        while stack:
+            d = stack.pop()
+            for e in proxy.list_all(d):
+                full = proxy.meta(e["FullPath"])
+                if full is not None:
+                    queue.publish(e["FullPath"],
+                                  {"directory": d, "old_entry": None,
+                                   "new_entry": full})
+                    count += 1
+                if e["is_directory"]:
+                    stack.append(e["FullPath"])
+        queue.close()
+        return f"notified {count} entries under {root}"
+
+
+@register
 class FsMetaCat(Command):
     name = "fs.meta.cat"
     help = "fs.meta.cat <path> — print one entry's full metadata"

@@ -137,6 +137,44 @@ class VolumeMount(Command):
 
 
 @register
+class VolumeConfigureReplication(Command):
+    """Change a volume's intended replica placement on every holder
+    (command_volume_configure_replication.go); follow with
+    volume.fix.replication to create/trim actual copies."""
+    name = "volume.configure.replication"
+    help = ("volume.configure.replication -volumeId <id> "
+            "-replication <xyz>")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        vid = int(flags["volumeId"])
+        replication = flags.get("replication", "")
+        if not replication:
+            # parse("") would quietly mean 000 and trim real replicas
+            raise ShellError("empty -replication value")
+        rp = ReplicaPlacement.parse(replication)  # validates format
+        changed = []
+        topo = env.topology()["topology"]
+        for dc in topo["data_centers"]:
+            for rack in dc["racks"]:
+                for n in rack["nodes"]:
+                    for v in n["volumes"]:
+                        if v["id"] == vid and \
+                                v["replica_placement"] != rp.to_byte():
+                            env.vs_call(n["url"],
+                                        "/admin/configure_replication",
+                                        {"volume": vid,
+                                         "replication": replication})
+                            changed.append(n["url"])
+        if not changed:
+            raise ShellError(f"no volume {vid} replica needs change")
+        return (f"configured {replication} on {len(changed)} "
+                f"holder(s): {', '.join(changed)} — run "
+                f"volume.fix.replication to realize it")
+
+
+@register
 class VolumeUnmount(Command):
     name = "volume.unmount"
     help = "volume.unmount -volumeId <id> -node <host:port>"

@@ -232,6 +232,17 @@ class Store:
                     return
             raise VolumeError(f"volume {vid} not found")
 
+    def configure_volume(self, vid: int, replication: str) -> None:
+        """Change a mounted volume's replica placement in its superblock
+        (store.ConfigureVolume); the next heartbeat reports the new
+        placement so the master's layout re-groups it."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise VolumeError(f"volume {vid} not found")
+        v.configure_replication(ReplicaPlacement.parse(replication))
+        with self._lock:
+            self.new_volumes.append(self._volume_info(v))
+
     def mark_volume_readonly(self, vid: int, ro: bool = True) -> None:
         v = self.find_volume(vid)
         if v is None:

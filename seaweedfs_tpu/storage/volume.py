@@ -327,6 +327,24 @@ class Volume:
         with self._lock:
             self.readonly = ro
 
+    def configure_replication(self, rp: ReplicaPlacement) -> None:
+        """Rewrite the superblock's replica-placement byte in place
+        (VolumeConfigure RPC, server/volume_grpc_admin.go:104): the
+        volume's intended copy count changes; actual replica repair is
+        volume.fix.replication's job afterward."""
+        with self._lock:
+            if self._dat is None:
+                raise VolumeError(
+                    f"volume {self.vid} is tiered to remote storage; "
+                    f"its superblock cannot be reconfigured in place")
+            self.super_block.replica_placement = rp
+            pos = self._dat.tell()
+            self._dat.seek(0)
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+            os.fsync(self._dat.fileno())
+            self._dat.seek(pos)
+
     def sync(self) -> None:
         with self._lock:
             if self._dat is not None:

@@ -158,6 +158,17 @@ class Topology(Node):
         # apply concurrently and '+=' would lose updates.
         with self._lock:
             self.sequencer.set_max(v.max_file_key)
+            old = dn.volumes.get(v.id)
+            if old is not None and (
+                    old.replica_placement != v.replica_placement
+                    or old.ttl != v.ttl
+                    or old.collection != v.collection):
+                # volume.configure.replication (or a ttl/collection
+                # change) moved the volume to a different layout key:
+                # the stale registration must go, or lookups keep
+                # resolving through the old layout and never see
+                # replicas registered under the new one.
+                self._layout_for(old).unregister_volume(old, dn)
             dn.add_or_update_volume(v)
             self._layout_for(v).register_volume(v, dn)
 

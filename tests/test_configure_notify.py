@@ -1,0 +1,148 @@
+"""volume.configure.replication + fs.meta.notify + notification.toml.
+
+Reference: weed/shell/command_volume_configure_replication.go,
+command_fs_meta_notify.go, notification/configuration.go.
+"""
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.core.super_block import SuperBlock
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_volume_configure_replication(cluster, tmp_path):
+    master, servers = cluster
+    client = WeedClient(master.url())
+    fid = client.upload_data(b"data", name="a.txt")  # replication 000
+    vid = int(fid.split(",")[0])
+    env = CommandEnv(master.url())
+    run_command(env, "lock")
+    out = run_command(env,
+                      f"volume.configure.replication -volumeId {vid} "
+                      f"-replication 001")
+    assert "configured 001" in out
+    # superblock byte rewritten on disk
+    holder = next(vs for vs in servers
+                  if vs.store.find_volume(vid) is not None)
+    v = holder.store.find_volume(vid)
+    assert str(v.super_block.replica_placement) == "001"
+    with open(v.file_name() + ".dat", "rb") as f:
+        sb = SuperBlock.from_bytes(f.read(8))
+    assert str(sb.replica_placement) == "001"
+    # master re-registered it under the new placement
+    lookup = rpc.call(f"{master.url()}/vol/list")
+    found = [vv for dc in lookup["topology"]["data_centers"]
+             for rack in dc["racks"] for n in rack["nodes"]
+             for vv in n["volumes"] if vv["id"] == vid]
+    assert found and all(
+        vv["replica_placement"] == sb.replica_placement.to_byte()
+        for vv in found)
+    # fix.replication now creates the second copy
+    out = run_command(env, "volume.fix.replication")
+    assert "copied" in out
+    import time
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        client.cache.forget(vid)
+        if len(client.lookup(vid)) == 2:
+            break
+        time.sleep(0.1)
+    assert len(client.lookup(vid)) == 2, out
+    # the data reads back from either replica
+    assert client.download(fid) == b"data"
+    # idempotent: nothing left to change
+    import pytest as _pt
+    from seaweedfs_tpu.shell.env import ShellError
+    with _pt.raises(ShellError, match="no volume"):
+        run_command(env,
+                    f"volume.configure.replication -volumeId {vid} "
+                    f"-replication 001")
+    run_command(env, "unlock")
+
+
+def test_fs_meta_notify_bootstraps_queue(cluster, tmp_path):
+    master, _ = cluster
+    fs = FilerServer(master.url(), port=0,
+                     store_path=str(tmp_path / "f.db"))
+    fs.start()
+    try:
+        base = fs.url()
+        rpc.call(f"{base}/boot/a.txt", "POST", b"one")
+        rpc.call(f"{base}/boot/sub/b.txt", "POST", b"two")
+        env = CommandEnv(master.url(), filer_url=base)
+        spool = tmp_path / "notify" / "spool.jsonl"
+        out = run_command(env,
+                          f"fs.meta.notify -queue=file://{spool} /boot")
+        assert "notified" in out
+        lines = [json.loads(ln) for ln in
+                 open(spool).read().splitlines()]
+        keys = {ln["key"] for ln in lines}
+        assert {"/boot/a.txt", "/boot/sub", "/boot/sub/b.txt"} <= keys
+        ev = next(ln["message"] for ln in lines
+                  if ln["key"] == "/boot/a.txt")
+        assert ev["new_entry"]["path"] == "/boot/a.txt"
+        assert ev["old_entry"] is None
+        # the events drive a replicator like live ones do
+        from seaweedfs_tpu.replication.notification import FileQueue
+        from seaweedfs_tpu.replication.replicator import Replicator
+        from seaweedfs_tpu.replication.sink import LocalSink
+        repl = Replicator(base, "/boot",
+                          LocalSink(str(tmp_path / "mirror")))
+        FileQueue(str(spool)).consume(
+            lambda k, m: repl.replicate(m))
+        assert open(tmp_path / "mirror" / "a.txt", "rb").read() == \
+            b"one"
+        assert open(tmp_path / "mirror" / "sub" / "b.txt",
+                    "rb").read() == b"two"
+    finally:
+        fs.stop()
+
+
+def test_filer_wires_notification_toml(cluster, tmp_path, monkeypatch):
+    master, _ = cluster
+    conf_dir = tmp_path / "conf"
+    conf_dir.mkdir()
+    spool_dir = tmp_path / "nspool"
+    (conf_dir / "notification.toml").write_text(
+        f'[notification.file_queue]\nenabled = true\n'
+        f'dir = "{spool_dir}"\n')
+    import seaweedfs_tpu.utils.config as cfgmod
+    monkeypatch.setattr(cfgmod, "SEARCH_PATHS", [str(conf_dir)])
+    fs = FilerServer(master.url(), port=0,
+                     store_path=str(tmp_path / "f2.db"))
+    fs.start()
+    try:
+        from seaweedfs_tpu.replication.notification import FileQueue
+        assert isinstance(fs.filer.notification_queue, FileQueue)
+        rpc.call(f"{fs.url()}/nq/x.txt", "POST", b"payload")
+        got = []
+        FileQueue(str(spool_dir / "events.jsonl")).consume(
+            lambda k, m: got.append(k))
+        assert "/nq/x.txt" in got
+    finally:
+        fs.stop()
