@@ -184,13 +184,12 @@ def decode_record_batches(buf: bytes,
             raise ValueError("record batch CRC mismatch")
         br = _Reader(body)
         attrs = br.i16()
-        if attrs & 0x07:
-            # gzip/snappy/lz4/zstd from a foreign producer: the records
-            # area is a compressed blob, not varint framing — fail
-            # loudly instead of parsing garbage.
+        codec = attrs & 0x07
+        if codec not in (0, 1):
+            # snappy/lz4/zstd need non-stdlib codecs: fail loudly
+            # instead of parsing a compressed blob as varint framing.
             raise ValueError(
-                f"compressed record batch (codec {attrs & 0x07}) "
-                f"unsupported")
+                f"compressed record batch (codec {codec}) unsupported")
         br.i32()                  # lastOffsetDelta
         br.i64()                  # baseTimestamp
         br.i64()                  # maxTimestamp
@@ -198,6 +197,11 @@ def decode_record_batches(buf: bytes,
         br.i16()                  # producerEpoch
         br.i32()                  # baseSequence
         n = br.i32()
+        if codec == 1:
+            # gzip: the records area (after the plaintext count) is one
+            # compressed blob (KIP-98); stdlib covers it.
+            import gzip as _gzip
+            br = _Reader(_gzip.decompress(br.raw(br.remaining())))
         for _ in range(n):
             rec_len = br.varint()
             rr = _Reader(br.raw(rec_len))

@@ -359,3 +359,49 @@ def test_tombstone_record_decoded_as_none(broker):
     q.consume(lambda k, m: got.append(k))
     assert got == ["/after-tombstone"]
     q.close()
+
+
+def test_gzip_compressed_batch_from_foreign_producer():
+    """codec=1 (gzip) batches decode via stdlib; snappy still refuses."""
+    import gzip as _gzip
+    import struct as _s
+    from seaweedfs_tpu.replication.kafka import (_w_varint, _w_i8,
+                                                 _w_i16, _w_i32,
+                                                 _w_i64)
+    rec = bytearray()
+    _w_i8(rec, 0)
+    _w_varint(rec, 0)
+    _w_varint(rec, 0)
+    _w_varint(rec, 2)
+    rec += b"kk"
+    _w_varint(rec, 5)
+    rec += b"value"
+    _w_varint(rec, 0)
+    framed = bytearray()
+    _w_varint(framed, len(rec))
+    framed += rec
+
+    def build(codec, records_blob):
+        body = bytearray()
+        _w_i16(body, codec)
+        _w_i32(body, 0)
+        _w_i64(body, 0)
+        _w_i64(body, 0)
+        _w_i64(body, -1)
+        _w_i16(body, -1)
+        _w_i32(body, -1)
+        _w_i32(body, 1)
+        body += records_blob
+        batch = bytearray()
+        _w_i64(batch, 0)
+        _w_i32(batch, 9 + len(body))
+        _w_i32(batch, -1)
+        _w_i8(batch, 2)
+        batch += _s.pack(">I", crc32c(bytes(body)))
+        batch += body
+        return bytes(batch)
+
+    out = decode_record_batches(build(1, _gzip.compress(bytes(framed))))
+    assert out == [(0, b"kk", b"value")]
+    with pytest.raises(ValueError, match="codec 2"):
+        decode_record_batches(build(2, bytes(framed)))
