@@ -8,11 +8,11 @@ the **v2 (magic=2) format** every broker since 0.11 speaks — varint
 record framing, CRC32-C over the batch body (the same Castagnoli core
 the needle codec uses, core/crc.py).
 
-Scope: one topic, explicit partition list, no consumer groups — the
-`NotificationQueue.consume` contract is poll-drain from a checkpointed
-offset, which maps to plain Fetch (the reference's kafka consumer also
-tracks its own offsets in a progress file rather than committing group
-offsets).
+Scope: one topic, all partitions (leaders discovered per partition),
+no consumer groups — the `NotificationQueue.consume` contract is
+poll-drain from checkpointed per-partition offsets, which maps to plain
+Fetch (the reference's kafka consumer also tracks its own offsets in a
+progress file rather than committing group offsets).
 """
 
 from __future__ import annotations
@@ -270,49 +270,67 @@ class _Broker:
 class KafkaQueue(NotificationQueue):
     """Publish/consume the {key, message} envelope on one Kafka topic.
 
-    consume() drains from a locally-tracked offset (checkpointed to
-    `offset_path` after each delivered batch, like the reference's
-    progress file) — at-least-once, no consumer groups."""
+    Partitions are discovered from Metadata and ALL are consumed (the
+    reference's sarama consumer does the same); produces are routed by
+    CRC32-C of the key so per-path ordering holds, like sarama's hash
+    partitioner.  consume() drains each partition from locally-tracked
+    offsets (checkpointed to `offset_path` as JSON after each drained
+    batch, like the reference's progress file) — at-least-once, no
+    consumer groups.  Pass `partition` to pin a single partition."""
 
     API_PRODUCE, API_FETCH, API_LIST_OFFSETS, API_METADATA = 0, 1, 2, 3
     ERR_OFFSET_OUT_OF_RANGE = 1
 
     def __init__(self, bootstrap: str, topic: str,
-                 partition: int = 0, offset_path: str | None = None,
+                 partition: int | None = None,
+                 offset_path: str | None = None,
                  timeout: float = 10.0):
         host, _, port = bootstrap.partition(":")
         self.topic = topic
-        self.partition = partition
+        self.pinned = partition
         self.timeout = timeout
         self.offset_path = offset_path
-        self._offset = self._load_offset()
+        self._offsets: dict[int, int] = self._load_offsets()
         self._bootstrap = (host, int(port or 9092))
-        self._leader: _Broker | None = None
+        self._conns: dict[tuple, _Broker] = {}
+        self._leaders: dict[int, tuple] = {}   # pid -> (host, port)
         self._lock = threading.Lock()
 
     # -- offsets ------------------------------------------------------------
 
-    def _load_offset(self) -> int:
+    def _load_offsets(self) -> dict[int, int]:
         if not self.offset_path:
-            return 0
+            return {}
         try:
             with open(self.offset_path) as f:
-                return int(f.read().strip() or 0)
-        except (OSError, ValueError):
-            return 0
+                raw = f.read().strip()
+        except OSError:
+            return {}
+        if not raw:
+            return {}
+        try:
+            doc = json.loads(raw)
+            if isinstance(doc, dict):
+                return {int(k): int(v) for k, v in doc.items()}
+        except (json.JSONDecodeError, ValueError):
+            pass
+        try:  # legacy single-int checkpoint (partition 0)
+            return {0: int(raw)}
+        except ValueError:
+            return {}
 
-    def _save_offset(self) -> None:
+    def _save_offsets(self) -> None:
         if self.offset_path:
             with open(self.offset_path, "w") as f:
-                f.write(str(self._offset))
+                json.dump({str(k): v for k, v in self._offsets.items()},
+                          f)
 
     # -- connection / metadata ---------------------------------------------
 
-    def _connect(self) -> _Broker:
-        with self._lock:
-            if self._leader is not None:
-                return self._leader
-            boot = _Broker(*self._bootstrap, timeout=self.timeout)
+    def _refresh_metadata(self) -> None:
+        """Metadata v1: partition list + per-partition leader addrs."""
+        boot = _Broker(*self._bootstrap, timeout=self.timeout)
+        try:
             body = bytearray()
             _w_i32(body, 1)
             _w_str(body, self.topic)
@@ -325,7 +343,7 @@ class KafkaQueue(NotificationQueue):
                 r.string()  # rack
                 brokers[node] = (bhost, bport)
             r.i32()      # controller id
-            leader_node = None
+            leaders: dict[int, tuple] = {}
             for _ in range(r.i32()):      # topics
                 r.i16()                   # topic error
                 r.string()                # name
@@ -338,28 +356,54 @@ class KafkaQueue(NotificationQueue):
                         r.i32()           # replicas
                     for _ in range(r.i32()):
                         r.i32()           # isr
-                    if pid == self.partition:
-                        leader_node = leader
-            if leader_node is None or leader_node not in brokers:
-                boot.close()
-                raise ConnectionError(
-                    f"no leader for {self.topic}/{self.partition}")
-            if brokers[leader_node] == \
-                    (self._bootstrap[0], self._bootstrap[1]):
-                self._leader = boot
-            else:
-                boot.close()
-                self._leader = _Broker(*brokers[leader_node],
-                                       timeout=self.timeout)
-            return self._leader
+                    if leader in brokers:
+                        leaders[pid] = brokers[leader]
+        finally:
+            boot.close()
+        if not leaders:
+            raise ConnectionError(f"no leaders for topic {self.topic}")
+        self._leaders = leaders
 
-    def _drop_leader(self) -> None:
+    def _partitions(self) -> list[int]:
         with self._lock:
-            if self._leader is not None:
-                self._leader.close()
-                self._leader = None
+            if not self._leaders:
+                self._refresh_metadata()
+            if self.pinned is not None:
+                return [self.pinned]
+            return sorted(self._leaders)
+
+    def _broker_for(self, pid: int) -> _Broker:
+        with self._lock:
+            if pid not in self._leaders:
+                self._refresh_metadata()
+            addr = self._leaders.get(pid)
+            if addr is None:
+                raise ConnectionError(
+                    f"no leader for {self.topic}/{pid}")
+            conn = self._conns.get(addr)
+            if conn is None:
+                conn = self._conns[addr] = _Broker(
+                    *addr, timeout=self.timeout)
+            return conn
+
+    def _drop_connections(self) -> None:
+        """Leadership moved or a conn died: rediscover everything."""
+        with self._lock:
+            for conn in self._conns.values():
+                conn.close()
+            self._conns = {}
+            self._leaders = {}
+
+    # back-compat aliases used by tests/tools
+    _drop_leader = _drop_connections
 
     # -- NotificationQueue --------------------------------------------------
+
+    def _pick_partition(self, key: str) -> int:
+        if self.pinned is not None:
+            return self.pinned
+        parts = self._partitions()
+        return parts[crc32c(key.encode()) % len(parts)]
 
     def publish(self, key: str, message: dict) -> None:
         value = json.dumps({"key": key, "message": message},
@@ -369,6 +413,7 @@ class KafkaQueue(NotificationQueue):
         # segment before consumers see it.
         batch = encode_record_batch([(key.encode(), value)],
                                     base_ts_ms=int(time.time() * 1000))
+        pid = self._pick_partition(key)
         body = bytearray()
         _w_str(body, None)            # transactional id (v3+)
         _w_i16(body, -1)              # acks: full ISR
@@ -376,23 +421,36 @@ class KafkaQueue(NotificationQueue):
         _w_i32(body, 1)               # one topic
         _w_str(body, self.topic)
         _w_i32(body, 1)               # one partition
-        _w_i32(body, self.partition)
+        _w_i32(body, pid)
         _w_bytes(body, batch)
         try:
-            r = self._connect().call(self.API_PRODUCE, 3, bytes(body))
+            r = self._broker_for(pid).call(self.API_PRODUCE, 3,
+                                           bytes(body))
         except (OSError, ConnectionError):
-            self._drop_leader()  # stale leader: retry once on reconnect
-            r = self._connect().call(self.API_PRODUCE, 3, bytes(body))
+            self._drop_connections()  # stale leader: retry once
+            r = self._broker_for(pid).call(self.API_PRODUCE, 3,
+                                           bytes(body))
         r.i32()                       # topic count
         r.string()
         r.i32()                       # partition count
         r.i32()                       # partition id
         err = r.i16()
         if err:
-            self._drop_leader()
+            self._drop_connections()
             raise ConnectionError(f"kafka produce error code {err}")
 
     def consume(self, fn) -> None:
+        # Round-robin the partitions until a full pass delivers
+        # nothing — each partition drains from its own offset.
+        while True:
+            delivered = False
+            for pid in self._partitions():
+                delivered |= self._drain_partition(pid, fn)
+            if not delivered:
+                return
+
+    def _drain_partition(self, pid: int, fn) -> bool:
+        delivered = False
         while True:
             body = bytearray()
             _w_i32(body, -1)          # replica id (consumer)
@@ -403,14 +461,16 @@ class KafkaQueue(NotificationQueue):
             _w_i32(body, 1)           # one topic
             _w_str(body, self.topic)
             _w_i32(body, 1)
-            _w_i32(body, self.partition)
-            _w_i64(body, self._offset)
+            _w_i32(body, pid)
+            _w_i64(body, self._offsets.get(pid, 0))
             _w_i32(body, 1 << 24)     # partition max bytes
             try:
-                r = self._connect().call(self.API_FETCH, 4, bytes(body))
+                r = self._broker_for(pid).call(self.API_FETCH, 4,
+                                               bytes(body))
             except (OSError, ConnectionError):
-                self._drop_leader()
-                r = self._connect().call(self.API_FETCH, 4, bytes(body))
+                self._drop_connections()
+                r = self._broker_for(pid).call(self.API_FETCH, 4,
+                                               bytes(body))
             r.i32()                   # throttle time
             r.i32()                   # topic count
             r.string()
@@ -423,11 +483,11 @@ class KafkaQueue(NotificationQueue):
                 # resume from the earliest retained offset (events in
                 # the gap are gone either way — at-least-once, not
                 # exactly-once).
-                self._offset = self._earliest_offset()
-                self._save_offset()
+                self._offsets[pid] = self._earliest_offset(pid)
+                self._save_offsets()
                 continue
             if err:
-                self._drop_leader()
+                self._drop_connections()
                 raise ConnectionError(f"kafka fetch error code {err}")
             r.i64()                   # high watermark
             r.i64()                   # last stable offset (v4+)
@@ -436,9 +496,9 @@ class KafkaQueue(NotificationQueue):
                 r.i64()
             records = r.nbytes() or b""
             batch = decode_record_batches(records)
-            delivered = False
+            got = False
             for offset, _key, value in batch:
-                if offset < self._offset:
+                if offset < self._offsets.get(pid, 0):
                     continue  # broker returns from batch start
                 doc = None
                 if value is not None:  # tombstones aren't our envelope
@@ -449,25 +509,27 @@ class KafkaQueue(NotificationQueue):
                 if isinstance(doc, dict) and "key" in doc \
                         and "message" in doc:
                     fn(doc["key"], doc["message"])
-                self._offset = offset + 1
-                delivered = True
-            if not delivered:
-                return
+                self._offsets[pid] = offset + 1
+                got = True
+            if not got:
+                return delivered
+            delivered = True
             # One checkpoint per drained batch: a crash mid-batch
             # redelivers the batch (at-least-once), and the hot loop
             # isn't N file rewrites for N records.
-            self._save_offset()
+            self._save_offsets()
 
-    def _earliest_offset(self) -> int:
+    def _earliest_offset(self, pid: int) -> int:
         """ListOffsets v1 with timestamp=-2 (earliest)."""
         body = bytearray()
         _w_i32(body, -1)          # replica id
         _w_i32(body, 1)           # one topic
         _w_str(body, self.topic)
         _w_i32(body, 1)
-        _w_i32(body, self.partition)
+        _w_i32(body, pid)
         _w_i64(body, -2)          # EARLIEST
-        r = self._connect().call(self.API_LIST_OFFSETS, 1, bytes(body))
+        r = self._broker_for(pid).call(self.API_LIST_OFFSETS, 1,
+                                       bytes(body))
         r.i32()                   # topic count
         r.string()
         r.i32()                   # partition count
@@ -479,4 +541,4 @@ class KafkaQueue(NotificationQueue):
         return r.i64()
 
     def close(self) -> None:
-        self._drop_leader()
+        self._drop_connections()

@@ -75,21 +75,49 @@ def test_truncated_tail_batch_ignored():
 # -- fake broker ------------------------------------------------------------
 
 class FakeBroker:
-    """Single-partition in-memory Kafka speaking Metadata v1 /
-    Produce v3 / Fetch v4 over real TCP."""
+    """In-memory Kafka speaking Metadata v1 / Produce v3 / Fetch v4 /
+    ListOffsets v1 over real TCP; N partitions, partition 0 exposed via
+    the legacy single-partition attributes the older tests use."""
 
-    def __init__(self):
+    def __init__(self, n_partitions: int = 1):
         self.sock = socket.socket()
         self.sock.bind(("127.0.0.1", 0))
         self.sock.listen(8)
         self.port = self.sock.getsockname()[1]
-        self.log: list[bytes] = []   # one stored batch per produce
-        self.base_offsets: list[int] = []
-        self.next_offset = 0
-        self.log_start = 0           # retention truncation point
+        self.n_partitions = n_partitions
+        # per-partition stores; partition 0 aliased by legacy attrs
+        self.plogs = {p: [] for p in range(n_partitions)}
+        self.pbases = {p: [] for p in range(n_partitions)}
+        self.pnext = {p: 0 for p in range(n_partitions)}
+        self.plog_start = {p: 0 for p in range(n_partitions)}
         self.produce_count = 0
         self._stop = False
         threading.Thread(target=self._serve, daemon=True).start()
+
+    # legacy single-partition views (partition 0)
+    @property
+    def log(self):
+        return self.plogs[0]
+
+    @property
+    def base_offsets(self):
+        return self.pbases[0]
+
+    @property
+    def next_offset(self):
+        return self.pnext[0]
+
+    @next_offset.setter
+    def next_offset(self, v):
+        self.pnext[0] = v
+
+    @property
+    def log_start(self):
+        return self.plog_start[0]
+
+    @log_start.setter
+    def log_start(self, v):
+        self.plog_start[0] = v
 
     def _serve(self):
         while not self._stop:
@@ -155,12 +183,13 @@ class FakeBroker:
         b += struct.pack(">h", 0)                      # no error
         b += self._str("events")
         b += bytes([0])                                # not internal
-        b += struct.pack(">i", 1)                      # 1 partition
-        b += struct.pack(">h", 0)
-        b += struct.pack(">i", 0)                      # partition 0
-        b += struct.pack(">i", 7)                      # leader = us
-        b += struct.pack(">i", 1) + struct.pack(">i", 7)   # replicas
-        b += struct.pack(">i", 1) + struct.pack(">i", 7)   # isr
+        b += struct.pack(">i", self.n_partitions)
+        for pid in range(self.n_partitions):
+            b += struct.pack(">h", 0)
+            b += struct.pack(">i", pid)
+            b += struct.pack(">i", 7)                  # leader = us
+            b += struct.pack(">i", 1) + struct.pack(">i", 7)  # replicas
+            b += struct.pack(">i", 1) + struct.pack(">i", 7)  # isr
         return b
 
     def _produce(self, body):
@@ -172,17 +201,17 @@ class FakeBroker:
         (tlen,) = struct.unpack_from(">h", body, off)
         off += 2 + tlen
         off += 4           # partition count
-        (_pid,) = struct.unpack_from(">i", body, off)
+        (pid,) = struct.unpack_from(">i", body, off)
         off += 4
         (blen,) = struct.unpack_from(">i", body, off)
         off += 4
         batch = bytearray(body[off:off + blen])
         n_records = len(decode_record_batches(bytes(batch)))
-        base = self.next_offset
+        base = self.pnext[pid]
         batch[0:8] = struct.pack(">q", base)  # broker assigns offsets
-        self.log.append(bytes(batch))
-        self.base_offsets.append(base)
-        self.next_offset += n_records
+        self.plogs[pid].append(bytes(batch))
+        self.pbases[pid].append(base)
+        self.pnext[pid] += n_records
         self.produce_count += 1
         resp = struct.pack(">i", 1) + self._str("events")
         resp += struct.pack(">i", 1)
@@ -198,13 +227,15 @@ class FakeBroker:
         # topics(1), name, parts(1), id, fetch_offset, part_max
         off = 4 + 4 + 4 + 4 + 1 + 4
         (tlen,) = struct.unpack_from(">h", body, off)
-        off += 2 + tlen + 4 + 4
+        off += 2 + tlen + 4
+        (pid,) = struct.unpack_from(">i", body, off)
+        off += 4
         (fetch_offset,) = struct.unpack_from(">q", body, off)
-        if fetch_offset < self.log_start:
+        if fetch_offset < self.plog_start[pid]:
             resp = struct.pack(">i", 0)
             resp += struct.pack(">i", 1) + self._str("events")
             resp += struct.pack(">i", 1)
-            resp += struct.pack(">i", 0)
+            resp += struct.pack(">i", pid)
             resp += struct.pack(">h", 1)      # OFFSET_OUT_OF_RANGE
             resp += struct.pack(">q", -1) + struct.pack(">q", -1)
             resp += struct.pack(">i", 0)
@@ -213,26 +244,31 @@ class FakeBroker:
         # include the batch containing fetch_offset (broker semantics:
         # return from the containing batch onward)
         records = b"".join(
-            batch for batch, base in zip(self.log, self.base_offsets)
+            batch for batch, base in zip(self.plogs[pid],
+                                         self.pbases[pid])
             if base + len(decode_record_batches(batch)) > fetch_offset)
         resp = struct.pack(">i", 0)           # throttle
         resp += struct.pack(">i", 1) + self._str("events")
         resp += struct.pack(">i", 1)
-        resp += struct.pack(">i", 0)          # partition
+        resp += struct.pack(">i", pid)        # partition
         resp += struct.pack(">h", 0)          # no error
-        resp += struct.pack(">q", self.next_offset)  # high watermark
-        resp += struct.pack(">q", self.next_offset)  # last stable
+        resp += struct.pack(">q", self.pnext[pid])  # high watermark
+        resp += struct.pack(">q", self.pnext[pid])  # last stable
         resp += struct.pack(">i", 0)          # aborted txns
         resp += struct.pack(">i", len(records)) + records
         return resp
 
     def _list_offsets(self, body):
+        off = 4 + 4
+        (tlen,) = struct.unpack_from(">h", body, off)
+        off += 2 + tlen + 4
+        (pid,) = struct.unpack_from(">i", body, off)
         resp = struct.pack(">i", 1) + self._str("events")
         resp += struct.pack(">i", 1)
-        resp += struct.pack(">i", 0)          # partition
+        resp += struct.pack(">i", pid)        # partition
         resp += struct.pack(">h", 0)          # no error
         resp += struct.pack(">q", -1)         # timestamp
-        resp += struct.pack(">q", self.log_start)
+        resp += struct.pack(">q", self.plog_start[pid])
         return resp
 
     def close(self):
@@ -405,3 +441,37 @@ def test_gzip_compressed_batch_from_foreign_producer():
     assert out == [(0, b"kk", b"value")]
     with pytest.raises(ValueError, match="codec 2"):
         decode_record_batches(build(2, bytes(framed)))
+
+
+def test_kafka_multi_partition_publish_and_drain(tmp_path):
+    """Keys route to partitions by CRC32-C; consume drains ALL
+    partitions (the old client silently ignored everything but 0)."""
+    broker = FakeBroker(n_partitions=4)
+    try:
+        q = KafkaQueue(f"127.0.0.1:{broker.port}", "events",
+                       offset_path=str(tmp_path / "off.json"))
+        keys = [f"/dir/file-{i}.txt" for i in range(40)]
+        for k in keys:
+            q.publish(k, {"k": k})
+        used = {p for p in range(4) if broker.plogs[p]}
+        assert len(used) > 1, "hash routing never left partition 0"
+        got = []
+        q.consume(lambda k, m: got.append(k))
+        assert sorted(got) == sorted(keys)
+        # same key always lands on the same partition (ordering)
+        q.publish(keys[0], {"k": "again"})
+        target = [p for p in range(4)
+                  if any(b"again" in blob for blob in broker.plogs[p])]
+        from seaweedfs_tpu.core.crc import crc32c as _crc
+        assert target == [_crc(keys[0].encode()) % 4]
+        # per-partition offsets persisted as JSON; a new consumer
+        # resumes cleanly
+        q2 = KafkaQueue(f"127.0.0.1:{broker.port}", "events",
+                        offset_path=str(tmp_path / "off.json"))
+        got2 = []
+        q2.consume(lambda k, m: got2.append(k))
+        assert got2 == [keys[0]]
+        q.close()
+        q2.close()
+    finally:
+        broker.close()
