@@ -48,3 +48,21 @@ def test_native_alt_scheme():
     data = np.random.default_rng(2).integers(
         0, 256, (8, 4096)).astype(np.uint8)
     assert np.array_equal(nc.encode(data), oc.encode(data))
+
+
+def test_native_sanitizer_harness():
+    """SURVEY §5: sanitizer builds for the C++ host kernels.  Builds
+    the standalone harness with -fsanitize=address,undefined and runs
+    it (crc vectors, gf_mul_add/gf_mix vs scalar reference at
+    tail-stressing lengths)."""
+    import os
+    import shutil
+    import subprocess
+    if shutil.which("g++") is None:
+        pytest.skip("g++ not available")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(["make", "asan-test"],
+                         cwd=os.path.join(root, "native"),
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "native sanitizer harness OK" in out.stdout
