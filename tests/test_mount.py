@@ -236,3 +236,59 @@ def test_real_fuse_mount(stack, tmp_path):
         assert os.listdir(mp) == []
     finally:
         fm.unmount()
+
+
+@pytest.mark.skipif(not _fuse_usable(), reason="/dev/fuse not usable")
+def test_real_fuse_hardlink(stack, tmp_path):
+    """`ln` through the kernel: both names resolve the shared content
+    (filerstore_hardlink.go indirection), surviving rm of one name."""
+    from seaweedfs_tpu.mount.fuse_ll import FuseMount
+    _m, _vs, filer = stack
+    mp = tmp_path / "mnt_ln"
+    mp.mkdir()
+    w = WFS(filer.url(), filer_dir="/fuselink", chunk_size=256)
+    fm = FuseMount(w, str(mp))
+    fm.mount_background()
+    try:
+        a = mp / "orig.txt"
+        a.write_bytes(b"shared content " * 40)
+        os.link(a, mp / "alias.txt")
+        assert (mp / "alias.txt").read_bytes() == a.read_bytes()
+        st = os.stat(a)
+        assert st.st_nlink == 2
+        os.remove(a)
+        assert (mp / "alias.txt").read_bytes() == \
+            b"shared content " * 40
+    finally:
+        fm.unmount()
+
+
+@pytest.mark.skipif(not _fuse_usable(), reason="/dev/fuse not usable")
+def test_real_fuse_cipher_mount(stack, tmp_path):
+    """A kernel mount of a cipher-enabled filer seals chunks: plaintext
+    through the OS, ciphertext on the volume server."""
+    from seaweedfs_tpu.cluster.client import WeedClient
+    from seaweedfs_tpu.filer.client import FilerProxy
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.mount.fuse_ll import FuseMount
+    master, _vs, _filer = stack
+    cfs = FilerServer(master.url(), chunk_size=512, cipher=True)
+    cfs.start()
+    mp = tmp_path / "mnt_ci"
+    mp.mkdir()
+    w = WFS(cfs.url(), filer_dir="/cipher", chunk_size=512)
+    assert w.cipher, "mount must adopt the filer's cipher bit"
+    fm = FuseMount(w, str(mp))
+    fm.mount_background()
+    try:
+        secret = b"top secret material " * 60  # > 1 chunk
+        (mp / "s.bin").write_bytes(secret)
+        assert (mp / "s.bin").read_bytes() == secret
+        meta = FilerProxy(cfs.url()).meta("/cipher/s.bin")
+        chunks = meta["chunks"]
+        assert chunks and all(c.get("cipher_key") for c in chunks)
+        raw = WeedClient(master.url()).download(chunks[0]["file_id"])
+        assert secret[:64] not in raw
+    finally:
+        fm.unmount()
+        cfs.stop()
