@@ -159,3 +159,26 @@ def test_ec_lifecycle_over_cluster(cluster, tmp_path):
     # 6. master learned the shard layout via heartbeats
     lookup = rpc.call(f"{master.url()}/dir/lookup?volumeId={vid}")
     assert "ecShards" in lookup
+
+
+def test_replicated_write_fails_when_sibling_down(cluster):
+    """All-or-fail fan-out (store_replicate.go): a write to a
+    replicated volume must ERROR when a sibling replica is down, so
+    the client knows the copy count wasn't met — never a silent
+    under-replication."""
+    master, servers = cluster
+    client = WeedClient(master.url())
+    fid = client.upload_data(b"seed", replication="001")
+    vid = int(fid.split(",")[0])
+    locs = client.lookup(vid)
+    assert len(locs) == 2
+    victim = next(vs for vs in servers if vs.url() == locs[1]["url"])
+    victim.stop()
+    # direct POST to the surviving holder on the same volume
+    survivor = locs[0]["url"]
+    key = 0x7777
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{survivor}/{vid},{key:x}00000001",
+                 "POST", b"must not half-land")
+    assert ei.value.status == 500
+    assert "replication failed" in ei.value.message
