@@ -236,3 +236,88 @@ def test_server_upload_download_roundtrip(tmp_path, capsys):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_server_full_stack_s3_webdav(tmp_path):
+    """Capstone: one `weed server -filer=true -s3=true -webdav=true`
+    process; an object PUT through the S3 gateway reads back through
+    S3, the filer HTTP API, and WebDAV."""
+    import socket
+    import subprocess
+    import sys as _sys
+    import time as _time
+    import urllib.request
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    mport, vport, fport, s3port, davport = (free_port() for _ in range(5))
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "seaweedfs_tpu", "server",
+         f"-master.port={mport}", f"-volume.port={vport}",
+         f"-dir={data_dir}", f"-mdir={tmp_path}",
+         "-filer=true", f"-filer.port={fport}",
+         "-s3=true", f"-s3.port={s3port}",
+         "-webdav=true", f"-webdav.port={davport}"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def wait_http(url, deadline):
+        while _time.time() < deadline:
+            try:
+                urllib.request.urlopen(url, timeout=1)
+                return
+            except urllib.error.HTTPError:
+                return  # server answered (any status)
+            except Exception:
+                _time.sleep(0.2)
+        raise TimeoutError(url)
+
+    try:
+        deadline = _time.time() + 30
+        for port, path in ((mport, "/dir/status"), (fport, "/"),
+                           (s3port, "/"), (davport, "/")):
+            wait_http(f"http://127.0.0.1:{port}{path}", deadline)
+        # wait for the volume server registration
+        while _time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/dir/status",
+                    timeout=2) as resp:
+                if json.loads(resp.read()).get(
+                        "topology", {}).get("children"):
+                    break
+            _time.sleep(0.2)
+        s3 = f"http://127.0.0.1:{s3port}"
+        body = b"through the S3 gateway" * 10
+        # create bucket + put object (anonymous mode: no identities)
+        urllib.request.urlopen(urllib.request.Request(
+            f"{s3}/caps", method="PUT"), timeout=10)
+        urllib.request.urlopen(urllib.request.Request(
+            f"{s3}/caps/dir/obj.txt", data=body, method="PUT"),
+            timeout=10)
+        # read back through S3
+        with urllib.request.urlopen(f"{s3}/caps/dir/obj.txt",
+                                    timeout=10) as resp:
+            assert resp.read() == body
+        # the same object through the filer namespace
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fport}/buckets/caps/dir/obj.txt",
+                timeout=10) as resp:
+            assert resp.read() == body
+        # and through WebDAV
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{davport}/buckets/caps/dir/obj.txt",
+                timeout=10) as resp:
+            assert resp.read() == body
+        # S3 list sees it
+        with urllib.request.urlopen(
+                f"{s3}/caps?list-type=2&prefix=dir/",
+                timeout=10) as resp:
+            listing = resp.read()
+        assert b"dir/obj.txt" in listing
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
