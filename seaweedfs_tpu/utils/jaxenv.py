@@ -28,6 +28,13 @@ def force_cpu(device_count: int = 8) -> None:
         # sitecustomize may have imported jax already, latching the
         # platform config; point it back at cpu.
         jax.config.update("jax_platforms", "cpu")
+        # If a backend was ALREADY initialized (e.g. the driver ran the
+        # single-chip entry() compile check first), the device count is
+        # latched at 1 — drop the live backends so the next query
+        # re-initializes with the forced CPU mesh.
+        if len(jax.devices()) < device_count:
+            import jax.extend.backend as jeb
+            jeb.clear_backends()
     except Exception:
         pass
 
