@@ -263,15 +263,22 @@ class FilerServer:
         if head:  # never materialize chunks just to discard the body
             headers["Content-Length"] = str(size)
             return (200, b"", headers)
+        # Stream: the handler hands a file-like range reader to the rpc
+        # writer, so a multi-GB GET is O(MB) filer RSS — symmetric with
+        # the streaming upload path (the reference's StreamContent).
         rng = self._parse_range(query.get("_range_header", ""), size)
         if rng is not None:
             lo, hi = rng
             if lo > hi:
                 raise rpc.RpcError(416, "range not satisfiable")
-            data = self.streamer.read(e.chunks, lo, hi - lo + 1)
             headers["Content-Range"] = f"bytes {lo}-{hi}/{size}"
-            return (206, data, headers)
-        return (200, self.streamer.read(e.chunks), headers)
+            headers["Content-Length"] = str(hi - lo + 1)
+            return (206, self.streamer.range_reader(
+                e.chunks, lo, hi - lo + 1).prime(), headers)
+        headers["Content-Length"] = str(size)
+        return (200,
+                self.streamer.range_reader(e.chunks, 0, size).prime(),
+                headers)
 
     @staticmethod
     def _parse_range(rng: str, size: int) -> tuple[int, int] | None:

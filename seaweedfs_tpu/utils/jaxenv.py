@@ -31,8 +31,12 @@ def force_cpu(device_count: int = 8) -> None:
         # If a backend was ALREADY initialized (e.g. the driver ran the
         # single-chip entry() compile check first), the device count is
         # latched at 1 — drop the live backends so the next query
-        # re-initializes with the forced CPU mesh.
-        if len(jax.devices()) < device_count:
+        # re-initializes with the forced CPU mesh.  Only when one
+        # exists: querying devices() here would otherwise force eager
+        # XLA client startup in every process that calls force_cpu()
+        # defensively.
+        if getattr(xb, "_backends", None) and \
+                len(jax.devices()) < device_count:
             import jax.extend.backend as jeb
             jeb.clear_backends()
     except Exception:
