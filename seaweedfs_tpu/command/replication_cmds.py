@@ -56,10 +56,12 @@ def run_filer_copy(flags: Flags, args: list[str]) -> int:
 
 
 def _copy_one(proxy, local: str, remote: str) -> int:
-    with open(local, "rb") as f:
-        data = f.read()
     mime = mimetypes.guess_type(local)[0] or "application/octet-stream"
-    proxy.put(remote, data, mime)
+    # Stream the open file: filer.copy of a multi-GB file must not
+    # materialize it (the proxy sends readers under Content-Length and
+    # the filer's upload route consumes incrementally).
+    with open(local, "rb") as f:
+        proxy.put(remote, f, mime, length=os.path.getsize(local))
     return 1
 
 
