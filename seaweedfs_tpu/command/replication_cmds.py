@@ -61,7 +61,11 @@ def _copy_one(proxy, local: str, remote: str) -> int:
     # materialize it (the proxy sends readers under Content-Length and
     # the filer's upload route consumes incrementally).
     with open(local, "rb") as f:
-        proxy.put(remote, f, mime, length=os.path.getsize(local))
+        # fstat the OPEN handle: a path-level stat could disagree with
+        # the descriptor under a concurrent replace, declaring a length
+        # the body never matches (hung or truncated upload).
+        proxy.put(remote, f, mime,
+                  length=os.fstat(f.fileno()).st_size)
     return 1
 
 
