@@ -83,6 +83,10 @@ def run_export(flags: Flags, args: list[str]) -> int:
                 continue
             name = (needle.name.decode("utf-8", "replace")
                     if needle.name else f"{needle.id:x}")
+            if needle.is_compressed() and not name.endswith(".gz"):
+                # gzip-stored needle: export the stored bytes honestly
+                # (command/export.go appends .gz the same way)
+                name += ".gz"
             if tar is not None:
                 info = tarfile.TarInfo(name=name)
                 info.size = len(needle.data)

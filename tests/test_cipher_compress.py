@@ -266,3 +266,29 @@ def test_filer_cipher_roundtrip(cluster, tmp_path):
         assert part == payload[100:300]
     finally:
         fs.stop()
+
+
+def test_export_names_gzip_needles(cluster, tmp_path):
+    """weed export writes gzip-stored needles under name.gz — the tar
+    holds the stored bytes, so the name must say so (export.go)."""
+    import tarfile
+    from seaweedfs_tpu.command import COMMANDS, _load_all, parse_flags
+    master, servers = cluster
+    client = WeedClient(master.url())
+    r = client.upload(TEXT, name="doc.txt")
+    assert r["is_compressed"]
+    vid = int(r["fid"].split(",")[0])
+    holder = next(vs for vs in servers
+                  if vs.store.find_volume(vid) is not None)
+    vol_dir = holder.store.find_volume(vid).dir
+    holder.store.find_volume(vid).sync()
+    _load_all()
+    out = tmp_path / "vol.tar"
+    flags, rest = parse_flags(
+        [f"-dir={vol_dir}", f"-volumeId={vid}", f"-o={out}"])
+    assert COMMANDS["export"].run(flags, rest) == 0
+    with tarfile.open(out) as tf:
+        names = tf.getnames()
+        assert "doc.txt.gz" in names
+        member = tf.extractfile("doc.txt.gz").read()
+    assert gzip.decompress(member) == TEXT
