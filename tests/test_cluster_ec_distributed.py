@@ -123,3 +123,38 @@ def test_too_many_lost_cluster_wide(cluster):
         vs._ec_loc_cache.clear()
     with pytest.raises(rpc.RpcError):
         rpc.call(f"http://{servers[1].url()}/{fids[0]}")
+
+
+def test_gzip_needle_through_ec_path(cluster):
+    """Needle flags survive EC: a gzip-stored needle read from shards
+    decompresses for plain readers and passes through for
+    gzip-accepting ones — storage layout never changes read behavior
+    (_serve_needle is shared by the replicated and EC ladders)."""
+    import gzip as _gzip
+
+    from seaweedfs_tpu.cluster.client import WeedClient
+    master, servers = cluster
+    client = WeedClient(master.url())
+    text = b"compress me through erasure coding\n" * 100
+    r = client.upload(text, name="doc.txt")
+    assert r["is_compressed"]
+    vid = int(r["fid"].split(",")[0])
+    src = client.lookup(vid)[0]["url"]
+    rpc.call_json(f"http://{src}/admin/ec/generate", "POST",
+                  {"volume": vid})
+    rpc.call_json(f"http://{src}/admin/ec/mount", "POST",
+                  {"volume": vid})
+    rpc.call_json(f"http://{src}/admin/delete_volume", "POST",
+                  {"volume": vid})
+    for vs in servers:
+        vs._send_heartbeat(full=True)
+    # plain read through the EC ladder: decompressed
+    assert rpc.call(f"http://{src}/{r['fid']}") == text
+    # gzip-accepting read: stored bytes pass through
+    resp, conn = rpc._request(f"http://{src}/{r['fid']}", "GET",
+                              None, 10.0,
+                              req_headers={"Accept-Encoding": "gzip"})
+    raw = resp.read()
+    rpc._finish(conn, resp)
+    assert resp.getheader("content-encoding") == "gzip"
+    assert _gzip.decompress(raw) == text
