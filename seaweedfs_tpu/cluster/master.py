@@ -411,6 +411,19 @@ class MasterServer:
                 self.topo.sync_data_node_ec_shards(
                     [(e["id"], e.get("collection", ""), e["shard_bits"])
                      for e in hb["ec_shards"]], dn)
+            # Incremental EC deltas (master_grpc_server.go handles the
+            # same Heartbeat fields): merge into the node's shard bits.
+            for e in hb.get("new_ec_shards", []):
+                bits = dn.ec_shards.get(e["id"], 0) | e["shard_bits"]
+                self.topo.register_ec_shards(
+                    e["id"], e.get("collection", ""), bits, dn)
+            for e in hb.get("deleted_ec_shards", []):
+                bits = dn.ec_shards.get(e["id"], 0) & ~e["shard_bits"]
+                if bits:
+                    self.topo.register_ec_shards(
+                        e["id"], e.get("collection", ""), bits, dn)
+                else:
+                    self.topo.unregister_ec_shards(e["id"], dn)
             after = set(dn.volumes) | set(dn.ec_shards)
         if after != before:
             # Push the delta to every /cluster/watch stream — clients

@@ -50,6 +50,25 @@ def _wait_forever(servers: list) -> int:
     return 0
 
 
+def _start_master_grpc(m, flags: Flags, ip: str):
+    """Start the master_pb.Seaweed gRPC plane on http port + 10000
+    (ParseServerToGrpcAddress convention; -grpc.port overrides,
+    -grpc=false disables).  TLS rides the same security.toml
+    [grpc.master] section as the HTTPS plane."""
+    if not flags.get_bool("grpc", True):
+        return None
+    from ..pb.master_grpc import MasterGrpcServer
+    from ..utils.security import (grpc_server_credentials,
+                                  security_configuration)
+    g = MasterGrpcServer(
+        m, host=ip, port=flags.get_int("grpc.port", 0) or None,
+        credentials=grpc_server_credentials(security_configuration(),
+                                            "master"))
+    g.start()
+    glog.infof("master gRPC (master_pb.Seaweed) at %s", g.addr())
+    return g
+
+
 def run_master(flags: Flags, args: list[str]) -> int:
     from ..cluster.master import MasterServer as Master
     from ..utils.config import load_configuration
@@ -74,7 +93,8 @@ def run_master(flags: Flags, args: list[str]) -> int:
             "master.maintenance.sleep_minutes", 17))
     m.start()
     glog.infof("master serving at %s", m.server.url())
-    return _wait_forever([m])
+    g = _start_master_grpc(m, flags, flags.get("ip", "127.0.0.1"))
+    return _wait_forever([m] + ([g] if g else []))
 
 
 def run_volume(flags: Flags, args: list[str]) -> int:
@@ -200,6 +220,9 @@ def run_server(flags: Flags, args: list[str]) -> int:
     servers.append(vs)
     glog.infof("master at %s, volume at %s", m.server.url(),
                vs.server.url())
+    g = _start_master_grpc(m, flags, ip)
+    if g:
+        servers.append(g)
     if flags.get_bool("filer", False):
         from ..filer.server import FilerServer
         fs = FilerServer(master_url=m.server.url(), host=ip,

@@ -207,3 +207,32 @@ def install_cluster_tls(cfg) -> bool:
     from ..cluster import rpc
     rpc.set_client_ssl_context(ctx, force_https=True)
     return True
+
+
+def grpc_server_credentials(cfg, component: str):
+    """security.toml `[grpc.<component>]` -> grpc.ServerCredentials, or
+    None when no cert/key is configured — the same keys and client_auth
+    policy load_server_tls applies to the HTTPS plane, so both planes
+    of one component share one TLS story."""
+    if cfg is None:
+        return None
+    cert = cfg.get_string(f"grpc.{component}.cert")
+    key = cfg.get_string(f"grpc.{component}.key")
+    if not cert or not key:
+        return None
+    ca = cfg.get_string(f"grpc.{component}.ca") or \
+        cfg.get_string("grpc.ca")
+    mode = cfg.get_string(f"grpc.{component}.client_auth",
+                          "none").lower()
+    import grpc
+    with open(key, "rb") as f:
+        key_pem = f.read()
+    with open(cert, "rb") as f:
+        cert_pem = f.read()
+    root = None
+    if ca:
+        with open(ca, "rb") as f:
+            root = f.read()
+    return grpc.ssl_server_credentials(
+        [(key_pem, cert_pem)], root_certificates=root,
+        require_client_auth=(mode == "require"))

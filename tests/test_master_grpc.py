@@ -1,0 +1,213 @@
+"""gRPC control plane: the wire-compatible master_pb.Seaweed service.
+
+Clients speak raw grpc channels with the protoc-generated messages —
+exactly what a ported `weed`-style gRPC client would send — and the
+facade bridges to the same master internals as the JSON plane.
+"""
+
+import json
+import threading
+import time
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.pb import master_pb2 as pb
+from seaweedfs_tpu.pb.master_grpc import MasterGrpcServer
+
+SVC = "/master_pb.Seaweed/"
+
+
+@pytest.fixture
+def stack(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60)
+    vs.start()
+    g = MasterGrpcServer(master, port=0)
+    g.start()
+    chan = grpc.insecure_channel(g.addr())
+    yield master, vs, g, chan
+    chan.close()
+    g.stop()
+    vs.stop()
+    master.stop()
+
+
+def _unary(chan, name, req, resp_cls):
+    fn = chan.unary_unary(
+        SVC + name,
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString)
+    return fn(req, timeout=10)
+
+
+def test_assign_lookup_roundtrip(stack):
+    _m, _vs, _g, chan = stack
+    out = _unary(chan, "Assign",
+                 pb.AssignRequest(count=1, replication="000"),
+                 pb.AssignResponse)
+    assert out.fid and out.url and not out.error
+    # upload through the HTTP data plane with the gRPC-assigned fid
+    rpc.call(f"http://{out.url}/{out.fid}", "POST", b"grpc-assigned")
+    vid = out.fid.split(",")[0]
+    lk = _unary(chan, "LookupVolume",
+                pb.LookupVolumeRequest(volume_ids=[vid]),
+                pb.LookupVolumeResponse)
+    assert len(lk.volume_id_locations) == 1
+    locs = lk.volume_id_locations[0].locations
+    assert any(loc.url == out.url for loc in locs)
+    assert rpc.call(f"http://{locs[0].url}/{out.fid}") == \
+        b"grpc-assigned"
+    # unknown volume -> per-entry error, not a transport failure
+    lk2 = _unary(chan, "LookupVolume",
+                 pb.LookupVolumeRequest(volume_ids=["9999"]),
+                 pb.LookupVolumeResponse)
+    assert lk2.volume_id_locations[0].error
+
+
+def test_statistics_and_configuration(stack):
+    master, vs, _g, chan = stack
+    client = WeedClient(master.url())
+    client.upload_data(b"x" * 1000)
+    vs.store.find_volume(1).sync()
+    vs._send_heartbeat(full=True)  # counters ride heartbeats
+    st = _unary(chan, "Statistics", pb.StatisticsRequest(),
+                pb.StatisticsResponse)
+    assert st.file_count >= 1 and st.used_size > 0
+    cfg = _unary(chan, "GetMasterConfiguration",
+                 pb.GetMasterConfigurationRequest(),
+                 pb.GetMasterConfigurationResponse)
+    assert cfg.leader == master.url()
+
+
+def test_volume_list_topology(stack):
+    master, vs, _g, chan = stack
+    WeedClient(master.url()).upload_data(b"vols")
+    vl = _unary(chan, "VolumeList", pb.VolumeListRequest(),
+                pb.VolumeListResponse)
+    nodes = [dn for dc in vl.topology_info.data_center_infos
+             for rack in dc.rack_infos for dn in rack.data_node_infos]
+    assert any(dn.id == vs.url() and dn.volume_infos for dn in nodes)
+    assert vl.volume_size_limit_mb == 64
+
+
+def test_grpc_heartbeat_registers_volume_server(stack):
+    """A 'Go-style' volume server registering over gRPC SendHeartbeat
+    lands in the same topology the JSON plane serves."""
+    master, _vs, _g, chan = stack
+    hb = pb.Heartbeat(
+        ip="10.9.9.9", port=18080, public_url="10.9.9.9:18080",
+        max_volume_count=5, data_center="dc9", rack="r9",
+        has_no_volumes=True,
+        volumes=[pb.VolumeInformationMessage(
+            id=77, size=123, collection="", file_count=1,
+            replica_placement=0, version=3)])
+    stream = chan.stream_stream(
+        SVC + "SendHeartbeat",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.HeartbeatResponse.FromString)
+    responses = stream(iter([hb]), timeout=10)
+    first = next(iter(responses))
+    assert first.volume_size_limit == 64 << 20
+    # visible through the JSON lookup path
+    out = rpc.call(f"{master.url()}/dir/lookup?volumeId=77")
+    assert out["locations"][0]["url"] == "10.9.9.9:18080"
+
+
+def test_keep_connected_pushes_locations(stack):
+    master, vs, _g, chan = stack
+    WeedClient(master.url()).upload_data(b"watch me")
+    stream = chan.stream_stream(
+        SVC + "KeepConnected",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.VolumeLocation.FromString)
+    got = []
+    done = threading.Event()
+
+    def consume():
+        try:
+            for loc in stream(iter([pb.KeepConnectedRequest(
+                    name="test-client")]), timeout=15):
+                got.append(loc)
+                done.set()
+                return
+        except grpc.RpcError:
+            pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert done.wait(10), "no VolumeLocation pushed"
+    assert got[0].url == vs.url() and got[0].new_vids
+
+
+def test_collections_and_admin_lease(stack):
+    master, _vs, _g, chan = stack
+    WeedClient(master.url()).upload_data(b"c", collection="grpccol")
+    cl = _unary(chan, "CollectionList", pb.CollectionListRequest(),
+                pb.CollectionListResponse)
+    assert any(c.name == "grpccol" for c in cl.collections)
+    lease = _unary(chan, "LeaseAdminToken",
+                   pb.LeaseAdminTokenRequest(lock_name="grpc-shell"),
+                   pb.LeaseAdminTokenResponse)
+    assert lease.token
+    # a second caller is refused while held
+    with pytest.raises(grpc.RpcError) as ei:
+        _unary(chan, "LeaseAdminToken",
+               pb.LeaseAdminTokenRequest(lock_name="intruder"),
+               pb.LeaseAdminTokenResponse)
+    assert ei.value.code() == grpc.StatusCode.ABORTED
+    _unary(chan, "ReleaseAdminToken",
+           pb.ReleaseAdminTokenRequest(previous_token=lease.token),
+           pb.ReleaseAdminTokenResponse)
+
+
+def test_grpc_incremental_ec_shard_heartbeat(stack):
+    """Delta-only EC heartbeats (new_ec_shards / deleted_ec_shards)
+    register and unregister shard bits without a full sync."""
+    master, _vs, _g, chan = stack
+    stream = chan.stream_stream(
+        SVC + "SendHeartbeat",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.HeartbeatResponse.FromString)
+    hb0 = pb.Heartbeat(ip="10.8.8.8", port=18081,
+                       public_url="10.8.8.8:18081",
+                       max_volume_count=5, has_no_volumes=True)
+    hb_add = pb.Heartbeat(
+        ip="10.8.8.8", port=18081, public_url="10.8.8.8:18081",
+        max_volume_count=5,
+        new_ec_shards=[pb.VolumeEcShardInformationMessage(
+            id=88, ec_index_bits=0b111)])
+    hb_del = pb.Heartbeat(
+        ip="10.8.8.8", port=18081, public_url="10.8.8.8:18081",
+        max_volume_count=5,
+        deleted_ec_shards=[pb.VolumeEcShardInformationMessage(
+            id=88, ec_index_bits=0b111)])
+    for _ in stream(iter([hb0, hb_add]), timeout=10):
+        pass
+    ec = _unary(chan, "LookupEcVolume",
+                pb.LookupEcVolumeRequest(volume_id=88),
+                pb.LookupEcVolumeResponse)
+    assert {e.shard_id for e in ec.shard_id_locations} == {0, 1, 2}
+    assert ec.shard_id_locations[0].locations[0].url == \
+        "10.8.8.8:18081"
+    for _ in stream(iter([hb_del]), timeout=10):
+        pass
+    with pytest.raises(grpc.RpcError) as ei:
+        _unary(chan, "LookupEcVolume",
+               pb.LookupEcVolumeRequest(volume_id=88),
+               pb.LookupEcVolumeResponse)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_lookup_malformed_id_is_per_entry_error(stack):
+    _m, _vs, _g, chan = stack
+    lk = _unary(chan, "LookupVolume",
+                pb.LookupVolumeRequest(volume_ids=["not-a-vid"]),
+                pb.LookupVolumeResponse)
+    assert lk.volume_id_locations[0].error
