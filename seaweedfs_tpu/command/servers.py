@@ -57,7 +57,11 @@ def _start_master_grpc(m, flags: Flags, ip: str):
     [grpc.master] section as the HTTPS plane."""
     if not flags.get_bool("grpc", True):
         return None
-    from ..pb.master_grpc import MasterGrpcServer
+    try:
+        from ..pb.master_grpc import MasterGrpcServer
+    except ImportError as e:
+        glog.warningf("gRPC plane disabled (grpcio missing: %s)", e)
+        return None
     from ..utils.security import (grpc_server_credentials,
                                   security_configuration)
     g = MasterGrpcServer(
@@ -66,6 +70,31 @@ def _start_master_grpc(m, flags: Flags, ip: str):
                                             "master"))
     g.start()
     glog.infof("master gRPC (master_pb.Seaweed) at %s", g.addr())
+    return g
+
+
+def _start_filer_grpc(fs, flags: Flags, ip: str,
+                      allow_port_flag: bool = True):
+    """filer_pb.SeaweedFiler on http port + 10000; same conventions as
+    the master plane (-grpc=false, -grpc.port, security.toml
+    [grpc.filer] TLS).  In `weed server` the -grpc.port override
+    belongs to the master plane, so the filer keeps the convention."""
+    if not flags.get_bool("grpc", True):
+        return None
+    try:
+        from ..pb.filer_grpc import FilerGrpcServer
+    except ImportError as e:
+        glog.warningf("gRPC plane disabled (grpcio missing: %s)", e)
+        return None
+    from ..utils.security import (grpc_server_credentials,
+                                  security_configuration)
+    port = flags.get_int("grpc.port", 0) if allow_port_flag else 0
+    g = FilerGrpcServer(
+        fs, host=ip, port=port or None,
+        credentials=grpc_server_credentials(security_configuration(),
+                                            "filer"))
+    g.start()
+    glog.infof("filer gRPC (filer_pb.SeaweedFiler) at %s", g.addr())
     return g
 
 
@@ -148,7 +177,8 @@ def run_filer(flags: Flags, args: list[str]) -> int:
         cipher=flags.get_bool("encryptVolumeData", False))
     fs.start()
     glog.infof("filer serving at %s", fs.server.url())
-    return _wait_forever([fs])
+    g = _start_filer_grpc(fs, flags, flags.get("ip", "127.0.0.1"))
+    return _wait_forever([fs] + ([g] if g else []))
 
 
 def _s3_identities(config_path: str):
@@ -232,6 +262,10 @@ def run_server(flags: Flags, args: list[str]) -> int:
         fs.start()
         servers.append(fs)
         glog.infof("filer at %s", fs.server.url())
+        fg = _start_filer_grpc(fs, flags, ip,
+                               allow_port_flag=False)
+        if fg:
+            servers.append(fg)
         if flags.get_bool("s3", False):
             from ..s3api.server import S3ApiServer
             s3 = S3ApiServer(filer_url=fs.server.url(), host=ip,

@@ -269,6 +269,11 @@ class MasterGrpcServer:
                 {"volumeId": str(req.volume_id)}, b"")
         except jrpc.RpcError as e:
             ctx.abort(grpc.StatusCode.NOT_FOUND, e.message)
+        if not out.get("ecShards"):
+            # A plain replicated volume answers through LookupVolume;
+            # OK-but-empty here would read as "all shards lost".
+            ctx.abort(grpc.StatusCode.NOT_FOUND,
+                      f"ec volume {req.volume_id} not found")
         resp = pb.LookupEcVolumeResponse(volume_id=req.volume_id)
         for sid, locs in sorted(out.get("ecShards", {}).items(),
                                 key=lambda kv: int(kv[0])):
@@ -284,12 +289,10 @@ class MasterGrpcServer:
             leader=self.master.leader_url())
 
     def _list_clients(self, req, ctx):
-        with self.master._watchers_lock:
-            n = len(self.master._watchers)
-        # watcher streams are anonymous on the JSON plane; report count
-        # via placeholder addresses like the reference lists grpc peers
-        return pb.ListMasterClientsResponse(
-            grpc_addresses=[f"client-{i}" for i in range(n)])
+        # Watcher streams are anonymous on the JSON plane: an honest
+        # empty list beats fabricated "addresses" a ported filer would
+        # try (and fail) to dial.
+        return pb.ListMasterClientsResponse(grpc_addresses=[])
 
     def _lease_admin_token(self, req, ctx):
         body = json.dumps({"name": req.lock_name or "shell",
@@ -315,9 +318,13 @@ class MasterGrpcServer:
         for hb in request_iterator:
             doc = {"ip": hb.ip, "port": hb.port,
                    "public_url": hb.public_url,
-                   "max_volume_count": hb.max_volume_count,
                    "data_center": hb.data_center or "DefaultDataCenter",
                    "rack": hb.rack or "DefaultRack"}
+            if hb.max_volume_count > 0:
+                # proto3's absent-field 0 must not register a node that
+                # can never host volumes; omitting the key gets the
+                # JSON plane's default capacity.
+                doc["max_volume_count"] = hb.max_volume_count
             if hb.volumes or hb.has_no_volumes:
                 doc["volumes"] = [_vinfo_dict(v) for v in hb.volumes]
             if hb.new_volumes or hb.deleted_volumes:

@@ -151,6 +151,18 @@ def load_server_tls(cfg, component: str):
     therefore server-auth TLS; set `client_auth = "require"` per
     component to get the reference's RequireAndVerifyClientCert
     behavior where the port is cluster-internal."""
+    parsed = server_tls_config(cfg, component)
+    if parsed is None:
+        return None
+    cert, key, ca, mode = parsed
+    return tls_server_context(cert, key, ca,
+                              require_client_cert=mode == "require")
+
+
+def server_tls_config(cfg, component: str):
+    """Parse + validate `[grpc.<component>]` -> (cert, key, ca, mode)
+    or None — the ONE config reader behind both the HTTPS and gRPC
+    planes, so a client_auth typo fails loudly on both."""
     if cfg is None:
         return None
     cert = cfg.get_string(f"grpc.{component}.cert")
@@ -166,8 +178,7 @@ def load_server_tls(cfg, component: str):
     if mode == "require" and not ca:
         raise ValueError(
             f"grpc.{component}.client_auth = 'require' needs grpc.ca")
-    return tls_server_context(cert, key, ca,
-                              require_client_cert=mode == "require")
+    return cert, key, ca, mode
 
 
 def load_client_tls(cfg, component: str = "client"):
@@ -211,19 +222,13 @@ def install_cluster_tls(cfg) -> bool:
 
 def grpc_server_credentials(cfg, component: str):
     """security.toml `[grpc.<component>]` -> grpc.ServerCredentials, or
-    None when no cert/key is configured — the same keys and client_auth
-    policy load_server_tls applies to the HTTPS plane, so both planes
-    of one component share one TLS story."""
-    if cfg is None:
+    None when no cert/key is configured — the same parsed/validated
+    config as the HTTPS plane (server_tls_config), so both planes of
+    one component share one TLS story."""
+    parsed = server_tls_config(cfg, component)
+    if parsed is None:
         return None
-    cert = cfg.get_string(f"grpc.{component}.cert")
-    key = cfg.get_string(f"grpc.{component}.key")
-    if not cert or not key:
-        return None
-    ca = cfg.get_string(f"grpc.{component}.ca") or \
-        cfg.get_string("grpc.ca")
-    mode = cfg.get_string(f"grpc.{component}.client_auth",
-                          "none").lower()
+    cert, key, ca, mode = parsed
     import grpc
     with open(key, "rb") as f:
         key_pem = f.read()
