@@ -98,6 +98,63 @@ def _start_filer_grpc(fs, flags: Flags, ip: str,
     return g
 
 
+def _start_grpc_plane(server_obj, flags: Flags, ip: str,
+                      component: str, server_cls_path: str,
+                      allow_port_flag: bool = True):
+    """Start one wire-compatible gRPC plane on http port + 10000
+    (ParseServerToGrpcAddress convention; -grpc.port overrides on the
+    primary role, -grpc=false disables).  TLS rides the same
+    security.toml [grpc.<component>] section as the HTTPS plane; a
+    config mistake exits with a message like _security() does."""
+    if not flags.get_bool("grpc", True):
+        return None
+    import importlib
+    try:
+        mod_name, cls_name = server_cls_path.rsplit(".", 1)
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+    except ImportError as e:
+        glog.warningf("gRPC plane disabled (grpcio missing: %s)", e)
+        return None
+    from ..utils.security import (grpc_server_credentials,
+                                  security_configuration)
+    try:
+        creds = grpc_server_credentials(security_configuration(),
+                                        component)
+    except Exception as e:  # noqa: BLE001 — bad values / cert paths
+        import sys
+        print(f"security.toml [grpc.{component}]: {e}", file=sys.stderr)
+        raise SystemExit(2) from None
+    port = flags.get_int("grpc.port", 0) if allow_port_flag else 0
+    g = cls(server_obj, host=ip, port=port or None, credentials=creds)
+    g.start()
+    glog.infof("%s gRPC (%s) at %s", component, cls.SERVICE, g.addr())
+    return g
+
+
+def _start_master_grpc(m, flags: Flags, ip: str,
+                       allow_port_flag: bool = True):
+    return _start_grpc_plane(
+        m, flags, ip, "master",
+        "seaweedfs_tpu.pb.master_grpc.MasterGrpcServer",
+        allow_port_flag)
+
+
+def _start_filer_grpc(fs, flags: Flags, ip: str,
+                      allow_port_flag: bool = True):
+    return _start_grpc_plane(
+        fs, flags, ip, "filer",
+        "seaweedfs_tpu.pb.filer_grpc.FilerGrpcServer",
+        allow_port_flag)
+
+
+def _start_volume_grpc(vs, flags: Flags, ip: str,
+                       allow_port_flag: bool = True):
+    return _start_grpc_plane(
+        vs, flags, ip, "volume",
+        "seaweedfs_tpu.pb.volume_grpc.VolumeGrpcServer",
+        allow_port_flag)
+
+
 def run_master(flags: Flags, args: list[str]) -> int:
     from ..cluster.master import MasterServer as Master
     from ..utils.config import load_configuration
@@ -146,7 +203,8 @@ def run_volume(flags: Flags, args: list[str]) -> int:
     vs.start()
     glog.infof("volume server serving at %s (dirs %s)",
                vs.server.url(), dirs)
-    return _wait_forever([vs])
+    g = _start_volume_grpc(vs, flags, flags.get("ip", "127.0.0.1"))
+    return _wait_forever([vs] + ([g] if g else []))
 
 
 def run_msg_broker(flags: Flags, args: list[str]) -> int:
@@ -253,6 +311,9 @@ def run_server(flags: Flags, args: list[str]) -> int:
     g = _start_master_grpc(m, flags, ip)
     if g:
         servers.append(g)
+    vg = _start_volume_grpc(vs, flags, ip, allow_port_flag=False)
+    if vg:
+        servers.append(vg)
     if flags.get_bool("filer", False):
         from ..filer.server import FilerServer
         fs = FilerServer(master_url=m.server.url(), host=ip,

@@ -39,7 +39,7 @@ def entry_to_pb(e: Entry) -> "pb.Entry":
             group_name=list(a.group_names),
             symlink_target=a.symlink_target,
             md5=bytes.fromhex(a.md5) if a.md5 else b""),
-        hard_link_id=e.hard_link_id.encode(),
+        hard_link_id=e.hard_link_id.encode("utf-8", "surrogateescape"),
         hard_link_counter=e.hard_link_counter)
     for k, v in e.extended.items():
         out.extended[k] = v.encode() if isinstance(v, str) else v
@@ -74,7 +74,7 @@ def entry_from_pb(directory: str, p: "pb.Entry") -> Entry:
         attributes=attrs, chunks=chunks,
         extended={k: v.decode("utf-8", "surrogateescape")
                   for k, v in p.extended.items()},
-        hard_link_id=p.hard_link_id.decode()
+        hard_link_id=p.hard_link_id.decode("utf-8", "surrogateescape")
         if p.hard_link_id else "",
         hard_link_counter=p.hard_link_counter)
 
@@ -279,7 +279,9 @@ class FilerGrpcServer:
         try:
             e = self.fs.filer.find_entry(path).clone()
         except NotFound:
-            ctx.abort(grpc.StatusCode.NOT_FOUND, f"{path} not found")
+            # First append creates the file, like the reference
+            # (filer_grpc_server.go AppendToEntry on ErrNotFound).
+            e = Entry(path=path, attributes=Attributes(mode=0o644))
         offset = e.size()
         for c in req.chunks:
             e.chunks.append(FileChunk(
@@ -287,7 +289,7 @@ class FilerGrpcServer:
                 mtime=c.mtime, etag=c.e_tag,
                 cipher_key=c.cipher_key.hex() if c.cipher_key else ""))
             offset += c.size
-        self.fs.filer.update_entry(e)
+        self.fs.filer.create_entry(e)
         return pb.AppendToEntryResponse()
 
     def _delete_entry(self, req, ctx):
@@ -319,7 +321,9 @@ class FilerGrpcServer:
 
     def _assign_volume(self, req, ctx):
         from ..cluster import rpc as jrpc
-        ttl = f"{req.ttl_sec}s" if req.ttl_sec else ""
+        # TTL grammar has no seconds unit (volume_ttl.go m/h/d/w/M/y):
+        # round seconds up to minutes like the reference SecondsToTTL.
+        ttl = f"{-(-req.ttl_sec // 60)}m" if req.ttl_sec else ""
         try:
             out = self.fs.client.assign(
                 count=req.count or 1, collection=req.collection,
@@ -367,9 +371,31 @@ class FilerGrpcServer:
         return pb.DeleteCollectionResponse()
 
     def _statistics(self, req, ctx):
+        # Aggregate from the master topology dump - the filer has
+        # no volume state of its own (the reference filer proxies
+        # its master the same way).
+        used = files = count = 0
+        limit = 0
+        try:
+            vl = self.fs.client._master_call("/vol/list")
+            for dc in vl["topology"]["data_centers"]:
+                for rack in dc["racks"]:
+                    for n in rack["nodes"]:
+                        for v in n["volumes"]:
+                            if req.collection and \
+                                    v.get("collection", "") != \
+                                    req.collection:
+                                continue
+                            used += v["size"]
+                            files += v["file_count"]
+                            count += 1
+            limit = vl.get("volume_size_limit", 0)
+        except Exception:  # noqa: BLE001 - master down: zeros
+            pass
         return pb.StatisticsResponse(
             replication=req.replication, collection=req.collection,
-            ttl=req.ttl)
+            ttl=req.ttl, total_size=count * limit, used_size=used,
+            file_count=files)
 
     def _get_configuration(self, req, ctx):
         BUCKETS_PATH = "/buckets"  # filer_buckets.go DirBucketsPath
@@ -431,13 +457,15 @@ class FilerGrpcServer:
         return pb.LocateBrokerResponse(found=False)
 
     def _kv_get(self, req, ctx):
-        value = self.fs.filer.store.kv_get(req.key.decode())
+        value = self.fs.filer.store.kv_get(
+            req.key.decode("utf-8", "surrogateescape"))
         if value is None:
             return pb.KvGetResponse(error="not found")
         return pb.KvGetResponse(value=value)
 
     def _kv_put(self, req, ctx):
-        self.fs.filer.store.kv_put(req.key.decode(), req.value)
+        self.fs.filer.store.kv_put(
+            req.key.decode("utf-8", "surrogateescape"), req.value)
         return pb.KvPutResponse()
 
 

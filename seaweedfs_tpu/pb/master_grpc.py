@@ -315,16 +315,20 @@ class MasterGrpcServer:
         """Bidi heartbeat: each pb.Heartbeat maps onto the exact dict
         the HTTP /heartbeat route ingests, so a gRPC volume server and
         a JSON one register identically."""
+        last_max = 0  # per-stream capacity memory
         for hb in request_iterator:
             doc = {"ip": hb.ip, "port": hb.port,
                    "public_url": hb.public_url,
                    "data_center": hb.data_center or "DefaultDataCenter",
                    "rack": hb.rack or "DefaultRack"}
+            # proto3's absent-field 0 must neither register a node that
+            # can never host volumes nor RESET a capacity an earlier
+            # message on this stream established (an omitted key makes
+            # the JSON handler apply its default of 7).
             if hb.max_volume_count > 0:
-                # proto3's absent-field 0 must not register a node that
-                # can never host volumes; omitting the key gets the
-                # JSON plane's default capacity.
-                doc["max_volume_count"] = hb.max_volume_count
+                last_max = hb.max_volume_count
+            if last_max > 0:
+                doc["max_volume_count"] = last_max
             if hb.volumes or hb.has_no_volumes:
                 doc["volumes"] = [_vinfo_dict(v) for v in hb.volumes]
             if hb.new_volumes or hb.deleted_volumes:

@@ -21,14 +21,14 @@ def stack(tmp_path_factory):
     master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp))
     master.start()
     vs = VolumeServer(master.url(), [str(tmp / "vs")],
-                      pulse_seconds=60)
+                      max_volume_counts=[64], pulse_seconds=60)
     vs.start()
     fs = FilerServer(master.url(), chunk_size=1024)
     fs.start()
     g = FilerGrpcServer(fs, port=0)
     g.start()
     chan = grpc.insecure_channel(g.addr())
-    yield master, fs, g, chan
+    yield master, vs, fs, g, chan
     chan.close()
     g.stop()
     fs.stop()
@@ -47,7 +47,7 @@ def test_grpc_full_write_read_cycle(stack):
     """The reference client's upload sequence, entirely over gRPC +
     HTTP data plane: AssignVolume -> POST bytes -> CreateEntry ->
     LookupDirectoryEntry -> LookupVolume -> GET bytes."""
-    _m, fs, _g, chan = stack
+    _m, _vs, fs, _g, chan = stack
     av = _unary(chan, "AssignVolume",
                 pb.AssignVolumeRequest(count=1), pb.AssignVolumeResponse)
     assert av.file_id and not av.error
@@ -83,7 +83,7 @@ def test_grpc_full_write_read_cycle(stack):
 
 
 def test_grpc_list_rename_delete(stack):
-    _m, fs, _g, chan = stack
+    _m, _vs, fs, _g, chan = stack
     for i in range(5):
         rpc.call(f"{fs.url()}/lst/f{i}.txt", "POST", b"x")
     listed = list(chan.unary_stream(
@@ -118,7 +118,7 @@ def test_grpc_list_rename_delete(stack):
 
 
 def test_grpc_configuration_and_kv(stack):
-    master, fs, _g, chan = stack
+    master, _vs, fs, _g, chan = stack
     cfg = _unary(chan, "GetFilerConfiguration",
                  pb.GetFilerConfigurationRequest(),
                  pb.GetFilerConfigurationResponse)
@@ -137,7 +137,7 @@ def test_grpc_configuration_and_kv(stack):
 
 
 def test_grpc_subscribe_metadata_replay_and_tail(stack):
-    _m, fs, _g, chan = stack
+    _m, _vs, fs, _g, chan = stack
     rpc.call(f"{fs.url()}/sub/before.txt", "POST", b"1")
     stream = chan.unary_stream(
         SVC + "SubscribeMetadata",
@@ -168,3 +168,63 @@ def test_grpc_subscribe_metadata_replay_and_tail(stack):
              if r.event_notification.HasField("new_entry")]
     assert "before.txt" in names and "live.txt" in names
     assert all(r.ts_ns for r in got)
+
+
+def test_grpc_binary_hardlink_id_and_kv_keys(stack):
+    """Reference clients send RANDOM BYTES as hard_link_id and may use
+    binary KV keys — both must round-trip, never UnicodeDecodeError."""
+    import os as _os
+    _m, _vs, fs, _g, chan = stack
+    raw_id = bytes(range(240, 256)) + b"\x01"  # non-UTF-8
+    out = _unary(chan, "CreateEntry",
+                 pb.CreateEntryRequest(
+                     directory="/hl",
+                     entry=pb.Entry(
+                         name="linked.txt",
+                         attributes=pb.FuseAttributes(mtime=1,
+                                                      file_mode=0o644),
+                         hard_link_id=raw_id, hard_link_counter=2)),
+                 pb.CreateEntryResponse)
+    assert not out.error
+    lk = _unary(chan, "LookupDirectoryEntry",
+                pb.LookupDirectoryEntryRequest(directory="/hl",
+                                               name="linked.txt"),
+                pb.LookupDirectoryEntryResponse)
+    assert lk.entry.hard_link_id == raw_id
+    assert lk.entry.hard_link_counter == 2
+    bkey = b"\xff\xfe binary key"
+    _unary(chan, "KvPut", pb.KvPutRequest(key=bkey, value=b"v1"),
+           pb.KvPutResponse)
+    got = _unary(chan, "KvGet", pb.KvGetRequest(key=bkey),
+                 pb.KvGetResponse)
+    assert got.value == b"v1"
+
+
+def test_grpc_append_creates_and_assign_ttl(stack):
+    _m, _vs, fs, _g, chan = stack
+    av = _unary(chan, "AssignVolume",
+                pb.AssignVolumeRequest(count=1, ttl_sec=90),
+                pb.AssignVolumeResponse)
+    assert av.file_id and not av.error  # 90s -> "2m", a valid TTL
+    body = b"appended"
+    rpc.call(f"http://{av.url}/{av.file_id}", "POST", body)
+    # first AppendToEntry on a missing path creates it
+    _unary(chan, "AppendToEntry",
+           pb.AppendToEntryRequest(
+               directory="/app", entry_name="log.txt",
+               chunks=[pb.FileChunk(file_id=av.file_id, size=len(body),
+                                    mtime=1)]),
+           pb.AppendToEntryResponse)
+    assert rpc.call(f"{fs.url()}/app/log.txt") == body
+
+
+def test_grpc_filer_statistics_real_numbers(stack):
+    _m, vs, fs, _g, chan = stack
+    rpc.call(f"{fs.url()}/statdir/s.bin", "POST", b"z" * 4096)
+    for loc in vs.store.locations:
+        for v in loc.volumes.values():
+            v.sync()
+    vs._send_heartbeat(full=True)  # counters ride heartbeats
+    st = _unary(chan, "Statistics", pb.StatisticsRequest(),
+                pb.StatisticsResponse)
+    assert st.file_count >= 1 and st.used_size > 0 and st.total_size > 0
