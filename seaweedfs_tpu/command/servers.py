@@ -217,7 +217,10 @@ def run_msg_broker(flags: Flags, args: list[str]) -> int:
         ssl_context=_security("msg_broker"))
     mb.start()
     glog.infof("message broker serving at %s", mb.url())
-    return _wait_forever([mb])
+    g = _start_grpc_plane(
+        mb, flags, flags.get("ip", "127.0.0.1"), "msg_broker",
+        "seaweedfs_tpu.pb.messaging_grpc.MessagingGrpcServer")
+    return _wait_forever([mb] + ([g] if g else []))
 
 
 def run_filer(flags: Flags, args: list[str]) -> int:

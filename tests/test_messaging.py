@@ -175,3 +175,73 @@ def test_delete_topic(broker):
     from seaweedfs_tpu.cluster import rpc
     with pytest.raises(rpc.RpcError):
         c.topic_config("gone", "t")
+
+
+# -- gRPC plane (messaging_pb.SeaweedMessaging) -----------------------------
+
+def test_messaging_grpc_publish_subscribe(broker):
+    import grpc
+    from seaweedfs_tpu.pb import messaging_pb2 as pb
+    from seaweedfs_tpu.pb.messaging_grpc import MessagingGrpcServer
+    g = MessagingGrpcServer(broker, port=0)
+    g.start()
+    chan = grpc.insecure_channel(g.addr())
+    SVC = "/messaging_pb.SeaweedMessaging/"
+    try:
+        unary = lambda name, req, resp: chan.unary_unary(  # noqa: E731
+            SVC + name,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp.FromString)(req, timeout=10)
+        unary("ConfigureTopic",
+              pb.ConfigureTopicRequest(
+                  namespace="chat", topic="grpc",
+                  configuration=pb.TopicConfiguration(
+                      partition_count=1)),
+              pb.ConfigureTopicResponse)
+        cfg = unary("GetTopicConfiguration",
+                    pb.GetTopicConfigurationRequest(namespace="chat",
+                                                    topic="grpc"),
+                    pb.GetTopicConfigurationResponse)
+        assert cfg.configuration.partition_count == 1
+        fb = unary("FindBroker",
+                   pb.FindBrokerRequest(namespace="chat",
+                                        topic="grpc"),
+                   pb.FindBrokerResponse)
+        assert fb.broker == broker.url()
+        # bidi publish: init then two messages
+        pub = chan.stream_stream(
+            SVC + "Publish",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.PublishResponse.FromString)
+        reqs = [
+            pb.PublishRequest(init=pb.PublishRequest.InitMessage(
+                namespace="chat", topic="grpc", partition=0)),
+            pb.PublishRequest(data=pb.Message(key=b"k1",
+                                              value=b"hello grpc")),
+            pb.PublishRequest(data=pb.Message(key=b"k2",
+                                              value=b"second")),
+            pb.PublishRequest(data=pb.Message(is_close=True)),
+        ]
+        out = list(pub(iter(reqs), timeout=10))
+        assert out[0].config.partition_count == 1
+        assert out[-1].is_closed
+        # bidi subscribe from EARLIEST sees both messages
+        sub = chan.stream_stream(
+            SVC + "Subscribe",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.BrokerMessage.FromString)
+        init = pb.SubscriberMessage(
+            init=pb.SubscriberMessage.InitMessage(
+                namespace="chat", topic="grpc", partition=0,
+                startPosition=(
+                    pb.SubscriberMessage.InitMessage.EARLIEST)))
+        got = []
+        for msg in sub(iter([init]), timeout=10):
+            got.append(msg.data)
+            if len(got) == 2:
+                break
+        assert [(m.key, m.value) for m in got] == \
+            [(b"k1", b"hello grpc"), (b"k2", b"second")]
+    finally:
+        chan.close()
+        g.stop()
