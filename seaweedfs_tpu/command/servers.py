@@ -50,54 +50,6 @@ def _wait_forever(servers: list) -> int:
     return 0
 
 
-def _start_master_grpc(m, flags: Flags, ip: str):
-    """Start the master_pb.Seaweed gRPC plane on http port + 10000
-    (ParseServerToGrpcAddress convention; -grpc.port overrides,
-    -grpc=false disables).  TLS rides the same security.toml
-    [grpc.master] section as the HTTPS plane."""
-    if not flags.get_bool("grpc", True):
-        return None
-    try:
-        from ..pb.master_grpc import MasterGrpcServer
-    except ImportError as e:
-        glog.warningf("gRPC plane disabled (grpcio missing: %s)", e)
-        return None
-    from ..utils.security import (grpc_server_credentials,
-                                  security_configuration)
-    g = MasterGrpcServer(
-        m, host=ip, port=flags.get_int("grpc.port", 0) or None,
-        credentials=grpc_server_credentials(security_configuration(),
-                                            "master"))
-    g.start()
-    glog.infof("master gRPC (master_pb.Seaweed) at %s", g.addr())
-    return g
-
-
-def _start_filer_grpc(fs, flags: Flags, ip: str,
-                      allow_port_flag: bool = True):
-    """filer_pb.SeaweedFiler on http port + 10000; same conventions as
-    the master plane (-grpc=false, -grpc.port, security.toml
-    [grpc.filer] TLS).  In `weed server` the -grpc.port override
-    belongs to the master plane, so the filer keeps the convention."""
-    if not flags.get_bool("grpc", True):
-        return None
-    try:
-        from ..pb.filer_grpc import FilerGrpcServer
-    except ImportError as e:
-        glog.warningf("gRPC plane disabled (grpcio missing: %s)", e)
-        return None
-    from ..utils.security import (grpc_server_credentials,
-                                  security_configuration)
-    port = flags.get_int("grpc.port", 0) if allow_port_flag else 0
-    g = FilerGrpcServer(
-        fs, host=ip, port=port or None,
-        credentials=grpc_server_credentials(security_configuration(),
-                                            "filer"))
-    g.start()
-    glog.infof("filer gRPC (filer_pb.SeaweedFiler) at %s", g.addr())
-    return g
-
-
 def _start_grpc_plane(server_obj, flags: Flags, ip: str,
                       component: str, server_cls_path: str,
                       allow_port_flag: bool = True):

@@ -33,10 +33,6 @@ class VolumeGrpcServer:
                  port: int | None = None, max_workers: int = 16,
                  credentials=None):
         self.vs = volume_server
-        # Two-phase vacuum staging: volume id -> snapshot size captured
-        # at Compact time, consumed by Commit (volume_vacuum.go keeps
-        # the same state on the Volume struct).
-        self._vacuum_snapshots: dict[int, int] = {}
         self.port = port if port is not None \
             else volume_server.server.port + GRPC_PORT_DELTA
         self._server = grpc.server(
@@ -242,30 +238,28 @@ class VolumeGrpcServer:
             garbage_ratio=v.garbage_ratio())
 
     def _vacuum_compact(self, req, ctx):
+        # Staging state + guard live on the Volume (storage/vacuum.py),
+        # so compacts from the JSON admin plane or CLI serialize with
+        # this one instead of interleaving .cpd/.cpx writes; re-running
+        # compact replaces a stale staged snapshot like the reference.
         from ..storage.vacuum import compact
         v = self._volume_or_abort(req.volume_id, ctx)
-        self._vacuum_snapshots[req.volume_id] = compact(v)
+        compact(v)
         return pb.VacuumVolumeCompactResponse()
 
     def _vacuum_commit(self, req, ctx):
-        from ..storage.vacuum import commit_compact
+        from ..storage.vacuum import VacuumError, commit_compact
         v = self._volume_or_abort(req.volume_id, ctx)
-        snap = self._vacuum_snapshots.pop(req.volume_id, None)
-        if snap is None:
-            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
-                      "no compact staged for this volume")
-        commit_compact(v, snap)
+        try:
+            commit_compact(v)
+        except VacuumError as e:
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         return pb.VacuumVolumeCommitResponse(is_read_only=v.readonly)
 
     def _vacuum_cleanup(self, req, ctx):
+        from ..storage.vacuum import cleanup_compact
         v = self._volume_or_abort(req.volume_id, ctx)
-        self._vacuum_snapshots.pop(req.volume_id, None)
-        base = v.file_name()
-        for ext in (".cpd", ".cpx"):
-            try:
-                os.remove(base + ext)
-            except FileNotFoundError:
-                pass
+        cleanup_compact(v)
         return pb.VacuumVolumeCleanupResponse()
 
     # -- volume lifecycle ----------------------------------------------------

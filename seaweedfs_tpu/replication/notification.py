@@ -22,6 +22,7 @@ import json
 import os
 import queue as _queue
 import threading
+import time
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
@@ -117,6 +118,100 @@ def _xml_findall(root, tag: str):
     return [el for el in root.iter() if el.tag.split("}")[-1] == tag]
 
 
+
+class _SendSpool:
+    """Bounded in-order send spool drained by one daemon thread.
+
+    publish() must never block the caller on the network: the filer
+    publishes under its meta-log lock, so a slow endpoint would stall
+    every namespace mutation.  Past the bound, events are dropped (with
+    a counter) rather than backpressuring the filer — the durable
+    FileQueue is the right choice when loss is unacceptable.
+
+    close() is terminal: the sender drains-and-discards whatever
+    remains and exits within ~1s (a sentinel would block put() forever
+    on a full spool), and later put()s are counted as dropped.  Every
+    get() is matched by task_done(), so flush()'s join() can never
+    deadlock — including flush() after close().
+    """
+
+    MAX = 65536
+
+    def __init__(self, send: Callable, name: str, maxsize: int = MAX):
+        self._send = send
+        self._name = name
+        self.dropped = 0
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=maxsize)
+        self._sender: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+
+    def put(self, item) -> None:
+        if self._closed.is_set():
+            self.dropped += 1  # closed is terminal: drop, don't
+            return             # respawn a sender per late event
+        self._ensure_sender()
+        try:
+            self._q.put_nowait(item)
+        except _queue.Full:
+            self.dropped += 1
+
+    def _ensure_sender(self) -> None:
+        with self._lock:
+            if self._sender is None or not self._sender.is_alive():
+                self._sender = threading.Thread(
+                    target=self._loop, daemon=True, name=self._name)
+                self._sender.start()
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=1.0)
+            except _queue.Empty:
+                if self._closed.is_set():
+                    return
+                continue
+            try:
+                if self._closed.is_set():
+                    # close() already gave up waiting: discard instead
+                    # of spending up to 70s per event on a dead
+                    # endpoint, so the thread (and the spool it pins)
+                    # actually terminates.
+                    self.dropped += 1
+                else:
+                    self._send(item)
+            except Exception:  # noqa: BLE001 — a dead endpoint drops
+                self.dropped += 1  # the event; never wedges the loop
+            finally:
+                self._q.task_done()
+
+    def flush(self, timeout: float | None = None) -> None:
+        """Block until every spooled publish has been attempted (tests,
+        graceful shutdown).  `timeout` bounds the wait.
+
+        Waits on the queue's all_tasks_done condition directly instead
+        of spawning a join() helper thread: a timed-out flush must not
+        pin a thread (plus the spool it references) until the sends
+        eventually finish — which on a dead endpoint is never."""
+        q = self._q
+        with q.all_tasks_done:
+            if timeout is None:
+                while q.unfinished_tasks:
+                    q.all_tasks_done.wait()
+                return
+            deadline = time.monotonic() + timeout
+            while q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                q.all_tasks_done.wait(remaining)
+
+    def close(self) -> None:
+        if self._sender is not None and self._sender.is_alive():
+            self.flush(timeout=5.0)
+        self._closed.set()
+
+
 class SqsQueue(NotificationQueue):
     """AWS SQS over its HTTP query API — stdlib urllib + the in-repo
     sig v4 signer, no SDK (weed/notification/aws_sqs).
@@ -128,13 +223,6 @@ class SqsQueue(NotificationQueue):
     at-least-once, like the reference's sqs consumer."""
 
     API_VERSION = "2012-11-05"
-    # publish() must never block the caller on the network: the filer
-    # publishes under its meta-log lock, so a slow endpoint would stall
-    # every namespace mutation.  Sends ride an in-order spool drained by
-    # one background thread; past this bound events are dropped (with a
-    # counter) rather than backpressuring the filer — the durable
-    # FileQueue is the right choice when loss is unacceptable.
-    SPOOL_MAX = 65536
 
     def __init__(self, queue_url: str, access_key: str = "",
                  secret_key: str = "", region: str = "us-east-1",
@@ -144,11 +232,11 @@ class SqsQueue(NotificationQueue):
         self.secret_key = secret_key
         self.region = region
         self.wait_seconds = wait_seconds
-        self.dropped = 0
-        self._spool: "_queue.Queue[dict | None]" = \
-            _queue.Queue(maxsize=self.SPOOL_MAX)
-        self._sender: threading.Thread | None = None
-        self._sender_lock = threading.Lock()
+        self._spool = _SendSpool(self._call, "sqs-sender")
+
+    @property
+    def dropped(self) -> int:
+        return self._spool.dropped
 
     def _call(self, params: dict) -> ET.Element:
         body = urllib.parse.urlencode(
@@ -166,54 +254,17 @@ class SqsQueue(NotificationQueue):
         with urllib.request.urlopen(req, timeout=70) as resp:
             return ET.fromstring(resp.read() or b"<empty/>")
 
-    def _ensure_sender(self) -> None:
-        with self._sender_lock:
-            if self._sender is None or not self._sender.is_alive():
-                self._sender = threading.Thread(
-                    target=self._send_loop, daemon=True,
-                    name="sqs-sender")
-                self._sender.start()
-
-    def _send_loop(self) -> None:
-        while True:
-            item = self._spool.get()
-            if item is None:
-                return
-            try:
-                self._call(item)
-            except Exception:  # noqa: BLE001 — a dead endpoint drops
-                self.dropped += 1  # the event; never wedges the loop
-            finally:
-                self._spool.task_done()
-
     def publish(self, key: str, message: dict) -> None:
-        params = {
+        self._spool.put({
             "Action": "SendMessage",
             "MessageBody": json.dumps({"key": key, "message": message},
-                                      separators=(",", ":"))}
-        self._ensure_sender()
-        try:
-            self._spool.put_nowait(params)
-        except _queue.Full:
-            self.dropped += 1
+                                      separators=(",", ":"))})
 
     def flush(self, timeout: float | None = None) -> None:
-        """Block until every spooled publish has been attempted (tests,
-        graceful shutdown).  `timeout` bounds the wait."""
-        if timeout is None:
-            self._spool.join()
-            return
-        deadline = threading.Event()
-        t = threading.Thread(target=lambda: (self._spool.join(),
-                                             deadline.set()),
-                             daemon=True)
-        t.start()
-        deadline.wait(timeout)
+        self._spool.flush(timeout)
 
     def close(self) -> None:
-        if self._sender is not None and self._sender.is_alive():
-            self.flush(timeout=5.0)
-            self._spool.put(None)
+        self._spool.close()
 
     def consume(self, fn: Callable[[str, dict], None]) -> None:
         # Short polling (wait_seconds=0) samples a subset of SQS
@@ -290,68 +341,31 @@ def queue_for_spec(spec: str, **kw) -> NotificationQueue:
 
 
 class AsyncPublisher(NotificationQueue):
-    """Decorator that takes publish() off the caller's thread: the
-    filer publishes under its meta-log lock, so a networked queue
-    (Kafka TCP, Pub/Sub HTTP) must never block it.  Sends ride an
-    in-order bounded spool drained by one background thread; past the
-    bound events are dropped (counted) rather than backpressuring
-    namespace mutations.  consume()/close() delegate to the inner
-    queue.  (SqsQueue carries its own identical spool.)"""
-
-    SPOOL_MAX = 65536
+    """Decorator that takes publish() off the caller's thread: a
+    networked queue (Kafka TCP, Pub/Sub HTTP) rides a _SendSpool so it
+    never blocks the filer's meta-log lock.  consume()/close()
+    delegate to the inner queue.  (SqsQueue carries its own spool.)"""
 
     def __init__(self, inner: NotificationQueue):
         self.inner = inner
-        self.dropped = 0
-        self._spool: "_queue.Queue[tuple | None]" = \
-            _queue.Queue(maxsize=self.SPOOL_MAX)
-        self._sender: threading.Thread | None = None
-        self._sender_lock = threading.Lock()
+        self._spool = _SendSpool(
+            lambda item: self.inner.publish(*item), "notify-sender")
 
-    def _ensure_sender(self) -> None:
-        with self._sender_lock:
-            if self._sender is None or not self._sender.is_alive():
-                self._sender = threading.Thread(
-                    target=self._send_loop, daemon=True,
-                    name="notify-sender")
-                self._sender.start()
-
-    def _send_loop(self) -> None:
-        while True:
-            item = self._spool.get()
-            if item is None:
-                return
-            try:
-                self.inner.publish(*item)
-            except Exception:  # noqa: BLE001 — dead endpoint drops the
-                self.dropped += 1  # event; never wedges the loop
-            finally:
-                self._spool.task_done()
+    @property
+    def dropped(self) -> int:
+        return self._spool.dropped
 
     def publish(self, key: str, message: dict) -> None:
-        self._ensure_sender()
-        try:
-            self._spool.put_nowait((key, message))
-        except _queue.Full:
-            self.dropped += 1
+        self._spool.put((key, message))
 
     def flush(self, timeout: float | None = None) -> None:
-        if timeout is None:
-            self._spool.join()
-            return
-        done = threading.Event()
-        threading.Thread(target=lambda: (self._spool.join(),
-                                         done.set()),
-                         daemon=True).start()
-        done.wait(timeout)
+        self._spool.flush(timeout)
 
     def consume(self, fn: Callable[[str, dict], None]) -> None:
         self.inner.consume(fn)
 
     def close(self) -> None:
-        if self._sender is not None and self._sender.is_alive():
-            self.flush(timeout=5.0)
-            self._spool.put(None)
+        self._spool.close()
         self.inner.close()
 
 

@@ -67,6 +67,12 @@ class Volume:
         # concurrent preads; exclusive for write batches and the vacuum
         # file swap.
         self._file_lock = RWLock()
+        # Vacuum staging state lives on the Volume (volume_vacuum.go
+        # keeps it on the Volume struct) so the in-process planes —
+        # gRPC facade and JSON admin — serialize on the same guard and
+        # a Commit can find the snapshot whichever plane staged it.
+        self.vacuum_lock = threading.RLock()
+        self.vacuum_staged: int | None = None
         base = self.file_name()
         # Tiered volume: the .dat lives on a remote BackendStorage
         # (storage/volume_tier.go); reads proxy through remote_file,

@@ -210,3 +210,50 @@ def test_store_rediscovers_volumes(tmp_path):
     assert store2.has_volume(7)
     assert store2.read_needle(7, 1).data == b"persisted"
     store2.close()
+
+
+def test_vacuum_staging_on_volume(tmp_path):
+    """Two-phase staging state lives on the Volume: commit with nothing
+    staged fails, compact stages, cleanup abandons, and concurrent
+    vacuum() calls from different planes serialize on the volume's
+    guard instead of interleaving .cpd/.cpx writes
+    (weed/storage/volume_vacuum.go keeps this state on the Volume)."""
+    from seaweedfs_tpu.storage.vacuum import (VacuumError, cleanup_compact,
+                                              commit_compact, compact)
+
+    v = Volume(str(tmp_path), "", 1)
+    for i in range(50):
+        v.write_needle(Needle(id=i + 1, cookie=7, data=b"x" * 100))
+    for i in range(25):
+        v.delete_needle(i + 1)
+
+    with pytest.raises(VacuumError):
+        commit_compact(v)  # nothing staged
+
+    compact(v)
+    assert v.vacuum_staged is not None
+    cleanup_compact(v)  # abandon
+    assert v.vacuum_staged is None
+    assert not os.path.exists(v.file_name() + ".cpd")
+    with pytest.raises(VacuumError):
+        commit_compact(v)  # staged snapshot was abandoned
+
+    errs = []
+
+    def worker():
+        try:
+            vacuum(v)
+        except Exception as e:  # noqa: BLE001 — collected for assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert errs == []
+    for i in range(25, 50):
+        assert v.read_needle(i + 1).data == b"x" * 100
+    with pytest.raises(NotFoundError):
+        v.read_needle(1)
+    v.close()
