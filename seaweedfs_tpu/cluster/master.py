@@ -540,9 +540,10 @@ class MasterServer:
         from .raft import NotLeader
         option = self._option_from_query(query)
         count = int(query.get("count", 1))
-        if not self.topo.has_writable_volume(option):
+        layout = self.topo.layout_for(option)
+        if layout.active_volume_count(option) == 0:
             with self._grow_lock:
-                if not self.topo.has_writable_volume(option):
+                if layout.active_volume_count(option) == 0:
                     try:
                         grown = self.vg.grow_by_type(
                             self.topo, option, self._allocate_volume)
@@ -554,7 +555,8 @@ class MasterServer:
                         raise rpc.RpcError(
                             406, "no free volumes and cannot grow")
         try:
-            fid, count, locs = self.topo.pick_for_write(count, option)
+            fid, count, locs = self.topo.pick_for_write(count, option,
+                                                        layout)
         except NotLeader:
             # The RaftSequencer's block alloc can discover lost
             # leadership (exactly the failover window it exists for):

@@ -9,6 +9,7 @@ the image, so the exposition format is emitted directly.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 import urllib.request
@@ -47,11 +48,17 @@ class _Metric:
         self._lock = threading.Lock()
 
     def _key(self, labels: dict[str, str]) -> tuple:
-        if set(labels) != set(self.label_names):
-            raise ValueError(
-                f"{self.name}: labels {sorted(labels)} != declared "
-                f"{sorted(self.label_names)}")
-        return tuple(str(labels[k]) for k in self.label_names)
+        # Hot path (every request observes): build the key directly and
+        # let a KeyError/length mismatch fall into the slow error path
+        # instead of constructing two sets per call.
+        try:
+            if len(labels) == len(self.label_names):
+                return tuple(str(labels[k]) for k in self.label_names)
+        except KeyError:
+            pass
+        raise ValueError(
+            f"{self.name}: labels {sorted(labels)} != declared "
+            f"{sorted(self.label_names)}")
 
     def _labels_of(self, key: tuple) -> dict[str, str]:
         return dict(zip(self.label_names, key))
@@ -157,13 +164,15 @@ class Histogram(_Metric):
         self._totals: dict[tuple, int] = {}
 
     def observe(self, value: float, **labels) -> None:
+        # Per-bucket (non-cumulative) counts + bisect: one increment
+        # per observation instead of a 15-bucket scan — this runs on
+        # every request.  expose() converts to Prometheus cumulative.
         k = self._key(labels)
+        i = bisect.bisect_left(self.buckets, value)
         with self._lock:
             counts = self._counts.setdefault(
-                k, [0] * len(self.buckets))
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    counts[i] += 1
+                k, [0] * (len(self.buckets) + 1))
+            counts[i] += 1
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
 
@@ -189,11 +198,13 @@ class Histogram(_Metric):
             totals = dict(self._totals)
         for key in keys:
             labels = self._labels_of(key)
+            running = 0
             for i, b in enumerate(self.buckets):
+                running += counts[key][i]
                 lb = dict(labels)
                 lb["le"] = f"{b:g}"
                 out.append(f"{self.name}_bucket{_fmt_labels(lb)} "
-                           f"{counts[key][i]}")
+                           f"{running}")
             lb = dict(labels)
             lb["le"] = "+Inf"
             out.append(f"{self.name}_bucket{_fmt_labels(lb)} "

@@ -251,11 +251,12 @@ class Store:
 
     # -- needle CRUD ---------------------------------------------------------
 
-    def write_needle(self, vid: int, n: Needle) -> tuple[int, int]:
+    def write_needle(self, vid: int, n: Needle,
+                     fsync: bool = False) -> tuple[int, int]:
         v = self.find_volume(vid)
         if v is None:
             raise VolumeError(f"volume {vid} not found")
-        return v.write_needle(n)
+        return v.write_needle(n, fsync=fsync)
 
     def read_needle(self, vid: int, needle_id: int,
                     cookie: int | None = None) -> Needle:

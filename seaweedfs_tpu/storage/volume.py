@@ -50,6 +50,71 @@ class _WriteReq:
     error: Exception | None = None
 
 
+class NeedleSlice:
+    """A byte range of a volume's .dat holding one needle's payload,
+    produced by Volume.read_needle_slice after cookie+CRC checks.
+
+    File-like enough for the HTTP responder: read(n) serves chunks via
+    os.pread (the TLS / fallback path) and sendfile_to(sock) moves the
+    whole remainder kernel-side with os.sendfile.  OWNS a dup'd fd of
+    the .dat rather than holding the volume's file lock: a slow client
+    must never block deletes/fsync-writes/vacuum on the volume, and if
+    vacuum swaps the file mid-transfer the dup keeps the old inode
+    alive — the client finishes reading a consistent pre-compact
+    snapshot."""
+
+    __slots__ = ("fd", "offset", "size", "_pos", "_closed")
+
+    def __init__(self, fd: int, offset: int, size: int):
+        self.fd = fd  # dup'd; closed by close()
+        self.offset = offset
+        self.size = size
+        self._pos = 0
+        self._closed = False
+
+    def read(self, n: int = -1) -> bytes:
+        remaining = self.size - self._pos
+        if remaining <= 0:
+            return b""
+        want = remaining if n < 0 else min(n, remaining)
+        data = os.pread(self.fd, want, self.offset + self._pos)
+        if not data:
+            raise VolumeError("needle slice truncated mid-read")
+        self._pos += len(data)
+        return data
+
+    def sendfile_to(self, sock) -> None:
+        """Zero-copy the remaining payload into a plaintext socket."""
+        sock_fd = sock.fileno()
+        end = self.offset + self.size
+        off = self.offset + self._pos
+        while off < end:
+            sent = os.sendfile(sock_fd, self.fd, off,
+                               min(end - off, 8 << 20))
+            if sent == 0:
+                raise ConnectionError("peer closed during sendfile")
+            off += sent
+        self._pos = self.size
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                os.close(self.fd)
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):  # backstop; close() is the contract
+        self.close()
+
+
 class Volume:
     """A single volume. Thread-safe; writes go through the batch worker."""
 
@@ -214,16 +279,28 @@ class Volume:
         self._append_at = offset + len(blob)
         return offset, n.size
 
-    def write_needle(self, n: Needle) -> tuple[int, int]:
-        """Append an object. Returns (offset, stored size). Blocks until the
-        record (and its batch) is fsynced."""
+    def write_needle(self, n: Needle,
+                     fsync: bool = False) -> tuple[int, int]:
+        """Append an object. Returns (offset, stored size).
+
+        Like the reference, writes reach the OS page cache (flush) but
+        are NOT fsynced by default — durability rides replication, and
+        `?fsync=true` opts a request in per-call
+        (topology/store_replicate.go:37-44, writeNeedle2's fsync
+        branch).  fsync=True requests ride the batch worker so
+        concurrent durable writers share one fsync per ≤128-request
+        batch.  Map entries publish only after flush, so a lock-free
+        pread can never observe a mapped offset whose bytes haven't
+        reached the OS.
+        """
         if self._closed:
             raise VolumeError(f"volume {self.vid} is closed")
-        if not self._use_worker:
+        if not fsync or not self._use_worker:
             with self._lock:
                 off, size = self._write_record_locked(n)
                 self._dat.flush()
-                os.fsync(self._dat.fileno())
+                if fsync:
+                    os.fsync(self._dat.fileno())
                 self.nm.put(n.id, off, n.size)
                 self.nm.flush()
                 self.last_modified = time.time()
@@ -304,6 +381,81 @@ class Volume:
             if self.remote_file is not None:
                 return self.remote_file.pread(size, offset)
             return os.pread(self._dat.fileno(), size, offset)
+
+    def read_needle_slice(self, needle_id: int,
+                          cookie: int | None = None,
+                          min_size: int = 0) -> "NeedleSlice | None":
+        """Zero-copy read: locate a needle, verify cookie + CRC by
+        streaming preads, and return a NeedleSlice over the raw data
+        bytes in the .dat — never materializing the payload as one
+        Python object.  The slice rides a dup'd fd, so no volume lock
+        is held during CRC or the client transfer: a vacuum swap
+        mid-read just leaves the reader on the old inode's consistent
+        bytes (the GET handler streams the slice with os.sendfile).
+
+        Returns None when the record needs the full parse path: v1
+        layout, remote-tiered volume, empty body, a body smaller than
+        `min_size`, or flags the read pipeline must interpret
+        (compressed / TTL).  Raises like read_needle for absent or
+        deleted needles so callers map errors identically.
+        (Reference parity: volume_server_handlers_read.go reads then
+        verifies the CRC before writing data out — same check, no
+        userspace copy of the payload.)
+        """
+        from ..core import crc as crc_mod
+        from ..core.needle import (FLAG_HAS_TTL, FLAG_IS_COMPRESSED,
+                                   VERSION1)
+        if self.remote_file is not None or self.version == VERSION1:
+            return None
+        with self._file_lock.read():
+            entry = self.nm.get(needle_id)
+            if entry is None:
+                raise NotFoundError(f"needle {needle_id:x} not found")
+            offset, size = entry
+            if not t.size_is_valid(size):
+                raise NotFoundError(f"needle {needle_id:x} deleted")
+            if size < max(min_size, 5):  # data_size(4)+flags(1) floor
+                return None
+            fd = os.dup(self._dat.fileno())
+        try:
+            head = os.pread(fd, t.NEEDLE_HEADER_SIZE + 4, offset)
+            if len(head) < t.NEEDLE_HEADER_SIZE + 4:
+                raise VolumeError(f"needle {needle_id:x} truncated")
+            disk_cookie = t.get_uint32(head, 0)
+            disk_size = t.get_uint32(head, 12)
+            data_size = t.get_uint32(head, 16)
+            if cookie is not None and disk_cookie != cookie:
+                raise VolumeError(
+                    f"cookie mismatch for needle {needle_id:x}")
+            if disk_size != size or data_size + 5 > size \
+                    or data_size < min_size:
+                os.close(fd)
+                return None  # unusual record: take the full parse path
+            data_off = offset + t.NEEDLE_HEADER_SIZE + 4
+            flags = os.pread(fd, 1, data_off + data_size)
+            if not flags or flags[0] & (FLAG_IS_COMPRESSED
+                                        | FLAG_HAS_TTL):
+                os.close(fd)
+                return None  # needs decode / expiry logic
+            stored = t.get_uint32(os.pread(
+                fd, 4, offset + t.NEEDLE_HEADER_SIZE + size))
+            crc = 0
+            pos, remaining = data_off, data_size
+            while remaining:
+                chunk = os.pread(fd, min(remaining, 4 << 20), pos)
+                if not chunk:
+                    raise VolumeError(
+                        f"needle {needle_id:x} truncated")
+                crc = crc_mod.crc32c(chunk, crc)
+                pos += len(chunk)
+                remaining -= len(chunk)
+            if crc_mod.masked_value(crc) != stored:
+                raise VolumeError(
+                    f"CRC error on needle {needle_id:x}")
+            return NeedleSlice(fd, data_off, data_size)
+        except BaseException:
+            os.close(fd)
+            raise
 
     # -- stats / lifecycle --------------------------------------------------
 

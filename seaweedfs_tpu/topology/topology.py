@@ -8,6 +8,7 @@ sweeps, and volume id / file key issuance.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -281,25 +282,29 @@ class Topology(Node):
 
     # -- writability ---------------------------------------------------------
 
-    def has_writable_volume(self, option: VolumeGrowOption) -> bool:
-        vl = self.get_or_create_layout(
+    def layout_for(self, option: VolumeGrowOption) -> "VolumeLayout":
+        """Resolve the layout for an assign option once — /dir/assign
+        used to resolve it three times (writability check + pick),
+        each with two ReplicaPlacement/TTL parses."""
+        return self.get_or_create_layout(
             option.collection,
             ReplicaPlacement.parse(option.replica_placement),
             TTL.parse(option.ttl))
-        return vl.active_volume_count(option) > 0
 
-    def pick_for_write(self, count: int, option: VolumeGrowOption
+    def has_writable_volume(self, option: VolumeGrowOption) -> bool:
+        return self.layout_for(option).active_volume_count(option) > 0
+
+    def pick_for_write(self, count: int, option: VolumeGrowOption,
+                       layout: "VolumeLayout | None" = None
                        ) -> tuple[str, int, list[DataNode]]:
         """Returns (fid, count, locations) — the Assign core."""
-        vl = self.get_or_create_layout(
-            option.collection,
-            ReplicaPlacement.parse(option.replica_placement),
-            TTL.parse(option.ttl))
+        vl = layout if layout is not None else self.layout_for(option)
         vid, locs = vl.pick_for_write(option)
         if not locs:
             raise ValueError(f"volume {vid} has no locations")
         file_key = self.next_file_key(count)
-        import secrets
-        cookie = secrets.randbits(32)
+        # math/rand cookie like the reference (topology.go:137) — the
+        # cookie is a read-guessing deterrent, not a crypto secret.
+        cookie = random.getrandbits(32)
         from ..core.types import format_file_id
         return format_file_id(vid, file_key, cookie), count, locs

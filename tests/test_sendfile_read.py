@@ -1,0 +1,81 @@
+"""Zero-copy needle GET path: Volume.read_needle_slice + os.sendfile
+(reference parity: volume_server_handlers_read.go serves needle bytes
+after a CRC check — same check here, without a userspace payload copy)."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.core.needle import Needle
+from seaweedfs_tpu.storage.volume import NotFoundError, Volume, VolumeError
+
+
+BIG = os.urandom(512 * 1024)
+
+
+def test_read_needle_slice_verifies_and_serves(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    v.write_needle(Needle(id=7, cookie=0xABC, data=BIG))
+    sl = v.read_needle_slice(7, 0xABC, min_size=1024)
+    assert sl is not None
+    with sl:
+        assert sl.size == len(BIG)
+        got = b""
+        while True:
+            piece = sl.read(100_000)
+            if not piece:
+                break
+            got += piece
+    assert got == BIG
+    # small needles fall back to the parse path
+    v.write_needle(Needle(id=8, cookie=1, data=b"tiny"))
+    assert v.read_needle_slice(8, 1, min_size=1024) is None
+    # wrong cookie refused, absent/deleted raise like read_needle
+    with pytest.raises(VolumeError):
+        v.read_needle_slice(7, 0xDEF, min_size=1024)
+    with pytest.raises(NotFoundError):
+        v.read_needle_slice(999, None, min_size=1024)
+    v.close()
+
+
+def test_read_needle_slice_detects_corruption(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    off, _size = v.write_needle(Needle(id=7, cookie=1, data=BIG))
+    # Flip one payload byte on disk: the streamed CRC must catch it.
+    with open(v.file_name() + ".dat", "r+b") as f:
+        f.seek(off + 16 + 4 + 1000)
+        b = f.read(1)
+        f.seek(off + 16 + 4 + 1000)
+        f.write(bytes((b[0] ^ 0xFF,)))
+    with pytest.raises(VolumeError, match="CRC"):
+        v.read_needle_slice(7, 1, min_size=1024)
+    v.close()
+
+
+def test_large_get_end_to_end_sendfile(tmp_path):
+    """Upload > SENDFILE_MIN through a live cluster, read it back via
+    the HTTP plane (exercises NeedleSlice.sendfile_to on a real
+    socket), and confirm compressed uploads still take the parse path."""
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60)
+    vs.start()
+    try:
+        client = WeedClient(master.url())
+        fid = client.upload_data(BIG)
+        out = rpc.call(f"http://{vs.url()}/{fid}")
+        assert bytes(out) == BIG
+        # a compressible payload stored gzipped must still round-trip
+        # (slice path declines compressed needles)
+        text = (b"the quick brown fox " * 40_000)  # > SENDFILE_MIN
+        fid2 = client.upload(text, name="a.txt")["fid"]
+        assert client.download(fid2) == text
+    finally:
+        vs.stop()
+        master.stop()
