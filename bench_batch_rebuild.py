@@ -28,7 +28,8 @@ import time
 # are too late).
 if os.environ.get("BENCH_REBUILD_TPU") != "1":
     from seaweedfs_tpu.utils.jaxenv import force_cpu
-    force_cpu(device_count=8)
+    force_cpu(device_count=int(os.environ.get("BENCH_REBUILD_DEVICES",
+                                              "8")))
 
 import numpy as np  # noqa: E402
 

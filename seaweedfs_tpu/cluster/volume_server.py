@@ -102,6 +102,7 @@ class VolumeServer:
         s.route("GET", "/admin/ec/shard_file", self._ec_shard_file)
         s.route("POST", "/admin/ec/copy_shard", self._ec_copy_shard)
         s.route("POST", "/admin/ec/receive_shard", self._ec_receive_shard)
+        s.route("POST", "/admin/ec/receive_file", self._ec_receive_file)
         s.route("POST", "/admin/ec/to_volume", self._ec_to_volume)
         s.route("POST", "/query", self._query)
         s.route("GET", "/admin/volume_tail", self._volume_tail)
@@ -963,6 +964,36 @@ class VolumeServer:
                         except (rpc.RpcError, OSError):
                             pass
         return {"volume": vid, "shard": sid, "bytes": len(body)}
+
+    def _ec_receive_file(self, query: dict, body: bytes) -> dict:
+        """Push-mode sidecar install (.ecx/.vif): the batched mesh
+        encode (parallel/cluster_encode.py) builds the sorted index
+        centrally and pushes it to every shard holder — for a fresh
+        encode there is no existing holder a receive_shard ecx_source
+        pull could reach."""
+        vid = int(query["volume"])
+        ext = query.get("ext", ".ecx")
+        if ext not in (".ecx", ".vif"):
+            raise rpc.RpcError(400, f"bad ext {ext}")
+        base = self._volume_base(vid)
+        os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+        # Unique temp per request (cf. receive_shard's per-shard temp
+        # names): concurrent .ecx/.vif pushes — or a push racing its
+        # own retry — must never interleave in one staging file.
+        tmp = (f"{base}.rcvx{ext.lstrip('.')}"
+               f".{threading.get_ident()}.tmp")
+        try:
+            with open(tmp, "wb") as f:
+                f.write(body)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, base + ext)
+        finally:
+            try:
+                os.remove(tmp)
+            except FileNotFoundError:
+                pass
+        return {"volume": vid, "ext": ext, "bytes": len(body)}
 
     def _ec_to_volume(self, query: dict, body: bytes) -> dict:
         """VolumeEcShardsToVolume: local data shards (.ec00-.ec09) + .ecx

@@ -1,0 +1,225 @@
+"""Cluster-integrated batched EC encode: many volumes, one mesh step.
+
+The encode mirror of `cluster_rebuild`: pull quiet/full volumes'
+`.dat`/`.idx` from their servers, stack stripe chunks from MANY volumes
+on the mesh's "vol" axis, compute all parity in batched jitted GF(2)
+bit-matmuls (`sharded_codec.batched_encode` — byte columns sharded over
+"col", zero collectives), then scatter the 14 shards + `.ecx` across
+the cluster, mount them, and delete the original replicas.
+
+The reference encodes one volume at a time ON its own server
+(weed/shell/command_ec_encode.go:92-264 →
+VolumeEcShardsGenerate, server/volume_grpc_erasure_coding.go:40); this
+is the SURVEY §2.3 "shard scatter after encode" mapping instead —
+encoding N quiet volumes is embarrassingly data-parallel over chips,
+and the per-volume chunking reuses the exact `_chunk_reader` the local
+encoder uses, so shard bytes stay byte-identical to `ec.encode`
+(the golden-gate layout).
+
+Shell entry point: `ec.encode -batch` (shell/command_ec.py).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import tempfile
+
+import numpy as np
+
+from ..cluster import rpc
+from ..ec import (DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
+                  TOTAL_SHARDS, to_ext)
+from ..ec.encoder import (DEFAULT_CHUNK, _chunk_reader,
+                          write_sorted_file_from_idx)
+from .cluster_rebuild import _pad_to, make_mesh
+from .sharded_codec import batched_encode
+
+# Column padding granularity — matches cluster_rebuild: keeps the
+# jitted matmul's N lane-aligned and divisible by any col axis <= 16,
+# and collapses ragged tail-chunk widths onto few compiled shapes.
+_COL_ALIGN = 2048
+
+
+def batch_encode(env, vids, mesh=None, max_batch_bytes=1 << 28,
+                 workers: int = 8, chunk_size: int = DEFAULT_CHUNK,
+                 progress=None) -> list[str]:
+    """EC-encode `vids` across the cluster in mesh-batched steps.
+    Returns one human-readable line per volume.
+
+    env: duck-typed cluster view (shell CommandEnv): volume_locations,
+    data_nodes, vs_call.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    targets: list[tuple[int, list[str]]] = []
+    messages: list[str] = []
+    for vid in vids:
+        try:
+            locs = env.volume_locations(vid)
+        except rpc.RpcError as e:
+            if e.status != 404:
+                raise
+            locs = []
+        if not locs:
+            messages.append(f"volume {vid}: SKIPPED — no locations")
+            continue
+        targets.append((vid, locs))
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=workers)
+    try:
+        i = 0
+        while i < len(targets):
+            batch, total = [], 0
+            while i < len(targets) and (not batch
+                                        or total < max_batch_bytes):
+                batch.append(targets[i])
+                total += _dat_size(env, *targets[i])
+                i += 1
+            messages += _encode_batch_group(env, mesh, pool, batch,
+                                            chunk_size, progress)
+    finally:
+        pool.shutdown(wait=False)
+    return messages
+
+
+def _dat_size(env, vid: int, locs: list[str]) -> int:
+    for n in env.data_nodes():
+        for v in n["volumes"]:
+            if v["id"] == vid:
+                return int(v["size"])
+    return 0
+
+
+def _fetch_volume(tmpdir: str, vid: int, locs: list[str]) -> str:
+    """Freeze + pull one volume's .dat/.idx to local temp files,
+    failing over across replicas.  Returns the local base path."""
+    base = os.path.join(tmpdir, str(vid))
+    errors = []
+    for url in locs:
+        try:
+            rpc.call_to_file(
+                f"http://{url}/admin/volume_file?volume={vid}&ext=.idx",
+                base + ".idx")
+            rpc.call_to_file(
+                f"http://{url}/admin/volume_file?volume={vid}&ext=.dat",
+                base + ".dat")
+            return base
+        except Exception as e:  # noqa: BLE001 — next replica
+            errors.append(f"{url}: {type(e).__name__}: {e}")
+    raise rpc.RpcError(
+        502, f"volume {vid}: cannot fetch .dat/.idx: "
+             + "; ".join(errors[:4]))
+
+
+def _encode_batch_group(env, mesh, pool, batch, chunk_size,
+                        progress) -> list[str]:
+    """Fetch, mesh-encode, scatter one sub-batch of volumes."""
+    from ..shell.command_ec import balanced_distribution, collect_ec_nodes
+    vol_axis = mesh.shape["vol"]
+    col_axis = mesh.shape["col"]
+    align = _pad_to(_COL_ALIGN, col_axis * 8)
+    out: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="ec_batch_encode_") as tmp:
+        # 1. Freeze every replica, then pull .dat/.idx in parallel.
+        for vid, locs in batch:
+            for url in locs:
+                env.vs_call(url, "/admin/readonly",
+                            {"volume": vid, "readonly": True})
+        bases = list(pool.map(
+            lambda t: _fetch_volume(tmp, *t), batch))
+
+        # 2. Mesh-encode: lockstep stripe chunks across volumes.  Each
+        # volume's chunk sequence is the exact local-encoder chunking
+        # (byte-identical shards); chunks are stacked on "vol" and
+        # column-padded with zeros (RS parity is columnwise, so padded
+        # columns are discarded zeros, never corruption).
+        writers = [_ShardWriter(b) for b in bases]
+        dats = [open(b + ".dat", "rb") for b in bases]
+        try:
+            iters = [
+                _chunk_reader(d, os.path.getsize(b + ".dat"),
+                              LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
+                              chunk_size)
+                for d, b in zip(dats, bases)]
+            active = list(range(len(iters)))
+            while active:
+                chunks, produced = [], []
+                for v in active:
+                    try:
+                        chunks.append(next(iters[v]))
+                        produced.append(v)
+                    except StopIteration:
+                        writers[v].finish()
+                active = produced
+                if not chunks:
+                    break
+                widths = [c.shape[1] for c in chunks]
+                n_pad = _pad_to(max(widths), align)
+                v_pad = _pad_to(len(chunks), vol_axis)
+                stacked = np.zeros((v_pad, DATA_SHARDS, n_pad),
+                                   np.uint8)
+                for j, c in enumerate(chunks):
+                    stacked[j, :, :c.shape[1]] = c
+                parity = np.asarray(batched_encode(stacked, mesh))
+                for j, v in enumerate(active):
+                    writers[v].write(chunks[j],
+                                     parity[j, :, :widths[j]])
+        finally:
+            for d in dats:
+                d.close()
+
+        # 3. .ecx from the fetched .idx (WriteSortedFileFromIdx).
+        for base in bases:
+            write_sorted_file_from_idx(base)
+
+        # 4. Scatter: balanced placement, push shards + .ecx, mount,
+        # then delete the original replicas (command_ec_encode.go flow).
+        for (vid, locs), base in zip(batch, bases):
+            plan = balanced_distribution(collect_ec_nodes(env))
+            futs = []
+            for url, shards in plan.items():
+                for sid in shards:
+                    with open(base + to_ext(sid), "rb") as f:
+                        payload = f.read()
+                    futs.append(pool.submit(
+                        rpc.call,
+                        f"http://{url}/admin/ec/receive_shard?"
+                        f"volume={vid}&shard={sid}", "POST", payload,
+                        600.0))
+            for f in futs:
+                f.result()
+            with open(base + ".ecx", "rb") as f:
+                ecx = f.read()
+            for url in plan:
+                rpc.call(f"http://{url}/admin/ec/receive_file?"
+                         f"volume={vid}&ext=.ecx", "POST", ecx, 600.0)
+                env.vs_call(url, "/admin/ec/mount", {"volume": vid})
+            for url in locs:
+                env.vs_call(url, "/admin/delete_volume", {"volume": vid})
+            line = (f"volume {vid} -> ec shards on {len(plan)} "
+                    "servers: "
+                    + ", ".join(f"{u}:{s}"
+                                for u, s in sorted(plan.items())))
+            out.append(line)
+            if progress:
+                progress(line)
+    return out
+
+
+class _ShardWriter:
+    """Appends stripe chunks to the 14 local shard files of one volume
+    in arrival order — the same order `write_ec_files` writes them."""
+
+    def __init__(self, base: str):
+        self.files = [open(base + to_ext(i), "wb")
+                      for i in range(TOTAL_SHARDS)]
+
+    def write(self, data: np.ndarray, parity: np.ndarray) -> None:
+        for i in range(DATA_SHARDS):
+            self.files[i].write(data[i].tobytes())
+        for p in range(parity.shape[0]):
+            self.files[DATA_SHARDS + p].write(parity[p].tobytes())
+
+    def finish(self) -> None:
+        for f in self.files:
+            f.close()

@@ -107,8 +107,13 @@ def balanced_distribution(nodes: list[dict],
 class EcEncode(Command):
     name = "ec.encode"
     help = ("ec.encode -volumeId <id>[,<id>...] | -collection <name> "
-            "[-fullPercent 95] — erasure-code volumes and spread the "
-            "shards across the cluster")
+            "[-fullPercent 95] [-batch] [-maxBatchMB 256] — "
+            "erasure-code volumes and spread the shards across the "
+            "cluster.  Default: per-volume generate on the holder "
+            "(VolumeEcShardsGenerate).  -batch: pull quiet volumes, "
+            "encode MANY at once in mesh-batched compiled steps "
+            "(volumes data-parallel over chips), scatter shards + .ecx "
+            "back (SURVEY §2.3 'shard scatter after encode')")
 
     def do(self, args: list[str], env: CommandEnv) -> str:
         env.confirm_is_locked()
@@ -116,10 +121,21 @@ class EcEncode(Command):
         vids = self._collect_vids(flags, env)
         if not vids:
             return "no volumes to encode"
+        if flags.get("batch") == "true":
+            return self.encode_batch(env, vids, flags)
         out = []
         for vid in vids:
             out.append(self.encode_one(env, vid))
         return "\n".join(out)
+
+    def encode_batch(self, env: CommandEnv, vids: list[int],
+                     flags: dict) -> str:
+        from ..parallel import cluster_encode
+        mesh = cluster_encode.make_mesh()
+        max_mb = int(flags.get("maxBatchMB", 256))
+        messages = cluster_encode.batch_encode(
+            env, vids, mesh=mesh, max_batch_bytes=max_mb << 20)
+        return "\n".join(messages) or "no volumes to encode"
 
     def _collect_vids(self, flags: dict, env: CommandEnv) -> list[int]:
         if "volumeId" in flags:

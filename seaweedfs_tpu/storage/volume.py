@@ -296,7 +296,11 @@ class Volume:
         if self._closed:
             raise VolumeError(f"volume {self.vid} is closed")
         if not fsync or not self._use_worker:
-            with self._lock:
+            # Same lock discipline as the batch worker: the file lock
+            # in write mode excludes vacuum's and tiering's fd swaps
+            # (which synchronize on _file_lock.write() only), _lock
+            # orders appends.
+            with self._file_lock.write(), self._lock:
                 off, size = self._write_record_locked(n)
                 self._dat.flush()
                 if fsync:

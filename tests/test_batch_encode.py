@@ -1,0 +1,138 @@
+"""Mesh-batched multi-volume EC encode driven through the shell.
+
+`ec.encode -batch` must pull the quiet volumes' .dat/.idx from their
+servers, encode MANY volumes in mesh-batched compiled steps (volumes
+data-parallel over the 8-device virtual mesh), scatter the 14 shards +
+.ecx across the cluster, mount them, delete the originals — and the
+shard bytes must be byte-identical to the local single-volume encoder
+(`write_ec_files`, the golden-gate layout).
+
+Reference behavior matched: weed/shell/command_ec_encode.go:92-264
+(mark readonly → generate → spread → delete), batched per SURVEY §2.3's
+"shard scatter after encode" mapping.
+"""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.ec import TOTAL_SHARDS, to_ext
+from seaweedfs_tpu.ec.encoder import (write_ec_files,
+                                      write_sorted_file_from_idx)
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _freshen(servers):
+    for vs in servers:
+        vs._send_heartbeat(full=True)
+        vs._ec_loc_cache.clear()
+
+
+def _fill_volumes(master, n_volumes=3, objs_per_volume=6):
+    client = WeedClient(master.url())
+    rpc.call_json(f"{master.url()}/vol/grow?count={n_volumes}", "POST")
+    by_vid: dict[int, list] = {}
+    i = 0
+    while any(len(v) < objs_per_volume
+              for v in by_vid.values()) or len(by_vid) < n_volumes:
+        payload = f"batch-encode-{i}".encode() * (i % 9 + 1)
+        fid = client.upload_data(payload)
+        by_vid.setdefault(int(fid.split(",")[0]), []).append(
+            (payload, fid))
+        i += 1
+        if i > 400:
+            break
+    vids = sorted(by_vid)[:n_volumes]
+    return client, {vid: by_vid[vid] for vid in vids}
+
+
+def test_batch_encode_through_shell(cluster, tmp_path):
+    master, servers = cluster
+    client, volumes = _fill_volumes(master, n_volumes=3)
+    vids = sorted(volumes)
+    env = CommandEnv(master.url())
+    _freshen(servers)
+
+    # Expected shards: pull each .dat/.idx and run the LOCAL encoder —
+    # the batch path must produce byte-identical outputs.
+    expect_dir = tmp_path / "expected"
+    expect_dir.mkdir()
+    expected: dict[int, dict[int, bytes]] = {}
+    ecx: dict[int, bytes] = {}
+    for vid in vids:
+        url = env.volume_locations(vid)[0]
+        base = str(expect_dir / str(vid))
+        rpc.call_to_file(f"http://{url}/admin/volume_file?volume={vid}"
+                         "&ext=.dat", base + ".dat")
+        rpc.call_to_file(f"http://{url}/admin/volume_file?volume={vid}"
+                         "&ext=.idx", base + ".idx")
+        write_ec_files(base)
+        write_sorted_file_from_idx(base)
+        expected[vid] = {
+            s: open(base + to_ext(s), "rb").read()
+            for s in range(TOTAL_SHARDS)}
+        ecx[vid] = open(base + ".ecx", "rb").read()
+
+    run_command(env, "lock")
+    out = run_command(
+        env, "ec.encode -volumeId " + ",".join(map(str, vids))
+        + " -batch")
+    for vid in vids:
+        assert f"volume {vid} -> ec shards" in out, out
+
+    _freshen(servers)
+    for vid, pairs in volumes.items():
+        # Original volume gone everywhere; 14 shards live + mounted.
+        assert env.volume_locations(vid) == []
+        locs = env.ec_shard_locations(vid)
+        assert sorted(locs) == list(range(TOTAL_SHARDS)), \
+            f"volume {vid}: {sorted(locs)}"
+        # Byte-identity vs the local encoder, shard by shard (+ .ecx).
+        for sid in range(TOTAL_SHARDS):
+            got = bytes(rpc.call(
+                f"http://{locs[sid][0]}/admin/ec/shard_file?"
+                f"volume={vid}&shard={sid}"))
+            assert got == expected[vid][sid], \
+                f"volume {vid} shard {sid} differs from local encode"
+        got_ecx = bytes(rpc.call(
+            f"http://{locs[0][0]}/admin/ec/shard_file?"
+            f"volume={vid}&ext=.ecx"))
+        assert got_ecx == ecx[vid]
+        # Every object reads back through the EC path.
+        for payload, fid in pairs:
+            assert bytes(client.download(fid)) == payload
+    env.close()
+
+
+def test_batch_encode_skips_missing_volume(cluster):
+    master, servers = cluster
+    client, volumes = _fill_volumes(master, n_volumes=1)
+    vid = next(iter(volumes))
+    env = CommandEnv(master.url())
+    _freshen(servers)
+    run_command(env, "lock")
+    out = run_command(env, f"ec.encode -volumeId 9999,{vid} -batch")
+    assert "volume 9999: SKIPPED" in out
+    assert f"volume {vid} -> ec shards" in out
+    env.close()
