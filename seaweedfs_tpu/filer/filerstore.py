@@ -295,6 +295,29 @@ def store_for_path(path: str | None) -> FilerStore:
         return ShardedKvStore(cfg.get_string("sharded_kv.dir") or path)
     if cfg.get_bool("sqlite.enabled"):
         return SqliteStore(cfg.get_string("sqlite.file") or path)
+    if cfg.get_bool("redis.enabled"):
+        # filer.toml [redis] — scaffold.go's redis section shape.
+        from .redis_store import RedisStore
+        return RedisStore(
+            host=cfg.get_string("redis.address",
+                                "localhost:6379").split(":")[0],
+            port=int((cfg.get_string("redis.address", "localhost:6379")
+                      .split(":") + ["6379"])[1]),
+            password=cfg.get_string("redis.password"),
+            database=int(cfg.get_string("redis.database", "0") or 0))
+    for section, dialect_name in (("mysql", "mysql"),
+                                  ("postgres", "postgres")):
+        if cfg.get_bool(f"{section}.enabled"):
+            # No mysql/postgres DBAPI driver ships in this image: the
+            # dialect's exact SQL runs on a local sqlite engine (the
+            # abstract_sql layer is the compatibility surface; point a
+            # real driver at AbstractSqlStore to reach a server).
+            from .abstract_sql import (MysqlDialect, PostgresDialect,
+                                       sqlite_validating_store)
+            dialect = MysqlDialect() if dialect_name == "mysql" \
+                else PostgresDialect()
+            return sqlite_validating_store(
+                dialect, cfg.get_string(f"{section}.file") or path)
     import os
     if os.path.isfile(path):
         # An existing regular file is a sqlite store from a previous

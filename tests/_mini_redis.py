@@ -1,0 +1,140 @@
+"""In-process mini-RESP2 server for RedisStore tests — the same
+no-server-needed pattern as the fake Kafka broker in
+test_kafka_queue.py.  Implements just the command set
+universal_redis_store.go uses (SET[+EX]/GET/DEL/SADD/SREM/SMEMBERS)
+plus AUTH/SELECT/PING, with lazy key expiry."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+
+class MiniRedis:
+    def __init__(self, password: str = ""):
+        self.password = password
+        self.dbs: dict[int, dict] = {}
+        self.expiry: dict[tuple[int, bytes], float] = {}
+        self.lock = threading.Lock()
+        self.commands_seen: list[list[bytes]] = []
+        self._sock = socket.create_server(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._running = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _db(self, n: int) -> dict:
+        return self.dbs.setdefault(n, {})
+
+    def _serve(self, conn):
+        rf = conn.makefile("rb")
+        db = 0
+        authed = not self.password
+        try:
+            while True:
+                line = rf.readline()
+                if not line:
+                    return
+                assert line[:1] == b"*", line
+                nargs = int(line[1:])
+                args = []
+                for _ in range(nargs):
+                    ln = rf.readline()
+                    assert ln[:1] == b"$"
+                    n = int(ln[1:])
+                    args.append(rf.read(n + 2)[:-2])
+                cmd = args[0].upper()
+                with self.lock:
+                    self.commands_seen.append(args)
+                    if cmd == b"AUTH":
+                        if args[1].decode() == self.password:
+                            authed = True
+                            conn.sendall(b"+OK\r\n")
+                        else:
+                            conn.sendall(b"-ERR invalid password\r\n")
+                        continue
+                    if not authed:
+                        conn.sendall(b"-NOAUTH Authentication required."
+                                     b"\r\n")
+                        continue
+                    conn.sendall(self._run(db, cmd, args))
+                    if cmd == b"SELECT":
+                        db = int(args[1])
+        except (OSError, AssertionError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def _expired(self, db: int, key: bytes) -> bool:
+        exp = self.expiry.get((db, key))
+        if exp is not None and time.time() > exp:
+            self._db(db).pop(key, None)
+            self.expiry.pop((db, key), None)
+            return True
+        return False
+
+    def _run(self, db: int, cmd: bytes, args: list[bytes]) -> bytes:
+        d = self._db(db)
+        if cmd == b"PING":
+            return b"+PONG\r\n"
+        if cmd == b"SELECT":
+            return b"+OK\r\n"
+        if cmd == b"SET":
+            d[args[1]] = args[2]
+            self.expiry.pop((db, args[1]), None)
+            if len(args) >= 5 and args[3].upper() == b"EX":
+                self.expiry[(db, args[1])] = time.time() + int(args[4])
+            return b"+OK\r\n"
+        if cmd == b"GET":
+            if self._expired(db, args[1]):
+                return b"$-1\r\n"
+            v = d.get(args[1])
+            if v is None or isinstance(v, set):
+                return b"$-1\r\n"
+            return b"$%d\r\n%s\r\n" % (len(v), v)
+        if cmd == b"DEL":
+            n = 0
+            for k in args[1:]:
+                if d.pop(k, None) is not None:
+                    n += 1
+                self.expiry.pop((db, k), None)
+            return b":%d\r\n" % n
+        if cmd == b"SADD":
+            s = d.setdefault(args[1], set())
+            n = 0
+            for m in args[2:]:
+                if m not in s:
+                    s.add(m)
+                    n += 1
+            return b":%d\r\n" % n
+        if cmd == b"SREM":
+            s = d.get(args[1], set())
+            n = 0
+            for m in args[2:]:
+                if m in s:
+                    s.discard(m)
+                    n += 1
+            return b":%d\r\n" % n
+        if cmd == b"SMEMBERS":
+            s = d.get(args[1], set())
+            out = b"*%d\r\n" % len(s)
+            for m in sorted(s):
+                out += b"$%d\r\n%s\r\n" % (len(m), m)
+            return out
+        return b"-ERR unknown command '%s'\r\n" % cmd
+
+    def close(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass

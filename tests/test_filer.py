@@ -119,8 +119,10 @@ def test_total_size():
 # -- store conformance -------------------------------------------------------
 
 @pytest.fixture(params=["memory", "sqlite", "sqlite-file", "ordered_kv",
-                        "sharded_kv"])
+                        "sharded_kv", "redis", "sql-mysql",
+                        "sql-postgres"])
 def store(request, tmp_path):
+    mini = None
     if request.param == "memory":
         s = MemoryStore()
     elif request.param == "sqlite":
@@ -131,10 +133,25 @@ def store(request, tmp_path):
     elif request.param == "sharded_kv":
         from seaweedfs_tpu.filer.ordered_kv import ShardedKvStore
         s = ShardedKvStore(str(tmp_path / "skv"), shards=4)
+    elif request.param == "redis":
+        from seaweedfs_tpu.filer.redis_store import RedisStore
+        from _mini_redis import MiniRedis
+        mini = MiniRedis()
+        s = RedisStore("127.0.0.1", mini.port)
+    elif request.param == "sql-mysql":
+        from seaweedfs_tpu.filer.abstract_sql import (
+            MysqlDialect, sqlite_validating_store)
+        s = sqlite_validating_store(MysqlDialect())
+    elif request.param == "sql-postgres":
+        from seaweedfs_tpu.filer.abstract_sql import (
+            PostgresDialect, sqlite_validating_store)
+        s = sqlite_validating_store(PostgresDialect())
     else:
         s = SqliteStore(str(tmp_path / "filer.db"))
     yield s
     s.close()
+    if mini is not None:
+        mini.close()
 
 
 class TestStoreConformance:
