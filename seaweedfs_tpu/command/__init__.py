@@ -9,6 +9,7 @@ memory and scripts carry over.
 
 from __future__ import annotations
 
+import os
 import sys
 from dataclasses import dataclass, field
 from typing import Callable
@@ -116,6 +117,14 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     flags, rest = parse_flags(args)
     glog.setup(verbosity=flags.get_int("v", 0))
+    # Offset width flavor: the reference's 5BytesOffset BUILD tag
+    # (storage/types/offset_5bytes.go) as a process-wide config —
+    # `-offsetBytes=5` on any command, or WEED_OFFSET_BYTES=5.
+    offset_bytes = flags.get_int(
+        "offsetBytes", int(os.environ.get("WEED_OFFSET_BYTES", "4")))
+    if offset_bytes != 4:
+        from ..core.types import set_offset_flavor
+        set_offset_flavor(offset_bytes)
     # -cpuprofile/-memprofile on any subcommand (grace.SetupProfiling):
     # begin profiling now, dump at process exit.
     if flags.get("cpuprofile") or flags.get("memprofile"):

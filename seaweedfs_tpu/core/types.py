@@ -29,6 +29,27 @@ TOMBSTONE_FILE_SIZE = -1  # Size(-1)
 MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8  # 32GB (4-byte offsets)
 
 
+def set_offset_flavor(nbytes: int) -> None:
+    """Select the offset width, the analog of the reference's
+    5BytesOffset BUILD flavor (weed/storage/types/offset_5bytes.go:9-16
+    vs offset_4bytes.go — a compile tag there, a process-wide config
+    here; `weed ... -offsetBytes=5` or WEED_OFFSET_BYTES=5).
+
+    4 bytes: 32GB max volume (the default).  5 bytes: the stored form
+    grows to 4 big-endian low bytes + 1 high byte (the reference's b4),
+    widening `.idx`/`.ecx` records to 17 bytes and raising the cap to
+    8TB.  Like the reference's build flavors, the two layouts are not
+    cross-readable — pick one per deployment.
+    """
+    global OFFSET_SIZE, NEEDLE_MAP_ENTRY_SIZE, MAX_POSSIBLE_VOLUME_SIZE
+    if nbytes not in (4, 5):
+        raise ValueError(f"offset flavor must be 4 or 5, got {nbytes}")
+    OFFSET_SIZE = nbytes
+    NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE
+    MAX_POSSIBLE_VOLUME_SIZE = (
+        4 * 1024 * 1024 * 1024 * 8 * (256 if nbytes == 5 else 1))
+
+
 def size_is_deleted(size: int) -> bool:
     return size < 0 or size == TOMBSTONE_FILE_SIZE
 
@@ -72,13 +93,23 @@ def get_uint16(b: bytes, off: int = 0) -> int:
 
 
 def offset_to_bytes(actual_offset: int) -> bytes:
-    """Actual byte offset (multiple of 8) -> 4-byte stored form."""
-    return put_uint32(actual_offset // NEEDLE_PADDING_SIZE)
+    """Actual byte offset (multiple of 8) -> stored form.
+
+    4-byte flavor: big-endian u32 of the /8 units.  5-byte flavor:
+    the same 4 bytes followed by the high byte (bits 32-39 of the
+    units) — offset_5bytes.go OffsetToBytes puts b4 LAST."""
+    units = actual_offset // NEEDLE_PADDING_SIZE
+    if OFFSET_SIZE == 4:
+        return put_uint32(units)
+    return put_uint32(units & 0xFFFFFFFF) + bytes(((units >> 32) & 0xFF,))
 
 
 def offset_from_bytes(b: bytes, off: int = 0) -> int:
-    """4-byte stored form -> actual byte offset."""
-    return get_uint32(b, off) * NEEDLE_PADDING_SIZE
+    """Stored form -> actual byte offset."""
+    units = get_uint32(b, off)
+    if OFFSET_SIZE == 5:
+        units |= b[off + 4] << 32
+    return units * NEEDLE_PADDING_SIZE
 
 
 def offset_is_zero(actual_offset: int) -> bool:

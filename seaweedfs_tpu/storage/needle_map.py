@@ -34,9 +34,32 @@ import numpy as np
 from ..core import idx as idx_mod
 from ..core import types as t
 
-# The on-disk .idx record, vectorizable: big-endian u64 key, u32 offset
-# (units of 8 bytes), i32 size.
-_IDX_DTYPE = np.dtype([("key", ">u8"), ("offset", ">u4"), ("size", ">i4")])
+# The on-disk .idx record, vectorizable: big-endian u64 key, then the
+# offset in /8 units (u32, or u32-low + u1-high for the 5-byte/8TB
+# flavor), then i32 size.  Resolved per call so set_offset_flavor()
+# takes effect.
+_IDX_DTYPE_4 = np.dtype([("key", ">u8"), ("offset", ">u4"),
+                         ("size", ">i4")])
+_IDX_DTYPE_5 = np.dtype([("key", ">u8"), ("offset", ">u4"),
+                         ("off_hi", "u1"), ("size", ">i4")])
+
+
+def _idx_dtype() -> np.dtype:
+    return _IDX_DTYPE_4 if t.OFFSET_SIZE == 4 else _IDX_DTYPE_5
+
+
+def _units_col(arr: np.ndarray) -> np.ndarray:
+    """Offset column in /8 units as uint64, either flavor."""
+    units = arr["offset"].astype(np.uint64)
+    if "off_hi" in (arr.dtype.names or ()):
+        units |= arr["off_hi"].astype(np.uint64) << 32
+    return units
+
+
+def _off_np_dtype():
+    """In-memory width for stored offset units: u32 suffices for the
+    4-byte flavor; the 8TB flavor needs 40 bits."""
+    return np.uint32 if t.OFFSET_SIZE == 4 else np.uint64
 
 
 def _keep_last_live(arr: np.ndarray) -> np.ndarray:
@@ -47,7 +70,7 @@ def _keep_last_live(arr: np.ndarray) -> np.ndarray:
     _uniq, idx_rev = np.unique(keys[::-1], return_index=True)
     last = len(keys) - 1 - idx_rev  # ascending-key order
     sel = arr[last]
-    live = (sel["offset"].astype(np.uint32) > 0) & \
+    live = (_units_col(sel) > 0) & \
            (sel["size"].astype(np.int32) > 0)
     return sel[live]
 
@@ -172,7 +195,7 @@ class CompactNeedleMap:
 
     def __init__(self, idx_file=None):
         self._keys = np.empty(0, np.uint64)
-        self._offs = np.empty(0, np.uint32)   # units of NEEDLE_PADDING
+        self._offs = np.empty(0, _off_np_dtype())  # /8 units
         self._sizes = np.empty(0, np.int32)
         self._overflow: dict[int, tuple[int, int]] = {}
         self._live = 0
@@ -197,15 +220,15 @@ class CompactNeedleMap:
         f.seek(0, os.SEEK_END)
         nm = cls(idx_file=f)
         usable = len(raw) - len(raw) % idx_mod.ENTRY_SIZE
-        arr = np.frombuffer(raw[:usable], dtype=_IDX_DTYPE)
+        arr = np.frombuffer(raw[:usable], dtype=_idx_dtype())
         if len(arr) == 0:
             return nm
-        offs = arr["offset"].astype(np.uint32)
+        offs = _units_col(arr)
         sizes = arr["size"].astype(np.int32)
         nm.metrics.maximum_file_key = int(arr["key"].astype(np.uint64).max())
         live_sel = _keep_last_live(arr)
         nm._keys = live_sel["key"].astype(np.uint64)
-        nm._offs = live_sel["offset"].astype(np.uint32)
+        nm._offs = _units_col(live_sel).astype(_off_np_dtype())
         nm._sizes = live_sel["size"].astype(np.int32)
         nm._live = len(live_sel)
         writes = (offs > 0) & (sizes > 0)
@@ -283,7 +306,7 @@ class CompactNeedleMap:
         items = sorted(self._overflow.items())
         okeys = np.array([k for k, _ in items], np.uint64)
         ooffs = np.array([v[0] // t.NEEDLE_PADDING_SIZE for _, v in items],
-                         np.uint32)
+                         _off_np_dtype())
         osizes = np.array([v[1] for _, v in items], np.int32)
         keep = ~np.isin(self._keys, okeys, assume_unique=True)
         olive = osizes > 0
@@ -360,7 +383,7 @@ class SortedFileNeedleMap:
                 break
             arr = np.frombuffer(
                 chunk[:len(chunk) - len(chunk) % idx_mod.ENTRY_SIZE],
-                dtype=_IDX_DTYPE)
+                dtype=_idx_dtype())
             sizes = arr["size"].astype(np.int64)
             live = sizes > 0
             self._live += int(live.sum())
@@ -382,7 +405,7 @@ class SortedFileNeedleMap:
         with open(idx_path, "rb") as f:
             raw = f.read()
         usable = len(raw) - len(raw) % idx_mod.ENTRY_SIZE
-        arr = np.frombuffer(raw[:usable], dtype=_IDX_DTYPE)
+        arr = np.frombuffer(raw[:usable], dtype=_idx_dtype())
         payload = _keep_last_live(arr).tobytes() if len(arr) else b""
         tmp = sdx_path + ".tmp"
         with open(tmp, "wb") as out:
