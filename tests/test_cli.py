@@ -238,10 +238,18 @@ def test_server_upload_download_roundtrip(tmp_path, capsys):
         proc.wait(timeout=10)
 
 
-def test_server_full_stack_s3_webdav(tmp_path):
+@pytest.mark.parametrize("transport", ["json", "grpc"])
+def test_server_full_stack_s3_webdav(tmp_path, transport):
     """Capstone: one `weed server -filer=true -s3=true -webdav=true`
     process; an object PUT through the S3 gateway reads back through
-    S3, the filer HTTP API, and WebDAV."""
+    S3, the filer HTTP API, and WebDAV.
+
+    Parametrized over the filer's internal master transport: with
+    WEED_INTERNAL_GRPC=1 the filer's assign/lookup traffic rides the
+    wire-compatible master_pb.Seaweed gRPC plane instead of the JSON
+    plane, so the gRPC facade is exercised by real cluster operation,
+    not only its dedicated tests (round-4 facade-drift canary)."""
+    import os as _os
     import socket
     import subprocess
     import sys as _sys
@@ -256,6 +264,9 @@ def test_server_full_stack_s3_webdav(tmp_path):
     mport, vport, fport, s3port, davport = (free_port() for _ in range(5))
     data_dir = tmp_path / "data"
     data_dir.mkdir()
+    env = dict(_os.environ)
+    if transport == "grpc":
+        env["WEED_INTERNAL_GRPC"] = "1"
     proc = subprocess.Popen(
         [_sys.executable, "-m", "seaweedfs_tpu", "server",
          f"-master.port={mport}", f"-volume.port={vport}",
@@ -263,7 +274,7 @@ def test_server_full_stack_s3_webdav(tmp_path):
          "-filer=true", f"-filer.port={fport}",
          "-s3=true", f"-s3.port={s3port}",
          "-webdav=true", f"-webdav.port={davport}"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
     def wait_http(url, deadline):
         while _time.time() < deadline:
