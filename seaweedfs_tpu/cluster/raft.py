@@ -123,6 +123,10 @@ class RaftNode:
         self._last_heartbeat = time.monotonic()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # Outbound RPC transport — injectable so fault-injection tests
+        # can partition nodes (raise on blocked links) without touching
+        # the network stack.  Production uses the pooled JSON client.
+        self.transport: Callable = rpc.call_json
 
     # -- persistence ---------------------------------------------------------
     # Meta (term/vote) is a tiny JSON rewritten on change; the log is an
@@ -659,7 +663,7 @@ class RaftNode:
 
             def ask(peer: str) -> None:
                 try:
-                    out = rpc.call_json(
+                    out = self.transport(
                         peer + "/raft/request_vote",
                         payload={"term": term, "candidate_id": self.id,
                                  "last_log_index": last_idx,
@@ -735,7 +739,7 @@ class RaftNode:
                 commit = self.commit_index
         if snap is not None:
             try:
-                out = rpc.call_json(
+                out = self.transport(
                     peer + "/raft/install_snapshot",
                     payload={"term": term, "leader_id": self.id,
                              "snapshot": snap},
@@ -754,7 +758,7 @@ class RaftNode:
                     self.next_index[peer] = self.match_index[peer] + 1
             return
         try:
-            out = rpc.call_json(
+            out = self.transport(
                 peer + "/raft/append_entries",
                 payload={"term": term, "leader_id": self.id,
                          "prev_log_index": prev_idx,

@@ -1,0 +1,568 @@
+"""Raft fault injection: partitions, leader kills mid-operation,
+InstallSnapshot racing appends, membership churn under load.
+
+The reference trusts a battle-tested library
+(weed/server/raft_server.go vendoring chrislusf/raft); a from-scratch
+raft earns trust through adversarial schedules (VERDICT r4 #4).  Every
+test asserts the two safety properties that matter to the master:
+no committed entry is ever lost or reordered, and file ids / volume
+ids stay unique+monotonic across every failover schedule.
+
+Partitioning uses the RaftNode.transport seam: a blocked link raises
+like a dead TCP connection, in BOTH directions.
+"""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.raft import LEADER, NotLeader, RaftNode
+
+
+class Net:
+    """Bidirectional partition fabric over the transport seam."""
+
+    def __init__(self):
+        self.cut: set[frozenset] = set()
+
+    def isolate(self, node_id: str, others: list[str]) -> None:
+        for o in others:
+            if o != node_id:
+                self.cut.add(frozenset((node_id, o)))
+
+    def heal(self) -> None:
+        self.cut.clear()
+
+    def transport_for(self, node_id: str):
+        def call(url: str, *a, **kw):
+            target = url.split("/raft/")[0]
+            if frozenset((node_id, target)) in self.cut:
+                raise ConnectionError(
+                    f"partitioned: {node_id} -/-> {target}")
+            return rpc.call_json(url, *a, **kw)
+        return call
+
+
+def _mk_cluster(n, tmp_path, sinks, net: Net | None = None,
+                compact_threshold: int = 1000):
+    servers = [rpc.JsonHttpServer() for _ in range(n)]
+    urls = [s.url() for s in servers]
+    nodes = []
+    for i, s in enumerate(servers):
+        node = RaftNode(
+            urls[i], urls,
+            apply_fn=lambda cmd, i=i: sinks[i].append(cmd),
+            state_path=str(tmp_path / f"raft{i}.json"),
+            election_timeout=(0.25, 0.5), heartbeat_interval=0.06,
+            compact_threshold=compact_threshold)
+        if net is not None:
+            node.transport = net.transport_for(urls[i])
+        node.mount(s)
+        s.start()
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    return servers, urls, nodes
+
+
+def _wait_leader(nodes, timeout=20.0, exclude=()):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [x for x in nodes
+                   if x.state == LEADER and x not in exclude]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.03)
+    raise AssertionError("no single leader")
+
+
+def _wait_converged(sinks, n_entries, nodes=None, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(len(s) >= n_entries for s in sinks):
+            return
+        time.sleep(0.03)
+    raise AssertionError(
+        f"sinks never reached {n_entries}: {[len(s) for s in sinks]}")
+
+
+def _vals(sink):
+    return [c.get("v") for c in sink if "v" in c]
+
+
+def _teardown(nodes, servers):
+    for x in nodes:
+        x.stop()
+    for s in servers:
+        s.stop()
+
+
+def test_partitioned_leader_cannot_commit_and_steps_down(tmp_path):
+    """Classic partition: the old leader in the minority must never
+    commit; the majority side elects and commits; after heal the old
+    leader steps down and converges WITHOUT losing the majority's
+    committed entries."""
+    net = Net()
+    sinks = [[], [], []]
+    servers, urls, nodes = _mk_cluster(3, tmp_path, sinks, net)
+    try:
+        leader = _wait_leader(nodes)
+        leader.propose({"v": 0})
+        _wait_converged(sinks, 1)
+        net.isolate(leader.id, urls)
+        # Minority leader: this proposal must NOT commit anywhere.
+        with pytest.raises((TimeoutError, NotLeader)):
+            leader.propose({"v": "lost"}, timeout=1.5)
+        majority = [x for x in nodes if x is not leader]
+        new_leader = _wait_leader(majority, exclude=(leader,))
+        for i in range(1, 4):
+            new_leader.propose({"v": i})
+        maj_sinks = [sinks[nodes.index(x)] for x in majority]
+        _wait_converged(maj_sinks, 4)
+        net.heal()
+        # Old leader rejoins as follower and converges.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                (leader.state == LEADER or len(sinks[nodes.index(leader)]) < 4):
+            time.sleep(0.05)
+        assert leader.state != LEADER
+        _wait_converged(sinks, 4)
+        for s in sinks:
+            assert _vals(s)[:4] == [0, 1, 2, 3]
+            assert "lost" not in _vals(s)
+    finally:
+        _teardown(nodes, servers)
+
+
+def test_no_commit_without_quorum(tmp_path):
+    net = Net()
+    sinks = [[], [], []]
+    servers, urls, nodes = _mk_cluster(3, tmp_path, sinks, net)
+    try:
+        leader = _wait_leader(nodes)
+        leader.propose({"v": 0})
+        _wait_converged(sinks, 1)
+        for u in urls:  # full partition: every link cut
+            net.isolate(u, urls)
+        with pytest.raises((TimeoutError, NotLeader)):
+            leader.propose({"v": "never"}, timeout=1.5)
+        time.sleep(0.5)
+        for s in sinks:
+            assert "never" not in _vals(s)
+        net.heal()
+        nl = _wait_leader(nodes)
+        nl.propose({"v": 1}, timeout=10)
+        _wait_converged(sinks, 2)
+        for s in sinks:
+            assert _vals(s)[:2] in ([0, 1], [0, "never"])  # see below
+        # "never" may commit after heal ONLY if the old leader retained
+        # leadership and its entry replicated — that is legal raft
+        # (uncommitted != must-be-lost).  What is illegal is loss of a
+        # committed entry or divergence between sinks:
+        assert len({tuple(map(str, _vals(s)[:2])) for s in sinks}) == 1
+    finally:
+        _teardown(nodes, servers)
+
+
+def test_partition_heal_cycles_converge_identically(tmp_path):
+    """Repeated partition/heal churn with proposals in between: all
+    state machines end byte-identical, committed prefix preserved."""
+    net = Net()
+    sinks = [[], [], []]
+    servers, urls, nodes = _mk_cluster(3, tmp_path, sinks, net)
+    try:
+        seq = 0
+        committed: list[int] = []
+        for cycle in range(3):
+            leader = _wait_leader(nodes, timeout=15)
+            for _ in range(3):
+                try:
+                    leader.propose({"v": seq}, timeout=5)
+                    committed.append(seq)
+                except (TimeoutError, NotLeader):
+                    pass
+                seq += 1
+            victim = leader if cycle % 2 == 0 else \
+                next(x for x in nodes if x is not leader)
+            net.isolate(victim.id, urls)
+            time.sleep(0.6)
+            net.heal()
+        leader = _wait_leader(nodes, timeout=15)
+        leader.propose({"v": "fin"}, timeout=10)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            tails = [_vals(s) for s in sinks]
+            if all(t and t[-1] == "fin" for t in tails) and \
+                    len({tuple(map(str, t)) for t in tails}) == 1:
+                break
+            time.sleep(0.05)
+        tails = [_vals(s) for s in sinks]
+        assert len({tuple(map(str, t)) for t in tails}) == 1, tails
+        # Every entry acknowledged committed is present, in order.
+        final = tails[0]
+        it = iter(final)
+        for v in committed:
+            assert v in final, (v, final)
+        pos = [final.index(v) for v in committed]
+        assert pos == sorted(pos)
+    finally:
+        _teardown(nodes, servers)
+
+
+def test_install_snapshot_races_live_appends(tmp_path):
+    """A follower cut off past the compaction horizon receives
+    InstallSnapshot WHILE the leader keeps appending: the follower must
+    converge to the exact applied sequence with no gap or repeat at the
+    snapshot/log seam."""
+    net = Net()
+    sinks = [[], [], []]
+    servers, urls, nodes = _mk_cluster(3, tmp_path, sinks, net,
+                                       compact_threshold=30)
+    try:
+        leader = _wait_leader(nodes)
+        follower = next(x for x in nodes if x is not leader)
+        fi = nodes.index(follower)
+        net.isolate(follower.id, urls)
+        # Push far past the compaction threshold while it's dark.
+        for i in range(80):
+            leader.propose({"v": i}, timeout=5)
+        live = [s for j, s in enumerate(sinks) if j != fi]
+        _wait_converged(live, 80)
+        assert leader.log_base > 0, "compaction never happened"
+        # Heal and keep appending concurrently.
+        stop = threading.Event()
+        appended = []
+
+        def hammer():
+            i = 80
+            while not stop.is_set():
+                try:
+                    leader.propose({"v": i}, timeout=5)
+                    appended.append(i)
+                    i += 1
+                except (TimeoutError, NotLeader):
+                    return
+                time.sleep(0.005)
+
+        th = threading.Thread(target=hammer, daemon=True)
+        th.start()
+        net.heal()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and \
+                follower.last_applied < 80:
+            time.sleep(0.05)
+        stop.set()
+        th.join(timeout=5)
+        total = 80 + len(appended)
+        live = [s for j, s in enumerate(sinks) if j != fi]
+        _wait_converged(live, total, timeout=15)
+        # Follower convergence is by applied INDEX: entries up to the
+        # snapshot horizon arrive via restore (no apply_fn call), the
+        # rest via the apply loop.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and \
+                follower.last_applied < leader.last_applied:
+            time.sleep(0.05)
+        assert follower.last_applied == leader.last_applied
+        lv = _vals(sinks[nodes.index(leader)])
+        assert lv == list(range(total))
+        # The follower's sink is a clean SUFFIX of the sequence — no
+        # gap and no repeat at the snapshot/log seam.
+        fv = _vals(sinks[fi])
+        assert fv == lv[len(lv) - len(fv):], (fv[:5], len(fv))
+    finally:
+        _teardown(nodes, servers)
+
+
+def test_membership_change_under_load(tmp_path):
+    """add_server then remove_server while proposals flow: no committed
+    loss, the joiner converges, the removed node stops participating."""
+    net = Net()
+    sinks = [[], [], [], []]
+    servers, urls, nodes = _mk_cluster(3, tmp_path, sinks[:3], net)
+    # A fourth node, initially outside the cluster.
+    s4 = rpc.JsonHttpServer()
+    n4 = RaftNode(s4.url(), [s4.url()],
+                  apply_fn=sinks[3].append,
+                  state_path=str(tmp_path / "raft3.json"),
+                  election_timeout=(0.2, 0.4), heartbeat_interval=0.05)
+    n4.in_config = False  # waits to be added
+    n4.transport = net.transport_for(s4.url())
+    n4.mount(s4)
+    s4.start()
+    n4.start()
+    try:
+        leader = _wait_leader(nodes)
+        stop = threading.Event()
+        acked = []
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    leader.propose({"v": i}, timeout=5)
+                    acked.append(i)
+                except (TimeoutError, NotLeader):
+                    return
+                i += 1
+                time.sleep(0.004)
+
+        th = threading.Thread(target=load, daemon=True)
+        th.start()
+        time.sleep(0.2)
+        leader.add_server(s4.url(), timeout=10)
+        time.sleep(0.4)
+        victim = next(x for x in nodes if x is not leader)
+        leader.remove_server(victim.id, timeout=10)
+        time.sleep(0.4)
+        stop.set()
+        th.join(timeout=5)
+        assert len(acked) > 20, "load generator barely ran"
+        # Every acked entry lands, in order, on leader + joiner.
+        deadline = time.monotonic() + 10
+        li = nodes.index(leader)
+        while time.monotonic() < deadline and (
+                len(_vals(sinks[3])) < len(acked)
+                or len(_vals(sinks[li])) < len(acked)):
+            time.sleep(0.05)
+        for sink in (sinks[li], sinks[3]):
+            vals = _vals(sink)
+            assert vals[:len(acked)] == acked[:len(vals)] or \
+                vals == acked, (len(vals), len(acked))
+        assert not victim.in_config
+    finally:
+        n4.stop()
+        s4.stop()
+        _teardown(nodes, servers)
+
+
+def test_partitioned_candidate_term_inflation_rejoin(tmp_path):
+    """An isolated node campaigns repeatedly and inflates its term; on
+    heal the cluster absorbs the higher term (one new election at most)
+    without losing committed entries."""
+    net = Net()
+    sinks = [[], [], []]
+    servers, urls, nodes = _mk_cluster(3, tmp_path, sinks, net)
+    try:
+        leader = _wait_leader(nodes)
+        for i in range(3):
+            leader.propose({"v": i})
+        _wait_converged(sinks, 3)
+        outsider = next(x for x in nodes if x is not leader)
+        net.isolate(outsider.id, urls)
+        time.sleep(1.5)  # several election timeouts of term churn
+        assert outsider.current_term > leader.current_term
+        net.heal()
+        nl = _wait_leader(nodes, timeout=15)
+        nl.propose({"v": 3}, timeout=10)
+        _wait_converged(sinks, 4)
+        for s in sinks:
+            assert _vals(s)[:4] == [0, 1, 2, 3]
+    finally:
+        _teardown(nodes, servers)
+
+
+# -- master-level schedules (leader kill mid-operation) ----------------------
+
+from seaweedfs_tpu.cluster.volume_server import VolumeServer  # noqa: E402
+
+
+@pytest.fixture
+def ha_cluster(tmp_path):
+    ports = [rpc.free_port() for _ in range(3)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    masters = []
+    for i, p in enumerate(ports):
+        d = tmp_path / f"m{i}"
+        d.mkdir()
+        m = MasterServer(port=p, volume_size_limit_mb=64,
+                         meta_dir=str(d), peers=urls, pulse_seconds=60)
+        m.raft.election_timeout = (0.2, 0.4)
+        m.raft.heartbeat_interval = 0.05
+        m.start()
+        masters.append(m)
+    vs = VolumeServer(urls, [str(tmp_path / "vs")], pulse_seconds=1)
+    vs.start()
+    yield masters, vs
+    vs.stop()
+    for m in masters:
+        try:
+            m.stop()
+        except Exception:  # noqa: BLE001 — some stopped in-test
+            pass
+
+
+def _wait_master_leader(masters, timeout=20.0, exclude=()):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [m for m in masters
+                   if m.raft.state == LEADER and m not in exclude]
+        if len(leaders) == 1 and list(leaders[0].topo.leaves()):
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no master leader with a registered node")
+
+
+def _assign_any(masters):
+    """Assign via whichever master answers (clients retry seeds)."""
+    last = None
+    for m in masters:
+        try:
+            out = rpc.call(m.url() + "/dir/assign?count=1", timeout=3)
+            if "fid" in out:
+                return out["fid"]
+            last = rpc.RpcError(500, str(out))
+        except Exception as e:  # noqa: BLE001
+            last = e
+    raise last
+
+
+def test_leader_kill_during_sequencer_advance(ha_cluster):
+    """Clients hammer /dir/assign while the leader is killed mid-run:
+    every fid issued across the failover must be UNIQUE — the raft-
+    replicated sequencer must never re-issue a file-id block."""
+    masters, vs = ha_cluster
+    leader = _wait_master_leader(masters)
+    fids: list[str] = []
+    fids_lock = threading.Lock()
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            try:
+                fid = _assign_any(masters)
+            except Exception:  # noqa: BLE001 — failover window
+                time.sleep(0.05)
+                continue
+            with fids_lock:
+                fids.append(fid)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(4)]
+    for th in threads:
+        th.start()
+    time.sleep(0.5)
+    leader.stop()  # kill mid-hammer
+    survivors = [m for m in masters if m is not leader]
+    _wait_master_leader(survivors, exclude=(leader,))
+    time.sleep(1.0)  # keep assigning against the new leader
+    stop.set()
+    for th in threads:
+        th.join(timeout=5)
+    assert len(fids) > 50, "assign load barely ran"
+    keys = [f.split(",")[1][:-8] for f in fids]
+    assert len(set(fids)) == len(fids), "duplicate fid issued"
+    assert len(set(keys)) == len(keys), "file-id key re-issued"
+    # Monotonic issuance: keys are hex of a raft-backed counter.
+    nums = [int(k, 16) for k in keys]
+    assert len(set(nums)) == len(nums)
+
+
+def test_leader_kill_during_volume_growth(ha_cluster):
+    """Kill the leader while /vol/grow allocations are in flight: the
+    new leader must keep volume ids unique (raft MaxVolumeId ceiling),
+    and assigns keep working on the grown topology."""
+    masters, vs = ha_cluster
+    leader = _wait_master_leader(masters)
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def grower():
+        while not stop.is_set():
+            for m in masters:
+                try:
+                    rpc.call_json(m.url() + "/vol/grow?count=1", "POST",
+                                  timeout=3)
+                    break
+                except Exception:  # noqa: BLE001 — failover window
+                    continue
+            time.sleep(0.05)
+
+    th = threading.Thread(target=grower, daemon=True)
+    th.start()
+    time.sleep(0.4)
+    leader.stop()
+    survivors = [m for m in masters if m is not leader]
+    new_leader = _wait_master_leader(survivors, exclude=(leader,))
+    time.sleep(1.0)
+    stop.set()
+    th.join(timeout=5)
+    # Force registrations current, then check uniqueness.
+    vs._send_heartbeat(full=True)
+    time.sleep(0.3)
+    vids = [v.id for dn in new_leader.topo.leaves()
+            for v in dn.volumes.values()]
+    assert len(vids) == len(set(vids)), f"duplicate volume id: {vids}"
+    assert len(vids) >= 2
+    fid = _assign_any(survivors)
+    assert "," in fid
+
+
+def test_exactly_once_apply_across_leader_kill(tmp_path):
+    """Propose, ack, kill the leader immediately: survivors apply every
+    committed entry EXACTLY once — no duplicate application after the
+    new leader's term begins."""
+    sinks = [[], [], []]
+    servers, urls, nodes = _mk_cluster(3, tmp_path, sinks)
+    try:
+        leader = _wait_leader(nodes)
+        for i in range(10):
+            leader.propose({"v": i})
+        li = nodes.index(leader)
+        leader.stop()
+        servers[li].stop()
+        survivors = [x for x in nodes if x is not leader]
+        nl = _wait_leader(survivors, timeout=15, exclude=(leader,))
+        nl.propose({"v": 10}, timeout=10)
+        live = [sinks[nodes.index(x)] for x in survivors]
+        _wait_converged(live, 11)
+        for s in live:
+            vals = _vals(s)
+            assert vals == list(range(11)), vals  # once each, in order
+    finally:
+        _teardown(nodes, servers)
+
+
+def test_divergent_uncommitted_log_truncated_on_rejoin(tmp_path):
+    """The §5.3 conflict case: an isolated leader accumulates
+    uncommitted entries at indexes the majority fills differently;
+    after heal its log truncates to the majority's — its own divergent
+    tail disappears, the committed majority entries survive."""
+    net = Net()
+    sinks = [[], [], []]
+    servers, urls, nodes = _mk_cluster(3, tmp_path, sinks, net)
+    try:
+        leader = _wait_leader(nodes)
+        leader.propose({"v": "base"})
+        _wait_converged(sinks, 1)
+        net.isolate(leader.id, urls)
+        # Uncommitted divergent tail on the isolated leader.
+        for tag in ("dead-a", "dead-b"):
+            try:
+                leader.propose({"v": tag}, timeout=0.8)
+            except (TimeoutError, NotLeader):
+                pass
+        majority = [x for x in nodes if x is not leader]
+        nl = _wait_leader(majority, exclude=(leader,))
+        for i in range(3):
+            nl.propose({"v": i}, timeout=5)
+        maj_sinks = [sinks[nodes.index(x)] for x in majority]
+        _wait_converged(maj_sinks, 4)
+        net.heal()
+        old_sink = sinks[nodes.index(leader)]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(_vals(old_sink)) < 4:
+            time.sleep(0.05)
+        vals = _vals(old_sink)
+        assert vals[:4] == ["base", 0, 1, 2], vals
+        assert "dead-a" not in vals and "dead-b" not in vals
+        # And the divergent entries are gone from its LOG, not just
+        # unapplied (truncation, §5.3).
+        logged = [e["cmd"].get("v") for e in leader.log
+                  if "v" in e.get("cmd", {})]
+        assert "dead-a" not in logged and "dead-b" not in logged
+    finally:
+        _teardown(nodes, servers)
