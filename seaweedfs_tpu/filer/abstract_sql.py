@@ -118,13 +118,6 @@ class PostgresDialect(Dialect):
         return self._DOLLAR.sub(r"?\1", sql)
 
 
-def _postgres_args(sql: str, args: tuple) -> tuple:
-    """sqlite ?N params are 1-indexed into a positional sequence, so
-    positional args pass through unchanged — the rewrite keeps the $N
-    ordering, which for these texts is already positional order."""
-    return args
-
-
 class AbstractSqlStore(FilerStore):
     """FilerStore over any DBAPI connection + Dialect pair.
 

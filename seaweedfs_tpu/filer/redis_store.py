@@ -181,9 +181,16 @@ class RedisStore(FilerStore):
         for m in members:
             name = m.decode() if isinstance(m, bytes) else m
             child = path.rstrip("/") + "/" + name
-            # Recurse like the filer's tree delete: a child that is
-            # itself a directory leaves its set + entries otherwise.
-            self.delete_folder_children(child)
+            # Recurse only into directories (checked from the child's
+            # meta, which we fetch anyway-adjacent): plain files would
+            # cost two wasted round-trips each on a real network.
+            meta = self.client.call("GET", child)
+            if meta is not None:
+                try:
+                    if json.loads(meta).get("is_directory"):
+                        self.delete_folder_children(child)
+                except ValueError:
+                    pass
             self.client.call("DEL", child)
         self.client.call("DEL", _dir_list_key(path))
 

@@ -135,3 +135,41 @@ def test_decode_matrix_recovers_identity():
     assert used == list(range(10))
     m = gf256.build_systematic_matrix(10, 14)
     assert np.array_equal(mat[0], m[10])
+
+
+def test_systematic_matrix_independent_lagrange_derivation():
+    """Second, independent derivation of the RS code matrix (VERDICT r4
+    #8, de-risking the self-pinned golden gate): klauspost's buildMatrix
+    computes `vandermonde(n, k) @ inv(top_k_rows)`; mathematically row r
+    of that product is the evaluation at x=r of the Lagrange basis
+    polynomials through nodes x=0..k-1 over GF(2^8).  Deriving the
+    parity rows DIRECTLY from the Lagrange formula — no Vandermonde
+    matrix, no Gaussian elimination, no matrix multiply — and asserting
+    table identity means a bug in either construction (or in mat_inv /
+    mat_mul) breaks this test instead of silently re-pinning wrong
+    golden bytes."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops.gf256 import (build_systematic_matrix,
+                                         gf_inv, gf_mul)
+
+    def lagrange_matrix(k: int, n: int) -> np.ndarray:
+        m = np.zeros((n, k), dtype=np.uint8)
+        for j in range(k):
+            m[j, j] = 1  # systematic top: identity
+        for r in range(k, n):
+            for j in range(k):
+                num, den = 1, 1
+                for x in range(k):
+                    if x == j:
+                        continue
+                    num = gf_mul(num, r ^ x)  # GF(2^8): sub == xor
+                    den = gf_mul(den, j ^ x)
+                m[r, j] = gf_mul(num, gf_inv(den))
+        return m
+
+    for k, n in ((10, 14), (8, 11), (16, 20), (4, 6), (2, 4)):
+        built = build_systematic_matrix(k, n)
+        derived = lagrange_matrix(k, n)
+        assert np.array_equal(np.asarray(built), derived), \
+            f"RS({k},{n - k}) matrix derivations disagree"
