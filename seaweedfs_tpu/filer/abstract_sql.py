@@ -25,7 +25,7 @@ import re
 import threading
 
 from .entry import Entry
-from .filerstore import FilerStore, NotFound, _norm
+from .filerstore import FilerStore, NotFound, _norm, split_dir_name
 
 
 def hash_string_to_long(s: str) -> int:
@@ -149,18 +149,10 @@ class AbstractSqlStore(FilerStore):
         with self._lock:
             return self._exec_raw(sql, args).fetchall()
 
-    @staticmethod
-    def _split(path: str) -> tuple[str, str]:
-        path = _norm(path)
-        if path == "/":
-            return "/", ""
-        d, name = path.rsplit("/", 1)
-        return d or "/", name
-
     # -- entries -------------------------------------------------------------
 
     def insert_entry(self, entry: Entry) -> None:
-        d, name = self._split(entry.path)
+        d, name = split_dir_name(entry.path)
         meta = json.dumps(entry.to_dict()).encode()
         self._upsert(d, name, meta)
 
@@ -185,7 +177,7 @@ class AbstractSqlStore(FilerStore):
         self.insert_entry(entry)
 
     def find_entry(self, path: str) -> Entry:
-        d, name = self._split(path)
+        d, name = split_dir_name(path)
         rows = self._query(self.dialect.find,
                            (hash_string_to_long(d), name, d))
         if not rows:
@@ -193,7 +185,7 @@ class AbstractSqlStore(FilerStore):
         return Entry.from_dict(json.loads(bytes(rows[0][0])))
 
     def delete_entry(self, path: str) -> None:
-        d, name = self._split(path)
+        d, name = split_dir_name(path)
         self._exec(self.dialect.delete,
                    (hash_string_to_long(d), name, d))
 

@@ -1,15 +1,28 @@
 """FilerStore: pluggable metadata backends.
 
 Reference: weed/filer/filerstore.go:20-43 (the interface) and the 11
-backends under weed/filer/{leveldb,redis,mysql,...}.  This build ships:
+backends under weed/filer/{leveldb,redis,mysql,...}.  This build ships
+the full breadth — embedded:
 
-- MemoryStore  — btree-ish sorted dict (the reference's memdb, test store)
-- SqliteStore  — stdlib sqlite3, the `abstract_sql` moral equivalent and
-                 the durable default (the reference defaults to leveldb;
-                 sqlite is the batteries-included analog here)
+- MemoryStore    — sorted dict (the reference's memdb, test store)
+- SqliteStore    — stdlib sqlite3, the batteries-included durable
+                   default (the reference defaults to leveldb)
+- OrderedKvStore — embedded ordered-KV with WAL/snapshots (leveldb
+                   analog), plus its 8-way ShardedKvStore (leveldb2)
 
-Both implement the same five-method contract + KV, and pass the same
-conformance tests (tests/test_filer.py::TestStoreConformance).
+and networked, each speaking its real wire protocol with no SDK:
+
+- RedisStore     — RESP2 (redis_store.py)
+- AbstractSqlStore — the shared-SQL layer with verbatim
+                   mysql/postgres dialect texts (abstract_sql.py)
+- EtcdStore      — etcd v3 KV gRPC (etcd_store.py)
+- ElasticStore   — Elasticsearch REST (elastic_store.py)
+- MongoStore     — OP_MSG + BSON (mongo_store.py)
+- CassandraStore — CQL binary protocol v4 (cassandra_store.py)
+
+All implement the same five-method contract + KV and pass the same
+conformance suite (tests/test_filer.py's `store` fixture runs every
+backend; the networked ones against in-process mini wire servers).
 """
 
 from __future__ import annotations
@@ -81,6 +94,17 @@ def _norm(path: str) -> str:
 def _dir_key(dir_path: str) -> str:
     """Key prefix under which a directory's children sort."""
     return dir_path if dir_path.endswith("/") else dir_path + "/"
+
+
+def split_dir_name(path: str) -> tuple[str, str]:
+    """Normalize and split into (directory, name) — FullPath.DirAndName.
+    Root splits to ("/", "") — shared by every (directory, name)-keyed
+    networked store so the scheme can't drift between backends."""
+    path = _norm(path)
+    if path == "/":
+        return "/", ""
+    d, name = path.rsplit("/", 1)
+    return d or "/", name
 
 
 class MemoryStore(FilerStore):
@@ -305,6 +329,35 @@ def store_for_path(path: str | None) -> FilerStore:
                       .split(":") + ["6379"])[1]),
             password=cfg.get_string("redis.password"),
             database=int(cfg.get_string("redis.database", "0") or 0))
+    if cfg.get_bool("mongodb.enabled"):
+        from .mongo_store import MongoStore
+        uri = cfg.get_string("mongodb.uri", "mongodb://localhost:27017")
+        hostport = uri.split("://")[-1].split("/")[0]
+        host, _, port = hostport.rpartition(":")
+        return MongoStore(host or hostport,
+                          int(port) if port.isdigit() else 27017,
+                          database=cfg.get_string("mongodb.database",
+                                                  "seaweedfs"))
+    if cfg.get_bool("cassandra.enabled"):
+        from .cassandra_store import CassandraStore
+        hosts = cfg.get_string("cassandra.hosts", "localhost").split(",")
+        host, _, port = hosts[0].rpartition(":")
+        return CassandraStore(
+            host or hosts[0],
+            int(port) if port.isdigit() else 9042,
+            keyspace=cfg.get_string("cassandra.keyspace", "seaweedfs"))
+    if cfg.get_bool("etcd.enabled"):
+        from .etcd_store import EtcdStore
+        return EtcdStore(cfg.get_string("etcd.servers",
+                                        "localhost:2379").split(",")[0])
+    if cfg.get_bool("elastic7.enabled"):
+        from .elastic_store import ElasticStore
+        servers = cfg.get_string("elastic7.servers",
+                                 "http://localhost:9200")
+        return ElasticStore(
+            servers.split(",")[0],
+            username=cfg.get_string("elastic7.username"),
+            password=cfg.get_string("elastic7.password"))
     for section, dialect_name in (("mysql", "mysql"),
                                   ("postgres", "postgres")):
         if cfg.get_bool(f"{section}.enabled"):

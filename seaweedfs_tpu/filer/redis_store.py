@@ -17,9 +17,8 @@ typed reply back.  Tests run it against an in-process mini-RESP server
 from __future__ import annotations
 
 import json
-import socket
-import threading
 
+from ..utils.wireclient import WireClient
 from .entry import Entry
 from .filerstore import FilerStore, FilerStoreError, NotFound, _norm
 
@@ -30,27 +29,23 @@ class RespError(FilerStoreError):
     """Server-side -ERR reply."""
 
 
-class RespClient:
+class RespClient(WireClient):
     """Minimal RESP2 client: encode one command as an array of bulk
-    strings, parse one typed reply.  Thread-safe (one in-flight command
-    at a time); redials once on a dead pooled connection."""
+    strings, parse one typed reply.  Connection lifecycle (lock,
+    redial-once, close) comes from WireClient."""
 
     def __init__(self, host: str, port: int, password: str = "",
                  database: int = 0, timeout: float = 10.0):
-        self.host, self.port = host, port
+        super().__init__(host, port, timeout)
         self.password, self.database = password, database
-        self.timeout = timeout
-        self._lock = threading.Lock()
-        self._sock: socket.socket | None = None
         self._rf = None
 
     # -- wire ----------------------------------------------------------------
 
-    def _connect(self) -> None:
-        self._sock = socket.create_connection(
-            (self.host, self.port), timeout=self.timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    def _on_connect(self) -> None:
         self._rf = self._sock.makefile("rb", buffering=1 << 16)
+
+    def _handshake(self) -> None:
         if self.password:
             self._roundtrip(("AUTH", self.password))
         if self.database:
@@ -95,32 +90,16 @@ class RespClient:
         return self._read_reply()
 
     def call(self, *args):
-        with self._lock:
-            for attempt in (0, 1):
-                if self._sock is None:
-                    self._connect()
-                try:
-                    return self._roundtrip(args)
-                except RespError:
-                    raise
-                except (OSError, ConnectionError):
-                    self.close_nolock()
-                    if attempt:
-                        raise
-        raise AssertionError("unreachable")
+        return self._call(lambda: self._roundtrip(args))
 
     def close_nolock(self) -> None:
-        for closer in (self._rf, self._sock):
+        if self._rf is not None:
             try:
-                if closer is not None:
-                    closer.close()
+                self._rf.close()
             except OSError:
                 pass
-        self._sock = self._rf = None
-
-    def close(self) -> None:
-        with self._lock:
-            self.close_nolock()
+            self._rf = None
+        super().close_nolock()
 
 
 def _dir_and_name(path: str) -> tuple[str, str]:

@@ -1,0 +1,161 @@
+"""In-process mini Cassandra: CQL binary protocol v4 frames
+(STARTUP→READY, QUERY→RESULT) over a sorted (directory, name) dict,
+dispatching on the store's five exact statement texts."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from seaweedfs_tpu.filer.cassandra_store import (OP_ERROR, OP_QUERY,
+                                                 OP_READY, OP_RESULT,
+                                                 OP_STARTUP,
+                                                 RESULT_ROWS,
+                                                 RESULT_VOID,
+                                                 CassandraStore)
+
+
+class MiniCassandra:
+    def __init__(self):
+        # (directory, name) -> meta bytes
+        self.rows: dict[tuple[str, str], bytes] = {}
+        self.lock = threading.Lock()
+        self.queries_seen: list[str] = []
+        self._sock = socket.create_server(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._running = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _recv_exact(conn, n):
+        out = bytearray()
+        while len(out) < n:
+            piece = conn.recv(n - len(out))
+            if not piece:
+                return None
+            out += piece
+        return bytes(out)
+
+    def _serve(self, conn):
+        try:
+            while True:
+                hdr = self._recv_exact(conn, 9)
+                if hdr is None:
+                    return
+                ver, _fl, stream, op, length = struct.unpack(">BBhBi",
+                                                             hdr)
+                body = self._recv_exact(conn, length) if length else b""
+                if body is None:
+                    return
+                if op == OP_STARTUP:
+                    out_op, out = OP_READY, b""
+                elif op == OP_QUERY:
+                    out_op, out = self._query(body)
+                else:
+                    out_op = OP_ERROR
+                    msg = b"bad opcode"
+                    out = struct.pack(">iH", 0x000A, len(msg)) + msg
+                conn.sendall(struct.pack(">BBhBi", 0x84, 0, stream,
+                                         out_op, len(out)) + out)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _void():
+        return OP_RESULT, struct.pack(">i", RESULT_VOID)
+
+    @staticmethod
+    def _rows_result(cols: list[str], rows: list[list[bytes]]):
+        # flags=1 (global table spec), varchar columns.
+        body = struct.pack(">ii", RESULT_ROWS, 0)[:4]
+        meta = struct.pack(">ii", 0x0001, len(cols))
+        for part in (b"ks", b"filemeta"):
+            meta += struct.pack(">H", len(part)) + part
+        for c in cols:
+            cb = c.encode()
+            meta += struct.pack(">H", len(cb)) + cb
+            meta += struct.pack(">H", 0x000D)  # varchar
+        body = struct.pack(">i", RESULT_ROWS) + meta
+        body += struct.pack(">i", len(rows))
+        for row in rows:
+            for cell in row:
+                if cell is None:
+                    body += struct.pack(">i", -1)
+                else:
+                    body += struct.pack(">i", len(cell)) + cell
+        return OP_RESULT, body
+
+    def _query(self, body: bytes):
+        n = struct.unpack_from(">i", body)[0]
+        cql = body[4:4 + n].decode()
+        i = 4 + n
+        _consistency, flags = struct.unpack_from(">HB", body, i)
+        i += 3
+        values: list[bytes] = []
+        if flags & 0x01:
+            count = struct.unpack_from(">H", body, i)[0]
+            i += 2
+            for _ in range(count):
+                ln = struct.unpack_from(">i", body, i)[0]
+                i += 4
+                values.append(body[i:i + ln] if ln >= 0 else b"")
+                i += max(ln, 0)
+        with self.lock:
+            self.queries_seen.append(cql)
+            return self._dispatch(cql, values)
+
+    def _dispatch(self, cql: str, v: list[bytes]):
+        s = CassandraStore
+        if cql.startswith("USE"):
+            return self._void()
+        if cql == s.SQL_INSERT:
+            d, name = v[0].decode(), v[1].decode()
+            self.rows[(d, name)] = v[2]
+            return self._void()
+        if cql == s.SQL_FIND:
+            d, name = v[0].decode(), v[1].decode()
+            meta = self.rows.get((d, name))
+            if meta is None:
+                return self._rows_result(["meta"], [])
+            return self._rows_result(["meta"], [[meta]])
+        if cql == s.SQL_DELETE:
+            self.rows.pop((v[0].decode(), v[1].decode()), None)
+            return self._void()
+        if cql == s.SQL_DELETE_DIR:
+            d = v[0].decode()
+            for k in [k for k in self.rows if k[0] == d]:
+                del self.rows[k]
+            return self._void()
+        if cql in (s.SQL_LIST_EXCLUSIVE, s.SQL_LIST_INCLUSIVE):
+            d, start = v[0].decode(), v[1].decode()
+            limit = struct.unpack(">i", v[2])[0]
+            keep = sorted(
+                (name, meta) for (dd, name), meta in self.rows.items()
+                if dd == d and (
+                    name >= start if cql == s.SQL_LIST_INCLUSIVE
+                    else name > start))
+            keep = keep[:limit]
+            return self._rows_result(
+                ["name", "meta"],
+                [[name.encode(), meta] for name, meta in keep])
+        msg = f"unknown statement: {cql}".encode()
+        return OP_ERROR, struct.pack(">iH", 0x2000, len(msg)) + msg
+
+    def close(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass

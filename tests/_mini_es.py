@@ -1,0 +1,123 @@
+"""In-process mini Elasticsearch REST server for ElasticStore tests:
+_doc CRUD, term/range _search with Name sort, wildcard multi-index —
+the mini-RESP pattern over the repo's own JsonHttpServer."""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import threading
+
+from seaweedfs_tpu.cluster import rpc
+
+
+class MiniEs:
+    def __init__(self):
+        self.indices: dict[str, dict[str, dict]] = {}
+        self._lock = threading.Lock()
+        self._srv = rpc.JsonHttpServer()
+        self._srv.prefix_route("PUT", "/", self._put)
+        self._srv.prefix_route("GET", "/", self._get)
+        self._srv.prefix_route("DELETE", "/", self._delete)
+        self._srv.prefix_route("POST", "/", self._post)
+        self._srv.start()
+        self.port = self._srv.port
+
+    def url(self) -> str:
+        return self._srv.url()
+
+    @staticmethod
+    def _doc_path(path: str):
+        parts = path.strip("/").split("/")
+        if len(parts) == 3 and parts[1] == "_doc":
+            return parts[0], parts[2]
+        return None
+
+    def _put(self, path: str, query: dict, body: bytes):
+        dp = self._doc_path(path)
+        if dp is None:  # index creation
+            with self._lock:
+                self.indices.setdefault(path.strip("/"), {})
+            return {"acknowledged": True}
+        index, doc_id = dp
+        with self._lock:
+            self.indices.setdefault(index, {})[doc_id] = \
+                json.loads(body)
+        return {"result": "updated", "_id": doc_id}
+
+    def _get(self, path: str, query: dict, body: bytes):
+        dp = self._doc_path(path)
+        if path.startswith("/_cat/indices"):
+            with self._lock:
+                return (200, json.dumps(
+                    [{"index": name} for name in self.indices]).encode(),
+                    {"Content-Type": "application/json"})
+        if dp is None:
+            raise rpc.RpcError(400, "bad path")
+        index, doc_id = dp
+        with self._lock:
+            doc = self.indices.get(index, {}).get(doc_id)
+        if doc is None:
+            raise rpc.RpcError(404, json.dumps({"found": False}))
+        return {"found": True, "_id": doc_id, "_source": doc}
+
+    def _delete(self, path: str, query: dict, body: bytes):
+        dp = self._doc_path(path)
+        with self._lock:
+            if dp is None:  # whole index
+                self.indices.pop(path.strip("/"), None)
+                return {"acknowledged": True}
+            index, doc_id = dp
+            existed = self.indices.get(index, {}).pop(doc_id, None)
+        if existed is None:
+            raise rpc.RpcError(404, json.dumps({"result": "not_found"}))
+        return {"result": "deleted"}
+
+    def _post(self, path: str, query: dict, body: bytes):
+        parts = path.strip("/").split("/")
+        if len(parts) == 2 and parts[1] == "_search":
+            return self._search(parts[0], json.loads(body or b"{}"))
+        raise rpc.RpcError(400, f"bad path {path}")
+
+    def _search(self, index_pat: str, req: dict):
+        q = req.get("query", {})
+        term = {}
+        range_filter = {}
+        if "term" in q:
+            term = q["term"]
+        elif "bool" in q:
+            for m in q["bool"].get("must", []):
+                term.update(m.get("term", {}))
+            for f in q["bool"].get("filter", []):
+                range_filter.update(f.get("range", {}))
+        with self._lock:
+            docs = []
+            for name, idx in self.indices.items():
+                if fnmatch.fnmatchcase(name, index_pat):
+                    docs.extend(idx.values())
+        def field_of(doc, name):
+            # ES keyword subfield: "Name.keyword" reads the raw value
+            return doc.get(name[:-8] if name.endswith(".keyword")
+                           else name, "")
+
+        hits = []
+        for doc in docs:
+            ok = all(doc.get(k) == v for k, v in term.items())
+            for field, cond in range_filter.items():
+                for op, val in cond.items():
+                    got = field_of(doc, field)
+                    ok = ok and {"gt": got > val, "gte": got >= val,
+                                 "lt": got < val,
+                                 "lte": got <= val}[op]
+            if ok:
+                hits.append(doc)
+        for sort in req.get("sort", []):
+            for field, order in sort.items():
+                hits.sort(key=lambda d: field_of(d, field),
+                          reverse=order == "desc")
+        size = req.get("size", 10)
+        return {"hits": {"hits": [{"_source": d}
+                                  for d in hits[:size]]}}
+
+    def close(self):
+        self._srv.stop()

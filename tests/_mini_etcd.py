@@ -1,0 +1,89 @@
+"""In-process mini etcd v3 gRPC server for EtcdStore tests: the three
+etcdserverpb.KV RPCs (Range/Put/DeleteRange) over a sorted dict —
+the mini-RESP/mini-Kafka test pattern for the gRPC world."""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from concurrent import futures
+
+import grpc
+
+from seaweedfs_tpu.pb import etcd_pb2 as pb
+
+
+class MiniEtcd:
+    def __init__(self):
+        self._keys: list[bytes] = []
+        self._m: dict[bytes, bytes] = {}
+        self._rev = 0
+        self._lock = threading.Lock()
+        self._server = grpc.server(futures.ThreadPoolExecutor(4))
+        unary = grpc.unary_unary_rpc_method_handler
+        handlers = {
+            "Range": unary(self._range,
+                           request_deserializer=pb.RangeRequest.FromString,
+                           response_serializer=(
+                               pb.RangeResponse.SerializeToString)),
+            "Put": unary(self._put,
+                         request_deserializer=pb.PutRequest.FromString,
+                         response_serializer=(
+                             pb.PutResponse.SerializeToString)),
+            "DeleteRange": unary(
+                self._delete_range,
+                request_deserializer=pb.DeleteRangeRequest.FromString,
+                response_serializer=(
+                    pb.DeleteRangeResponse.SerializeToString)),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler("etcdserverpb.KV",
+                                                  handlers),))
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.start()
+
+    def _header(self):
+        return pb.ResponseHeader(revision=self._rev)
+
+    def _select(self, key: bytes, range_end: bytes) -> list[bytes]:
+        if not range_end:
+            return [key] if key in self._m else []
+        lo = bisect.bisect_left(self._keys, key)
+        hi = bisect.bisect_left(self._keys, range_end)
+        return self._keys[lo:hi]
+
+    def _range(self, req, ctx):
+        with self._lock:
+            keys = self._select(req.key, req.range_end)
+            if req.sort_order == pb.RangeRequest.DESCEND:
+                keys = list(reversed(keys))
+            total = len(keys)
+            if req.limit:
+                keys = keys[:req.limit]
+            return pb.RangeResponse(
+                header=self._header(),
+                kvs=[pb.KeyValue(key=k, value=self._m[k])
+                     for k in keys],
+                more=total > len(keys), count=total)
+
+    def _put(self, req, ctx):
+        with self._lock:
+            self._rev += 1
+            if req.key not in self._m:
+                bisect.insort(self._keys, req.key)
+            self._m[req.key] = req.value
+            return pb.PutResponse(header=self._header())
+
+    def _delete_range(self, req, ctx):
+        with self._lock:
+            self._rev += 1
+            keys = self._select(req.key, req.range_end)
+            for k in list(keys):
+                del self._m[k]
+                i = bisect.bisect_left(self._keys, k)
+                del self._keys[i]
+            return pb.DeleteRangeResponse(header=self._header(),
+                                          deleted=len(keys))
+
+    def close(self):
+        self._server.stop(0.2)

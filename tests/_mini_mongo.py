@@ -1,0 +1,134 @@
+"""In-process mini MongoDB server: OP_MSG framing + the command subset
+the filer store uses (update/find/delete/createIndexes) over the
+store's own BSON codec — the mini-RESP pattern for the mongo wire."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from seaweedfs_tpu.filer.mongo_store import OP_MSG, bson_decode, bson_encode
+
+_HDR = struct.Struct("<iiii")
+
+
+class MiniMongo:
+    def __init__(self):
+        # (db, collection) -> list of docs {directory, name, meta}
+        self.collections: dict[tuple, list[dict]] = {}
+        self.lock = threading.Lock()
+        self.commands_seen: list[dict] = []
+        self._sock = socket.create_server(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._running = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_exact(self, conn, n):
+        out = bytearray()
+        while len(out) < n:
+            piece = conn.recv(n - len(out))
+            if not piece:
+                return None
+            out += piece
+        return bytes(out)
+
+    def _serve(self, conn):
+        try:
+            while True:
+                hdr = self._recv_exact(conn, 16)
+                if hdr is None:
+                    return
+                length, rid, _rto, opcode = _HDR.unpack(hdr)
+                payload = self._recv_exact(conn, length - 16)
+                if payload is None or opcode != OP_MSG:
+                    return
+                doc, _ = bson_decode(payload, 5)
+                with self.lock:
+                    self.commands_seen.append(doc)
+                    reply = self._run(doc)
+                body = b"\x00\x00\x00\x00" + b"\x00" + bson_encode(reply)
+                conn.sendall(_HDR.pack(16 + len(body), 1, rid, OP_MSG)
+                             + body)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _coll(self, doc, cmd) -> list[dict]:
+        return self.collections.setdefault((doc["$db"], doc[cmd]), [])
+
+    @staticmethod
+    def _matches(d: dict, q: dict) -> bool:
+        for k, cond in q.items():
+            if isinstance(cond, dict):
+                got = d.get(k, "")
+                for op, val in cond.items():
+                    if op == "$gt" and not got > val:
+                        return False
+                    if op == "$gte" and not got >= val:
+                        return False
+            elif d.get(k) != cond:
+                return False
+        return True
+
+    def _run(self, doc: dict) -> dict:
+        if "createIndexes" in doc:
+            return {"ok": 1.0}
+        if "update" in doc:
+            coll = self._coll(doc, "update")
+            n = 0
+            for u in doc["updates"]:
+                q, setter = u["q"], u["u"]["$set"]
+                hit = next((d for d in coll
+                            if self._matches(d, q)), None)
+                if hit is not None:
+                    hit.update(setter)
+                elif u.get("upsert"):
+                    coll.append({**q, **setter})
+                n += 1
+            return {"ok": 1.0, "n": n}
+        if "find" in doc:
+            coll = self._coll(doc, "find")
+            hits = [d for d in coll
+                    if self._matches(d, doc.get("filter", {}))]
+            for field, order in (doc.get("sort") or {}).items():
+                hits.sort(key=lambda d: d.get(field, ""),
+                          reverse=order < 0)
+            limit = doc.get("limit", 0)
+            if limit:
+                hits = hits[:limit]
+            return {"ok": 1.0,
+                    "cursor": {"id": 0,
+                               "ns": f"{doc['$db']}.{doc['find']}",
+                               "firstBatch": [dict(h) for h in hits]}}
+        if "delete" in doc:
+            coll = self._coll(doc, "delete")
+            n = 0
+            for dd in doc["deletes"]:
+                q, limit = dd["q"], dd.get("limit", 0)
+                keep = []
+                for d in coll:
+                    if self._matches(d, q) and (limit == 0 or n < limit):
+                        n += 1
+                    else:
+                        keep.append(d)
+                coll[:] = keep
+            return {"ok": 1.0, "n": n}
+        return {"ok": 0.0, "errmsg": f"unknown command {list(doc)[0]}"}
+
+    def close(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass

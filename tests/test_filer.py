@@ -120,7 +120,8 @@ def test_total_size():
 
 @pytest.fixture(params=["memory", "sqlite", "sqlite-file", "ordered_kv",
                         "sharded_kv", "redis", "sql-mysql",
-                        "sql-postgres"])
+                        "sql-postgres", "etcd", "elastic", "mongodb",
+                        "cassandra"])
 def store(request, tmp_path):
     mini = None
     if request.param == "memory":
@@ -146,6 +147,26 @@ def store(request, tmp_path):
         from seaweedfs_tpu.filer.abstract_sql import (
             PostgresDialect, sqlite_validating_store)
         s = sqlite_validating_store(PostgresDialect())
+    elif request.param == "etcd":
+        from seaweedfs_tpu.filer.etcd_store import EtcdStore
+        from _mini_etcd import MiniEtcd
+        mini = MiniEtcd()
+        s = EtcdStore(f"127.0.0.1:{mini.port}")
+    elif request.param == "elastic":
+        from seaweedfs_tpu.filer.elastic_store import ElasticStore
+        from _mini_es import MiniEs
+        mini = MiniEs()
+        s = ElasticStore(mini.url())
+    elif request.param == "mongodb":
+        from seaweedfs_tpu.filer.mongo_store import MongoStore
+        from _mini_mongo import MiniMongo
+        mini = MiniMongo()
+        s = MongoStore("127.0.0.1", mini.port)
+    elif request.param == "cassandra":
+        from seaweedfs_tpu.filer.cassandra_store import CassandraStore
+        from _mini_cassandra import MiniCassandra
+        mini = MiniCassandra()
+        s = CassandraStore("127.0.0.1", mini.port)
     else:
         s = SqliteStore(str(tmp_path / "filer.db"))
     yield s

@@ -138,3 +138,181 @@ def test_sql_kv_rides_filemeta():
     s.kv_delete("checkpoint")
     assert s.kv_get("checkpoint") is None
     s.close()
+
+
+# -- etcd (v3 KV gRPC, no SDK) ----------------------------------------------
+
+def test_etcd_wire_key_scheme():
+    """Entry keys are dir + \\x00 + name (etcd_store.go
+    DIR_FILE_SEPARATOR); subtree delete is one prefix DeleteRange."""
+    from _mini_etcd import MiniEtcd
+    from seaweedfs_tpu.filer.etcd_store import EtcdStore
+    m = MiniEtcd()
+    try:
+        s = EtcdStore(f"127.0.0.1:{m.port}")
+        s.insert_entry(Entry(path="/d/file.txt"))
+        assert b"/d\x00file.txt" in m._m
+        s.insert_entry(Entry(path="/d/sub", is_directory=True))
+        s.insert_entry(Entry(path="/d/sub/leaf"))
+        s.delete_folder_children("/d")
+        assert [k for k in m._m if k.startswith(b"/d\x00")] == []
+        assert [k for k in m._m if k.startswith(b"/d/sub\x00")] == []
+        # kv keys carry no separator: no collision with entry keys
+        s.kv_put("checkpoint", b"\x07")
+        assert s.kv_get("checkpoint") == b"\x07"
+        assert b"checkpoint" in m._m
+        s.close()
+    finally:
+        m.close()
+
+
+def test_etcd_range_pagination():
+    from _mini_etcd import MiniEtcd
+    from seaweedfs_tpu.filer.etcd_store import EtcdStore
+    m = MiniEtcd()
+    try:
+        s = EtcdStore(f"127.0.0.1:{m.port}")
+        for name in ("a", "b", "c", "d"):
+            s.insert_entry(Entry(path=f"/dir/{name}"))
+        page = s.list_directory_entries("/dir", "b", False, 2)
+        assert [e.name for e in page] == ["c", "d"]
+        page = s.list_directory_entries("/dir", "b", True, 2)
+        assert [e.name for e in page] == ["b", "c"]
+        s.close()
+    finally:
+        m.close()
+
+
+# -- elastic (REST, no SDK) --------------------------------------------------
+
+def test_elastic_wire_shapes():
+    """One index per top-level component (.seaweedfs_<root>), doc id =
+    md5(fullpath), {ParentId, Entry} doc shape, KV in
+    .seaweedfs_kv_entries (elastic_store.go)."""
+    import hashlib
+
+    from _mini_es import MiniEs
+    from seaweedfs_tpu.filer.elastic_store import ElasticStore
+    m = MiniEs()
+    try:
+        s = ElasticStore(m.url())
+        s.insert_entry(Entry(path="/buckets/b1/obj"))
+        idx = m.indices[".seaweedfs_buckets"]
+        doc_id = hashlib.md5(b"/buckets/b1/obj").hexdigest()
+        assert doc_id in idx
+        assert idx[doc_id]["ParentId"] == \
+            hashlib.md5(b"/buckets/b1").hexdigest()
+        s.kv_put("k", b"\x01\x02")
+        assert ".seaweedfs_kv_entries" in m.indices
+        assert s.kv_get("k") == b"\x01\x02"
+        # root listing spans indexes
+        s.insert_entry(Entry(path="/other", is_directory=True))
+        names = {e.name
+                 for e in s.list_directory_entries("/", "", True, 10)}
+        assert "other" in names
+        s.close()
+    finally:
+        m.close()
+
+
+# -- mongodb (OP_MSG + BSON wire, no SDK) ------------------------------------
+
+def test_bson_codec_roundtrip():
+    from seaweedfs_tpu.filer.mongo_store import bson_decode, bson_encode
+    doc = {"find": "filemeta", "$db": "seaweedfs",
+           "filter": {"directory": "/d", "name": {"$gt": "a"}},
+           "sort": {"name": 1}, "limit": 7,
+           "blob": b"\x00\x01\xff", "ok": 1.0, "flag": True,
+           "nothing": None, "big": 1 << 40,
+           "arr": ["x", 2, {"y": b"z"}]}
+    enc = bson_encode(doc)
+    got, end = bson_decode(enc)
+    assert end == len(enc)
+    assert got["filter"] == {"directory": "/d", "name": {"$gt": "a"}}
+    assert got["blob"] == b"\x00\x01\xff"
+    assert got["big"] == 1 << 40 and got["limit"] == 7
+    assert got["flag"] is True and got["nothing"] is None
+    assert got["arr"] == ["x", 2, {"y": b"z"}]
+
+
+def test_mongo_wire_commands():
+    """The store issues the reference's exact command shapes: upsert
+    update on (directory, name), find with $gt/$gte + name sort,
+    deleteMany on directory, unique-index creation at startup
+    (mongodb_store.go)."""
+    from _mini_mongo import MiniMongo
+    from seaweedfs_tpu.filer.mongo_store import MongoStore
+    m = MiniMongo()
+    try:
+        s = MongoStore("127.0.0.1", m.port, database="weeddb")
+        assert any("createIndexes" in c for c in m.commands_seen)
+        s.insert_entry(Entry(path="/d/f1"))
+        up = next(c for c in m.commands_seen if "update" in c)
+        assert up["$db"] == "weeddb"
+        assert up["updates"][0]["q"] == {"directory": "/d",
+                                         "name": "f1"}
+        assert up["updates"][0]["upsert"] is True
+        # update-in-place, not duplicate
+        s.insert_entry(Entry(path="/d/f1", is_directory=False))
+        docs = m.collections[("weeddb", "filemeta")]
+        assert len([d for d in docs if d["name"] == "f1"]) == 1
+        # kv rides the same collection under /etc/kv
+        s.kv_put("ck", b"\x09")
+        assert s.kv_get("ck") == b"\x09"
+        assert any(d["directory"] == "/etc/kv" and d["name"] == "ck"
+                   for d in docs)
+        s.close()
+    finally:
+        m.close()
+
+
+def test_mongo_reconnects_once():
+    from _mini_mongo import MiniMongo
+    from seaweedfs_tpu.filer.mongo_store import MongoStore
+    m = MiniMongo()
+    try:
+        s = MongoStore("127.0.0.1", m.port)
+        s.kv_put("a", b"1")
+        s.client._sock.close()
+        assert s.kv_get("a") == b"1"
+        s.close()
+    finally:
+        m.close()
+
+
+# -- cassandra (CQL v4 wire, no SDK) -----------------------------------------
+
+def test_cql_wire_statements_are_reference_verbatim():
+    """The five CQL texts must stay byte-for-byte the reference's
+    (cassandra_store.go:72-146) — they are the compatibility surface,
+    and the mini server dispatches on them exactly."""
+    from seaweedfs_tpu.filer.cassandra_store import CassandraStore as S
+    assert S.SQL_INSERT == ("INSERT INTO filemeta (directory,name,meta)"
+                            " VALUES(?,?,?) USING TTL ? ")
+    assert S.SQL_FIND == ("SELECT meta FROM filemeta "
+                          "WHERE directory=? AND name=?")
+    assert S.SQL_LIST_EXCLUSIVE == (
+        "SELECT NAME, meta FROM filemeta WHERE directory=? AND name>? "
+        "ORDER BY NAME ASC LIMIT ?")
+
+
+def test_cql_handshake_and_values():
+    from _mini_cassandra import MiniCassandra
+    from seaweedfs_tpu.filer.cassandra_store import CassandraStore
+    m = MiniCassandra()
+    try:
+        s = CassandraStore("127.0.0.1", m.port)
+        s.insert_entry(Entry(path="/d/f"))
+        assert m.queries_seen[0].startswith("USE")
+        assert ("/d", "f") in m.rows
+        # pagination over the wire
+        for name in ("a", "b", "c"):
+            s.insert_entry(Entry(path=f"/p/{name}"))
+        page = s.list_directory_entries("/p", "a", False, 2)
+        assert [e.name for e in page] == ["b", "c"]
+        # reconnect-once after a dead socket
+        s.client._sock.close()
+        assert s.find_entry("/d/f").path == "/d/f"
+        s.close()
+    finally:
+        m.close()
