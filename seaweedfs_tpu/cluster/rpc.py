@@ -45,6 +45,34 @@ _REASONS = {200: "OK", 201: "Created", 204: "No Content",
             501: "Not Implemented", 503: "Service Unavailable"}
 
 
+def parse_byte_range(rng: str, size: int) -> tuple[int, int] | None:
+    """Single-range 'bytes=' header -> (lo, hi) inclusive; None means
+    serve the whole payload (RFC 7233 lets a server ignore unparseable
+    or multi-part ranges — matching processRangeRequest's single-range
+    fast path, weed/server/common.go:233).  A lo past the end raises
+    RpcError(416)."""
+    if not rng.startswith("bytes=") or "," in rng:
+        return None
+    lo_s, _, hi_s = rng[6:].partition("-")
+    try:
+        if lo_s:
+            lo = int(lo_s)
+            hi = int(hi_s) if hi_s else size - 1
+        else:  # suffix form: bytes=-N
+            lo = max(size - int(hi_s), 0)
+            hi = size - 1
+    except ValueError:
+        return None
+    if lo >= size:
+        if size == 0 and not lo_s:
+            return None  # suffix range of an empty body: serve it all
+        raise RpcError(416, f"range {rng} beyond size {size}")
+    hi = min(hi, size - 1)
+    if hi < lo:  # reversed/negative range: unsatisfiable (Go's
+        return None  # parseRange rejects start > end; serve it all)
+    return lo, hi
+
+
 class RpcError(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(f"HTTP {status}: {message}")

@@ -352,8 +352,26 @@ class VolumeServer:
                 except VolumeError as e:
                     raise rpc.RpcError(403, str(e)) from None
                 if sl is not None:
+                    rng = rpc.parse_byte_range(
+                        query.get("_range_header", ""), sl.size)
+                    if rng is not None:
+                        # CRC was verified over the whole payload;
+                        # sendfile just the requested window
+                        # (processRangeRequest single-range path).
+                        lo, hi = rng
+                        total = sl.size
+                        sl.offset += lo
+                        sl.size = hi - lo + 1
+                        return (206, sl, {
+                            "Content-Length": str(sl.size),
+                            "Content-Range":
+                            f"bytes {lo}-{hi}/{total}",
+                            "Accept-Ranges": "bytes",
+                            "Content-Type":
+                            "application/octet-stream"})
                     return (200, sl,
                             {"Content-Length": str(sl.size),
+                             "Accept-Ranges": "bytes",
                              "Content-Type":
                              "application/octet-stream"})
             try:
@@ -366,8 +384,11 @@ class VolumeServer:
 
     def _serve_needle(self, n: Needle, query: dict):
         """Post-read pipeline shared by the replicated and EC paths:
-        gzip negotiation then optional image resize — storage layout
-        must never change read behavior."""
+        gzip negotiation, optional image resize, then Range shaping on
+        the outgoing representation (processRangeRequest,
+        weed/server/common.go:233 via
+        volume_server_handlers_read.go:255-264) — storage layout must
+        never change read behavior."""
         if n.is_compressed():
             # Stored gzipped (volume_server_handlers_read.go): hand the
             # raw bytes to readers that accept gzip, decompress for the
@@ -375,7 +396,8 @@ class VolumeServer:
             from ..utils.compression import ungzip_data
             if "gzip" in query.get("_accept_encoding", "") and \
                     "width" not in query and "height" not in query:
-                return (200, n.data, {"Content-Encoding": "gzip"})
+                return self._maybe_range(query, n.data,
+                                         {"Content-Encoding": "gzip"})
             n.data = ungzip_data(n.data)
         if "width" in query or "height" in query:
             # On-the-fly resize for image reads
@@ -391,10 +413,23 @@ class VolumeServer:
                     return 0
             data, mime = resized(n.data, _dim("width"), _dim("height"),
                                  query.get("mode", ""))
-            if mime:
-                return (200, data, {"Content-Type": mime})
-            return data
-        return n.data
+            return self._maybe_range(
+                query, data, {"Content-Type": mime} if mime else {})
+        return self._maybe_range(query, n.data, {})
+
+    @staticmethod
+    def _maybe_range(query: dict, data: bytes, hdrs: dict):
+        """Range applies to the response representation (what's being
+        sent after gzip/resize decisions), like the reference where
+        processRangeRequest wraps the final writeFn."""
+        hdrs = {"Accept-Ranges": "bytes", **hdrs}
+        rng = rpc.parse_byte_range(query.get("_range_header", ""),
+                                   len(data))
+        if rng is None:
+            return (200, data, hdrs)
+        lo, hi = rng
+        hdrs["Content-Range"] = f"bytes {lo}-{hi}/{len(data)}"
+        return (206, data[lo:hi + 1], hdrs)
 
     def _ec_read(self, ev: EcVolume, key: int, cookie: int) -> Needle:
         """EC read path with the full distributed ladder (store_ec.go):
