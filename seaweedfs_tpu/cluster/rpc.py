@@ -45,24 +45,33 @@ _REASONS = {200: "OK", 201: "Created", 204: "No Content",
             501: "Not Implemented", 503: "Service Unavailable"}
 
 
+import re as _re
+
+_RANGE_RE = _re.compile(r"^bytes=([0-9]*)-([0-9]*)$")
+
+
 def parse_byte_range(rng: str, size: int) -> tuple[int, int] | None:
     """Single-range 'bytes=' header -> (lo, hi) inclusive; None means
     serve the whole payload (RFC 7233 lets a server ignore unparseable
     or multi-part ranges — matching processRangeRequest's single-range
     fast path, weed/server/common.go:233).  A lo past the end raises
     RpcError(416)."""
-    if not rng.startswith("bytes=") or "," in rng:
+    # Digits only, exactly one dash, at least one side present — like
+    # Go's parseRange; Python's int() would otherwise accept '+5',
+    # '1_0', or whitespace, and 'bytes=--10' would misparse as a
+    # suffix range with a negative length.
+    m = _RANGE_RE.match(rng)
+    if m is None:
         return None
-    lo_s, _, hi_s = rng[6:].partition("-")
-    try:
-        if lo_s:
-            lo = int(lo_s)
-            hi = int(hi_s) if hi_s else size - 1
-        else:  # suffix form: bytes=-N
-            lo = max(size - int(hi_s), 0)
-            hi = size - 1
-    except ValueError:
+    lo_s, hi_s = m.group(1), m.group(2)
+    if not lo_s and not hi_s:
         return None
+    if lo_s:
+        lo = int(lo_s)
+        hi = int(hi_s) if hi_s else size - 1
+    else:  # suffix form: bytes=-N
+        lo = max(size - int(hi_s), 0)
+        hi = size - 1
     if lo >= size:
         if size == 0 and not lo_s:
             return None  # suffix range of an empty body: serve it all
@@ -494,6 +503,10 @@ class JsonHttpServer:
         # dict under reserved keys.
         if "range" in headers:
             query["_range_header"] = headers["range"]
+        if "if-none-match" in headers:
+            query["_if_none_match"] = headers["if-none-match"]
+        if "if-modified-since" in headers:
+            query["_if_modified_since"] = headers["if-modified-since"]
         if "content-type" in headers:
             query["_content_type"] = headers["content-type"]
         # Compression negotiation (volume server gzip path): the upload

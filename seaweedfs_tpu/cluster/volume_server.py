@@ -293,7 +293,19 @@ class VolumeServer:
             except VolumeError as e:
                 raise rpc.RpcError(403, str(e)) from None
             size = len(n.data)
-            hdrs = {}
+            # HEAD shares GET's handler in the reference
+            # (GetOrHeadHandler): same ETag/Last-Modified/Content-Type/
+            # Content-Disposition and the same 304 short-circuits, so a
+            # cache-validation flow can start from a HEAD.
+            hdrs, not_modified = self._conditional_headers(
+                query, f"{n.checksum:08x}",
+                n.name if n.has_name() else b"",
+                n.mime if n.has_mime() else b"",
+                int(n.last_modified) if n.has_last_modified_date()
+                else 0)
+            if not_modified:
+                return (304, b"", hdrs)
+            hdrs["Accept-Ranges"] = "bytes"
             if n.is_compressed() and size >= 4:
                 # HEAD must mirror GET's negotiation: a gzip-accepting
                 # client would receive the stored bytes (report that
@@ -352,8 +364,21 @@ class VolumeServer:
                 except VolumeError as e:
                     raise rpc.RpcError(403, str(e)) from None
                 if sl is not None:
-                    rng = rpc.parse_byte_range(
-                        query.get("_range_header", ""), sl.size)
+                    cond, not_modified = self._conditional_headers(
+                        query, sl.etag, sl.name, sl.mime,
+                        sl.last_modified)
+                    if not_modified:
+                        sl.close()
+                        return (304, b"", cond)
+                    cond.setdefault("Content-Type",
+                                    "application/octet-stream")
+                    cond["Accept-Ranges"] = "bytes"
+                    try:
+                        rng = rpc.parse_byte_range(
+                            query.get("_range_header", ""), sl.size)
+                    except rpc.RpcError:  # 416: the slice owns an fd
+                        sl.close()
+                        raise
                     if rng is not None:
                         # CRC was verified over the whole payload;
                         # sendfile just the requested window
@@ -363,17 +388,13 @@ class VolumeServer:
                         sl.offset += lo
                         sl.size = hi - lo + 1
                         return (206, sl, {
+                            **cond,
                             "Content-Length": str(sl.size),
                             "Content-Range":
-                            f"bytes {lo}-{hi}/{total}",
-                            "Accept-Ranges": "bytes",
-                            "Content-Type":
-                            "application/octet-stream"})
+                            f"bytes {lo}-{hi}/{total}"})
                     return (200, sl,
-                            {"Content-Length": str(sl.size),
-                             "Accept-Ranges": "bytes",
-                             "Content-Type":
-                             "application/octet-stream"})
+                            {**cond,
+                             "Content-Length": str(sl.size)})
             try:
                 n = self.store.read_needle(vid, key, cookie)
             except NotFoundError as e:
@@ -389,6 +410,12 @@ class VolumeServer:
         weed/server/common.go:233 via
         volume_server_handlers_read.go:255-264) — storage layout must
         never change read behavior."""
+        cond, not_modified = self._conditional_headers(
+            query, f"{n.checksum:08x}", n.name if n.has_name() else b"",
+            n.mime if n.has_mime() else b"",
+            int(n.last_modified) if n.has_last_modified_date() else 0)
+        if not_modified:
+            return (304, b"", cond)
         if n.is_compressed():
             # Stored gzipped (volume_server_handlers_read.go): hand the
             # raw bytes to readers that accept gzip, decompress for the
@@ -396,8 +423,9 @@ class VolumeServer:
             from ..utils.compression import ungzip_data
             if "gzip" in query.get("_accept_encoding", "") and \
                     "width" not in query and "height" not in query:
-                return self._maybe_range(query, n.data,
-                                         {"Content-Encoding": "gzip"})
+                return self._maybe_range(
+                    query, n.data,
+                    {**cond, "Content-Encoding": "gzip"})
             n.data = ungzip_data(n.data)
         if "width" in query or "height" in query:
             # On-the-fly resize for image reads
@@ -413,9 +441,54 @@ class VolumeServer:
                     return 0
             data, mime = resized(n.data, _dim("width"), _dim("height"),
                                  query.get("mode", ""))
-            return self._maybe_range(
-                query, data, {"Content-Type": mime} if mime else {})
-        return self._maybe_range(query, n.data, {})
+            if mime:
+                cond = {**cond, "Content-Type": mime}
+            return self._maybe_range(query, data, cond)
+        return self._maybe_range(query, n.data, cond)
+
+    @staticmethod
+    def _conditional_headers(query: dict, etag: str, name: bytes,
+                             mime: bytes, last_modified: int):
+        """Caching/content headers for a needle GET + the 304
+        short-circuit (volume_server_handlers_read.go:113-129 and
+        adjustHeaderContentDisposition, common.go:221): ETag is the
+        quoted 8-hex checksum, Last-Modified honors If-Modified-Since,
+        If-None-Match matches the quoted etag, needle mime wins unless
+        it is octet-stream, and a named needle gets inline/attachment
+        disposition (?dl=true).  Returns (headers, not_modified)."""
+        from email.utils import formatdate, parsedate_to_datetime
+        hdrs = {"ETag": f'"{etag}"'}
+        if last_modified:
+            hdrs["Last-Modified"] = formatdate(last_modified,
+                                               usegmt=True)
+            ims = query.get("_if_modified_since", "")
+            if ims:
+                try:
+                    dt = parsedate_to_datetime(ims)
+                    if dt.tzinfo is None:
+                        # Zone-less dates (obsolete asctime form) are
+                        # GMT per RFC 7231; naive .timestamp() would
+                        # apply the server's local offset.
+                        from datetime import timezone
+                        dt = dt.replace(tzinfo=timezone.utc)
+                    t_ims = dt.timestamp()
+                except (TypeError, ValueError):
+                    t_ims = None
+                if t_ims is not None and t_ims >= last_modified:
+                    return hdrs, True
+        if query.get("_if_none_match", "") == f'"{etag}"':
+            return hdrs, True
+        if mime and not mime.startswith(b"application/octet-stream"):
+            hdrs["Content-Type"] = mime.decode("utf-8", "replace")
+        if name:
+            disp = "inline"
+            if query.get("dl", "").lower() in ("true", "1"):
+                disp = "attachment"
+            fname = (name.decode("utf-8", "replace")
+                     .replace("\\", "\\\\").replace('"', '\\"'))
+            hdrs["Content-Disposition"] = \
+                f'{disp}; filename="{fname}"'
+        return hdrs, False
 
     @staticmethod
     def _maybe_range(query: dict, data: bytes, hdrs: dict):

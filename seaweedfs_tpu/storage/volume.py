@@ -50,6 +50,34 @@ class _WriteReq:
     error: Exception | None = None
 
 
+def _parse_needle_extras(tail: bytes) -> dict:
+    """Parse the post-data record tail (flags + optional extras, no
+    checksum) for the response-header metadata the zero-copy GET path
+    serves: name, mime, last-modified.  Mirrors the field order of
+    Needle._read_body_v2."""
+    from ..core.needle import (FLAG_HAS_LAST_MODIFIED_DATE,
+                               FLAG_HAS_MIME, FLAG_HAS_NAME,
+                               LAST_MODIFIED_BYTES_LENGTH)
+    flags = tail[0]
+    i = 1
+    name = mime = b""
+    last_modified = 0
+    if flags & FLAG_HAS_NAME and i < len(tail):
+        n = tail[i]
+        name = tail[i + 1:i + 1 + n]
+        i += 1 + n
+    if flags & FLAG_HAS_MIME and i < len(tail):
+        n = tail[i]
+        mime = tail[i + 1:i + 1 + n]
+        i += 1 + n
+    if flags & FLAG_HAS_LAST_MODIFIED_DATE and \
+            i + LAST_MODIFIED_BYTES_LENGTH <= len(tail):
+        last_modified = int.from_bytes(
+            tail[i:i + LAST_MODIFIED_BYTES_LENGTH], "big")
+    return {"name": name, "mime": mime,
+            "last_modified": last_modified}
+
+
 class NeedleSlice:
     """A byte range of a volume's .dat holding one needle's payload,
     produced by Volume.read_needle_slice after cookie+CRC checks.
@@ -63,14 +91,22 @@ class NeedleSlice:
     alive — the client finishes reading a consistent pre-compact
     snapshot."""
 
-    __slots__ = ("fd", "offset", "size", "_pos", "_closed")
+    __slots__ = ("fd", "offset", "size", "_pos", "_closed", "etag",
+                 "name", "mime", "last_modified")
 
-    def __init__(self, fd: int, offset: int, size: int):
+    def __init__(self, fd: int, offset: int, size: int,
+                 etag: str = "", name: bytes = b"", mime: bytes = b"",
+                 last_modified: int = 0):
         self.fd = fd  # dup'd; closed by close()
         self.offset = offset
         self.size = size
         self._pos = 0
         self._closed = False
+        # Response-header metadata (checksum etag + record extras).
+        self.etag = etag
+        self.name = name
+        self.mime = mime
+        self.last_modified = last_modified
 
     def read(self, n: int = -1) -> bytes:
         remaining = self.size - self._pos
@@ -436,11 +472,16 @@ class Volume:
                 os.close(fd)
                 return None  # unusual record: take the full parse path
             data_off = offset + t.NEEDLE_HEADER_SIZE + 4
-            flags = os.pread(fd, 1, data_off + data_size)
-            if not flags or flags[0] & (FLAG_IS_COMPRESSED
-                                        | FLAG_HAS_TTL):
+            # Everything after the data bytes up to the checksum:
+            # flags(1) + optional name/mime/last-modified extras —
+            # bounded by `size`, typically a handful of bytes.
+            tail = os.pread(fd, size - 4 - data_size,
+                            data_off + data_size)
+            if not tail or tail[0] & (FLAG_IS_COMPRESSED
+                                      | FLAG_HAS_TTL):
                 os.close(fd)
                 return None  # needs decode / expiry logic
+            meta = _parse_needle_extras(tail)
             stored = t.get_uint32(os.pread(
                 fd, 4, offset + t.NEEDLE_HEADER_SIZE + size))
             crc = 0
@@ -456,7 +497,8 @@ class Volume:
             if crc_mod.masked_value(crc) != stored:
                 raise VolumeError(
                     f"CRC error on needle {needle_id:x}")
-            return NeedleSlice(fd, data_off, data_size)
+            return NeedleSlice(fd, data_off, data_size,
+                               etag=f"{stored:08x}", **meta)
         except BaseException:
             os.close(fd)
             raise

@@ -149,3 +149,65 @@ def test_parse_byte_range_edge_cases():
         with _pytest.raises(rpc.RpcError) as ei:
             parse_byte_range(rng, 0)
         assert ei.value.status == 416
+
+
+def test_conditional_get_etag_last_modified(tmp_path):
+    """volume_server_handlers_read.go:113-129 parity: ETag is the
+    quoted checksum hex, If-None-Match answers 304, Last-Modified +
+    If-Modified-Since answer 304, needle mime/name drive Content-Type
+    and Content-Disposition (?dl=true switches to attachment) — on
+    both the parse path and the zero-copy path."""
+    import urllib.request
+
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master.start()
+    d = tmp_path / "v"
+    d.mkdir()
+    vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60)
+    vs.start()
+    try:
+        client = WeedClient(master.url())
+        cases = [
+            client.upload(b"small payload" * 10, name="doc.pdf",
+                          mime="application/pdf", compress=False)["fid"],
+            client.upload(os.urandom(300_000), name="big.bin",
+                          mime="image/png", compress=False)["fid"],
+        ]
+
+        def get(fid, headers=None, q=""):
+            req = urllib.request.Request(
+                f"http://{vs.url()}/{fid}{q}", headers=headers or {})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status, dict(r.headers), r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, dict(e.headers), b""
+
+        for fid in cases:
+            st, hdrs, body = get(fid)
+            assert st == 200
+            etag = hdrs["ETag"]
+            assert etag.startswith('"') and len(etag) == 10
+            assert "Last-Modified" in hdrs
+            assert 'filename="' in hdrs["Content-Disposition"]
+            assert hdrs["Content-Disposition"].startswith("inline")
+            assert hdrs["Content-Type"] in ("application/pdf",
+                                            "image/png")
+            # If-None-Match -> 304
+            st, _h, body = get(fid, {"If-None-Match": etag})
+            assert st == 304 and body == b""
+            # If-Modified-Since (now) -> 304
+            st, _h, _b = get(
+                fid, {"If-Modified-Since": hdrs["Last-Modified"]})
+            assert st == 304
+            # stale If-Modified-Since -> 200
+            st, _h, _b = get(fid, {
+                "If-Modified-Since":
+                "Mon, 01 Jan 1990 00:00:00 GMT"})
+            assert st == 200
+            # ?dl=true -> attachment
+            st, hdrs, _b = get(fid, q="?dl=true")
+            assert hdrs["Content-Disposition"].startswith("attachment")
+    finally:
+        vs.stop()
+        master.stop()
