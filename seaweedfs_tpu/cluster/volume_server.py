@@ -46,7 +46,8 @@ class VolumeServer:
                  rack: str = "DefaultRack",
                  pulse_seconds: int = 2,
                  jwt_signing_key: str = "",
-                 ssl_context=None):
+                 ssl_context=None,
+                 read_redirect: bool = True):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -65,6 +66,9 @@ class VolumeServer:
         self.data_center = data_center
         self.rack = rack
         self.pulse_seconds = pulse_seconds
+        # -read.redirect (volume.go:79, default true): GETs of volumes
+        # not hosted here 301 to a current holder instead of 404ing.
+        self.read_redirect = read_redirect
         self.server = rpc.JsonHttpServer(host, port,
                                          ssl_context=ssl_context)
         self.store = Store(directories, max_volume_counts,
@@ -78,6 +82,11 @@ class VolumeServer:
         # trusted for a long time.
         self._ec_loc_cache: dict[
             int, tuple[float, float, dict[int, list[str]]]] = {}
+        # vid -> (fetched_at, /dir/lookup response): the volume-location
+        # cache every misdirected read and replication fan-out shares
+        # (operation/lookup.go keeps the same cache for ~10 minutes;
+        # 60s here keeps rebalance staleness short on this plane).
+        self._vol_loc_cache: dict[int, tuple[float, dict]] = {}
         self._ec_read_pool: concurrent.futures.ThreadPoolExecutor | None = None
         self._ec_pool_lock = threading.Lock()
         self._load_ec_volumes()
@@ -279,12 +288,54 @@ class VolumeServer:
         fid = urllib.parse.unquote(path.lstrip("/"))
         return t.parse_file_id(fid)
 
+    _VOL_LOOKUP_TTL = 60.0
+
+    def _lookup_volume(self, vid: int) -> dict:
+        """Cached master /dir/lookup (operation/lookup.go's vid cache)
+        shared by the misdirected-read redirect and the replication
+        fan-out — neither may hammer the master per request."""
+        now = time.time()
+        hit = self._vol_loc_cache.get(vid)
+        if hit and now - hit[0] < self._VOL_LOOKUP_TTL:
+            return hit[1]
+        resp = rpc.call(f"{self.master_url}/dir/lookup?volumeId={vid}")
+        self._vol_loc_cache[vid] = (now, resp)
+        return resp
+
+    def _read_redirect_or_404(self, vid: int, path: str, query: dict):
+        """Non-local volume on the read path: 301 to a current holder
+        when -read.redirect is on (GetOrHeadHandler,
+        volume_server_handlers_read.go:62-83; default true,
+        volume.go:79), else 404 like a redirect-less server.  EC-only
+        volumes redirect to a shard holder (any holder serves reads by
+        distributed reconstruction), like the reference's topology
+        lookup falling back to EC locations."""
+        if self.read_redirect:
+            urls: list[str] = []
+            try:
+                out = self._lookup_volume(vid)
+                for loc in out.get("locations", []):
+                    urls.append(loc.get("publicUrl") or loc.get("url"))
+                for dns in out.get("ecShards", {}).values():
+                    for d in dns:
+                        urls.append(d.get("publicUrl") or d.get("url"))
+            except Exception:  # noqa: BLE001 — master down: plain 404
+                pass
+            for url in urls:
+                if url and url != self.url():
+                    target = f"http://{url}{path}"
+                    if query.get("collection"):
+                        target += "?collection=" + urllib.parse.quote(
+                            query["collection"])
+                    return (301, b"", {"Location": target})
+        raise rpc.RpcError(404, f"volume {vid} not on this server")
+
     def _head_needle(self, path: str, query: dict, body: bytes):
         """Existence/size probe without the body (fsck, clients)."""
         vid, key, cookie = self._parse_fid_path(path)
         v = self.store.find_volume(vid)
         if v is None and vid not in self.ec_volumes:
-            raise rpc.RpcError(404, f"volume {vid} not on this server")
+            return self._read_redirect_or_404(vid, path, query)
         if v is not None:
             try:
                 n = self.store.read_needle(vid, key, cookie)
@@ -340,8 +391,7 @@ class VolumeServer:
         if v is None:
             ev = self.ec_volumes.get(vid)
             if ev is None:
-                raise rpc.RpcError(404,
-                                   f"volume {vid} not on this server")
+                return self._read_redirect_or_404(vid, path, query)
             n = self._ec_read(ev, key, cookie)
         else:
             # Lock-free size peek decides the path so the dominant
@@ -775,8 +825,7 @@ class VolumeServer:
             # RPC saved per write on the hot path.
             return
         try:
-            lookup = rpc.call(
-                f"{self.master_url}/dir/lookup?volumeId={vid}")
+            lookup = self._lookup_volume(vid)
         except Exception:  # noqa: BLE001 — master unreachable: the local
             return         # write stands; repair catches divergence later
         errors = []

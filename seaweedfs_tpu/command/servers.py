@@ -151,7 +151,8 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         data_center=flags.get("dataCenter", "DefaultDataCenter"),
         rack=flags.get("rack", "DefaultRack"),
         jwt_signing_key=flags.get("jwt.key", ""),
-        ssl_context=_security("volume"))
+        ssl_context=_security("volume"),
+        read_redirect=flags.get_bool("read.redirect", True))
     vs.start()
     glog.infof("volume server serving at %s (dirs %s)",
                vs.server.url(), dirs)

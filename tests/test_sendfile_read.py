@@ -211,3 +211,67 @@ def test_conditional_get_etag_last_modified(tmp_path):
     finally:
         vs.stop()
         master.stop()
+
+
+def test_read_redirect_non_local_volume(tmp_path):
+    """-read.redirect parity (volume.go:79, default true;
+    GetOrHeadHandler:62-83): a GET against a server that doesn't host
+    the volume answers 301 to a current holder; with
+    read_redirect=False it answers 404 like before."""
+    import urllib.request
+
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master.start()
+    servers = []
+    for i, redirect in enumerate((True, False)):
+        d = tmp_path / f"v{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60,
+                          read_redirect=redirect)
+        vs.start()
+        servers.append(vs)
+    try:
+        client = WeedClient(master.url())
+        # Fill until both servers host at least one volume, then pick
+        # a fid hosted ONLY on one server.
+        fids = [client.upload_data(f"rr-{i}".encode() * 10)
+                for i in range(60)]
+        by_server: dict[str, str] = {}
+        for fid in fids:
+            vid = int(fid.split(",")[0])
+            locs = client.lookup(vid)
+            if len(locs) == 1:
+                by_server.setdefault(locs[0]["url"], fid)
+        a_url = servers[0].url()
+        b_url = servers[1].url()
+        foreign = by_server.get(b_url)  # hosted on B, ask A
+        assert foreign is not None, by_server
+        # A (redirect on) 301s to B; urllib follows and gets the data.
+        req = urllib.request.Request(f"http://{a_url}/{foreign}")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            assert r.url.startswith(f"http://{b_url}/")
+            assert r.read().startswith(b"rr-")
+        # Raw: the response really is a 301 with Location.
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **kw):
+                return None
+        opener = urllib.request.build_opener(NoRedirect)
+        try:
+            opener.open(f"http://{a_url}/{foreign}", timeout=10)
+            raise AssertionError("expected 301")
+        except urllib.error.HTTPError as e:
+            assert e.code == 301
+            assert e.headers["Location"] == f"http://{b_url}/{foreign}"
+        # B (redirect off) answers 404 for A's volumes.
+        local = by_server.get(a_url)
+        if local is not None:
+            try:
+                opener.open(f"http://{b_url}/{local}", timeout=10)
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+    finally:
+        for vs in servers:
+            vs.stop()
+        master.stop()
