@@ -289,17 +289,27 @@ class VolumeServer:
         return t.parse_file_id(fid)
 
     _VOL_LOOKUP_TTL = 60.0
+    _VOL_LOOKUP_NEG_TTL = 5.0
 
     def _lookup_volume(self, vid: int) -> dict:
         """Cached master /dir/lookup (operation/lookup.go's vid cache)
         shared by the misdirected-read redirect and the replication
-        fan-out — neither may hammer the master per request."""
+        fan-out — neither may hammer the master per request.  A
+        definitive negative answer (the master does not know the
+        volume) is negative-cached briefly, so clients hammering stale
+        fids don't turn every local 404 into a master round-trip."""
         now = time.time()
         hit = self._vol_loc_cache.get(vid)
-        if hit and now - hit[0] < self._VOL_LOOKUP_TTL:
+        if hit and now < hit[0]:
             return hit[1]
-        resp = rpc.call(f"{self.master_url}/dir/lookup?volumeId={vid}")
-        self._vol_loc_cache[vid] = (now, resp)
+        try:
+            resp = rpc.call(
+                f"{self.master_url}/dir/lookup?volumeId={vid}")
+        except rpc.RpcError:
+            self._vol_loc_cache[vid] = (
+                now + self._VOL_LOOKUP_NEG_TTL, {})
+            raise
+        self._vol_loc_cache[vid] = (now + self._VOL_LOOKUP_TTL, resp)
         return resp
 
     def _read_redirect_or_404(self, vid: int, path: str, query: dict):
@@ -321,9 +331,10 @@ class VolumeServer:
                         urls.append(d.get("publicUrl") or d.get("url"))
             except Exception:  # noqa: BLE001 — master down: plain 404
                 pass
+            scheme = "https" if self.server.ssl_context else "http"
             for url in urls:
                 if url and url != self.url():
-                    target = f"http://{url}{path}"
+                    target = f"{scheme}://{url}{path}"
                     if query.get("collection"):
                         target += "?collection=" + urllib.parse.quote(
                             query["collection"])
@@ -858,6 +869,9 @@ class VolumeServer:
         for th in threads:
             th.join()
         if errors:
+            # A cached location just failed: evict so the next write
+            # re-resolves immediately instead of failing for the TTL.
+            self._vol_loc_cache.pop(vid, None)
             raise rpc.RpcError(500, "replication failed: " +
                                "; ".join(errors))
 

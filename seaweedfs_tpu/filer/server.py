@@ -268,9 +268,10 @@ class FilerServer:
         # the streaming upload path (the reference's StreamContent).
         rng = self._parse_range(query.get("_range_header", ""), size)
         if rng is not None:
+            # parse_byte_range guarantees lo <= hi (reversed ranges
+            # come back None -> whole body) and raises 416 itself for
+            # past-the-end starts.
             lo, hi = rng
-            if lo > hi:
-                raise rpc.RpcError(416, "range not satisfiable")
             headers["Content-Range"] = f"bytes {lo}-{hi}/{size}"
             headers["Content-Length"] = str(hi - lo + 1)
             return (206, self.streamer.range_reader(
@@ -280,23 +281,10 @@ class FilerServer:
                 self.streamer.range_reader(e.chunks, 0, size).prime(),
                 headers)
 
-    @staticmethod
-    def _parse_range(rng: str, size: int) -> tuple[int, int] | None:
-        """Single-range 'bytes=' header -> (lo, hi) inclusive; None means
-        serve the whole file (RFC 7233: ignore unparseable ranges)."""
-        if not rng.startswith("bytes=") or "," in rng:
-            return None
-        lo_s, _, hi_s = rng[6:].partition("-")
-        try:
-            if lo_s:
-                lo = int(lo_s)
-                hi = int(hi_s) if hi_s else size - 1
-            else:  # suffix form: bytes=-N
-                lo = max(size - int(hi_s), 0)
-                hi = size - 1
-        except ValueError:
-            return None
-        return lo, min(hi, size - 1)
+    # Range parsing is the shared strict parser (rpc.parse_byte_range)
+    # — the reference's filer and volume reads go through the same
+    # processRangeRequest (filer_server_handlers_read.go:130).
+    _parse_range = staticmethod(rpc.parse_byte_range)
 
     # -- write ---------------------------------------------------------------
 
