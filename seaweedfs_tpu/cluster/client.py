@@ -10,7 +10,16 @@ import time
 import urllib.parse
 
 from ..core import types as t
+from ..trace import current_traceparent
 from . import rpc
+
+
+def _grpc_trace_metadata():
+    """traceparent as gRPC metadata — the gRPC analog of the header the
+    HTTP plane injects in rpc._request (the server facade forwards it
+    to the JSON handlers)."""
+    tp = current_traceparent()
+    return (("traceparent", tp),) if tp else None
 
 
 class VidCache:
@@ -86,7 +95,7 @@ class _GrpcMasterTransport:
             count=count, collection=collection,
             replication=replication or "", ttl=ttl,
             data_center=data_center), timeout=10,
-            wait_for_ready=True)
+            wait_for_ready=True, metadata=_grpc_trace_metadata())
         if out.error:
             raise rpc.RpcError(500, out.error)
         resp = {"fid": out.fid, "url": out.url,
@@ -97,7 +106,8 @@ class _GrpcMasterTransport:
 
     def lookup(self, vid: int) -> list[dict]:
         out = self._lookup(self.pb.LookupVolumeRequest(
-            volume_ids=[str(vid)]), timeout=10, wait_for_ready=True)
+            volume_ids=[str(vid)]), timeout=10, wait_for_ready=True,
+            metadata=_grpc_trace_metadata())
         for entry in out.volume_id_locations:
             if entry.error:
                 return []

@@ -119,6 +119,8 @@ class MasterServer:
         s.route("GET", "/ui", self._ui)
         from ..utils.pprof import enable_pprof_routes
         enable_pprof_routes(s)
+        from ..trace import setup_server_tracing
+        setup_server_tracing(s, "master")
         s.route("POST", "/vol/grow", self._grow)
         s.route("POST", "/vol/vacuum", self._vacuum)
         s.route("GET", "/col/list", self._col_list)

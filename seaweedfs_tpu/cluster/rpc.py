@@ -32,6 +32,8 @@ import time
 import urllib.parse
 from typing import Callable
 
+from ..trace import tracer as _tracer
+
 _REASONS = {200: "OK", 201: "Created", 204: "No Content",
             206: "Partial Content", 301: "Moved Permanently",
             302: "Found", 304: "Not Modified", 307: "Temporary Redirect",
@@ -324,6 +326,9 @@ class JsonHttpServer:
         self.routes: dict[tuple[str, str], Callable] = {}
         self.prefix_routes: list[tuple[str, str, Callable]] = []
         self.metrics = None  # (Registry, Counter, Histogram) when on
+        # Service name for the tracing middleware; set by
+        # trace.setup_server_tracing — None means no server spans.
+        self.trace_service: str | None = None
         self._metrics_route = False
         self._sock: socket.socket | None = None
         self._running = False
@@ -557,15 +562,36 @@ class JsonHttpServer:
 
         metrics = self.metrics
         t0 = time.perf_counter() if metrics else 0.0
+        # Tracing middleware: one server span per routed request,
+        # continuing the caller's traceparent context (or head-sampling
+        # a fresh root).  Scrape/debug endpoints are not traced — a
+        # trace of the trace endpoint is pure noise — but only when the
+        # path actually IS such a mounted route: on the filer, paths
+        # like /metrics or /debug/build.log are user files (served by
+        # prefix routes) and must trace like any other request (same
+        # route-aware stance as the metrics exclusion below).  Every
+        # exit path below MUST end the span: handler threads serve many
+        # keep-alive requests, and a leaked thread-local span would
+        # mis-parent every later request on the connection.
+        tspan = None
+        skip_trace = (self._metrics_route and req_path == "/metrics") \
+            or (req_path.startswith("/debug/")
+                and (method, req_path) in self.routes)
+        if self.trace_service is not None and not skip_trace:
+            tspan = _tracer.begin_server_span(
+                self.trace_service, method, req_path,
+                headers.get("traceparent", ""))
         try:
             result = fn(*args)
         except RpcError as e:
+            _tracer.end_server_span(tspan, e.status)
             if not self._finish_stream_body(body):
                 keep = False
             self._respond(conn, method, e.status, {"error": e.message},
                           None, close=not keep)
             return keep
         except ConnectionError as e:
+            _tracer.end_server_span(tspan, 500)
             if isinstance(body, BodyReader) and body.truncated:
                 # Truncated streaming body: the wire framing is gone,
                 # no reliable response is possible.
@@ -580,6 +606,7 @@ class JsonHttpServer:
                           None, close=not keep)
             return keep
         except Exception as e:  # noqa: BLE001
+            _tracer.end_server_span(tspan, 500)
             if not self._finish_stream_body(body):
                 keep = False
             self._respond(conn, method, 500,
@@ -605,6 +632,9 @@ class JsonHttpServer:
                 status, payload = result
         else:
             status, payload = 200, result
+        # Span end covers handler execution, not the response write (a
+        # slow reader streaming a 30GB body is not server time).
+        _tracer.end_server_span(tspan, status)
         self._respond(conn, method, status, payload, extra,
                       close=not keep)
         return keep
@@ -913,6 +943,15 @@ def _request(url: str, method: str, body, timeout: float,
     """One pooled request; returns (_Resp, _Conn) with the body NOT yet
     read (callers stream or read()).  Retries exactly once on a stale
     reused keep-alive connection (failure before any response bytes)."""
+    # Trace-context propagation: every outbound hop carries the active
+    # span's traceparent so the downstream server span links to it.  An
+    # explicit header wins — fan-out paths that run on worker threads
+    # (replication, EC shard gather) pass their captured context in.
+    tp = _tracer.current_traceparent()
+    if tp and (req_headers is None or
+               _tracer.TRACEPARENT_HEADER not in req_headers):
+        req_headers = {**(req_headers or {}),
+                       _tracer.TRACEPARENT_HEADER: tp}
     # Manual split on the hot path: urlsplit costs ~7µs/request and
     # its internal cache misses on per-fid URLs.  Anything unusual
     # (IPv6 brackets, userinfo, missing scheme, query-with-no-path)

@@ -31,9 +31,11 @@ from ..ec.encoder import rebuild_ec_files, write_ec_files, \
     write_sorted_file_from_idx
 from ..ec.shard_bits import ShardBits
 from ..ec.volume import EcVolume, NeedleNotFound
+from ..stats.metrics import observe_ec_stage
 from ..storage.store import Store
 from ..storage.vacuum import vacuum as vacuum_volume
 from ..storage.volume import NotFoundError, VolumeError
+from ..trace import span as trace_span
 from . import rpc
 
 
@@ -96,6 +98,8 @@ class VolumeServer:
         s.route("GET", "/ui", self._ui)
         from ..utils.pprof import enable_pprof_routes
         enable_pprof_routes(s)
+        from ..trace import setup_server_tracing
+        setup_server_tracing(s, "volumeServer")
         s.route("POST", "/admin/assign_volume", self._admin_assign_volume)
         s.route("POST", "/admin/delete_volume", self._admin_delete_volume)
         s.route("POST", "/admin/readonly", self._admin_readonly)
@@ -197,6 +201,13 @@ class VolumeServer:
                       for l in self.store.locations})
         reg.gauge("SeaweedFS_memory_rss_bytes", "resident set size",
                   callback=lambda: float(memory_status()["rss"]))
+        # EC pipeline stage instruments are process-global singletons
+        # (every coder/reconstruction path observes into them); exposing
+        # them here puts kernel/staging/fan-out time on this server's
+        # /metrics scrape.
+        from ..stats.metrics import ec_stage_bytes, ec_stage_seconds
+        reg.register(ec_stage_seconds)
+        reg.register(ec_stage_bytes)
 
     # -- heartbeats ---------------------------------------------------------
 
@@ -302,13 +313,16 @@ class VolumeServer:
         hit = self._vol_loc_cache.get(vid)
         if hit and now < hit[0]:
             return hit[1]
-        try:
-            resp = rpc.call(
-                f"{self.master_url}/dir/lookup?volumeId={vid}")
-        except rpc.RpcError:
-            self._vol_loc_cache[vid] = (
-                now + self._VOL_LOOKUP_NEG_TTL, {})
-            raise
+        # Cache miss = one master round-trip; on a trace this is where
+        # read-redirect / replication fan-out latency hides.
+        with trace_span("volume.loc_lookup", vid=vid):
+            try:
+                resp = rpc.call(
+                    f"{self.master_url}/dir/lookup?volumeId={vid}")
+            except rpc.RpcError:
+                self._vol_loc_cache[vid] = (
+                    now + self._VOL_LOOKUP_NEG_TTL, {})
+                raise
         self._vol_loc_cache[vid] = (now + self._VOL_LOOKUP_TTL, resp)
         return resp
 
@@ -665,59 +679,95 @@ class VolumeServer:
         # 2. remote shard holders (failover across every holder, like
         #    readRemoteEcShardInterval walking sourceDataNodes)
         locations = self._ec_shard_locations(ev.vid)
-        data = self._fetch_shard_interval(ev, locations, sid, off, size)
+        with trace_span("ec.shard_fetch", vid=ev.vid, shard=sid,
+                        size=size):
+            data = self._fetch_shard_interval(ev, locations, sid, off,
+                                              size)
         if data is not None:
             return data
         # 3. reconstruct from >=10 other shard intervals.  Fan the reads
         # out in parallel — latency is the slowest single fetch, not the
         # sum of 13 round-trips (store_ec.go:322-376 launches one
         # goroutine per shard; recoverOneRemoteEcShardInterval).
-        pool = self._ec_pool()
-        futs = {
-            pool.submit(
-                self._fetch_shard_interval, ev, locations, other, off, size):
-            other
-            for other in range(TOTAL_SHARDS) if other != sid
-        }
-        have: dict[int, bytes] = {}
-        for f in concurrent.futures.as_completed(futs):
-            buf = f.result()
-            if buf is not None:
-                have[futs[f]] = buf
-                if len(have) >= 10:
-                    break
-        for f in futs:
-            f.cancel()
-        if len(have) < 10:
-            # The location map let us down — drop it so the next read
-            # refreshes immediately instead of waiting out the TTL.
-            self._ec_loc_cache.pop(ev.vid, None)
-            raise rpc.RpcError(
-                500, f"cannot reconstruct shard {sid}: only {len(have)} "
-                     f"shard intervals reachable")
-        import numpy as np
-        arrs = {k: np.frombuffer(v, dtype=np.uint8) for k, v in have.items()}
-        rec = ev.coder.reconstruct(arrs, wanted=[sid])
-        return np.asarray(rec[sid]).tobytes()
+        with trace_span("ec.reconstruct", vid=ev.vid, shard=sid,
+                        size=size) as rspan:
+            # Pool threads have no thread-local trace context — hand
+            # them this span's context explicitly.
+            tp = rspan.traceparent() or None
+            pool = self._ec_pool()
+            t_gather = time.perf_counter()
+            futs = {
+                pool.submit(
+                    self._fetch_shard_interval, ev, locations, other,
+                    off, size, tp):
+                other
+                for other in range(TOTAL_SHARDS) if other != sid
+            }
+            have: dict[int, bytes] = {}
+            for f in concurrent.futures.as_completed(futs):
+                buf = f.result()
+                if buf is not None:
+                    have[futs[f]] = buf
+                    if len(have) >= 10:
+                        break
+            for f in futs:
+                f.cancel()
+            # Network fan-out cost, separate from the GF solve below.
+            observe_ec_stage("shard_gather",
+                             time.perf_counter() - t_gather,
+                             sum(len(b) for b in have.values()))
+            if len(have) < 10:
+                # The location map let us down — drop it so the next
+                # read refreshes immediately instead of waiting out the
+                # TTL.
+                self._ec_loc_cache.pop(ev.vid, None)
+                raise rpc.RpcError(
+                    500, f"cannot reconstruct shard {sid}: only "
+                         f"{len(have)} shard intervals reachable")
+            import jax
+            import numpy as np
+            arrs = {k: np.frombuffer(v, dtype=np.uint8)
+                    for k, v in have.items()}
+            # Execution-fenced device time: block_until_ready is a
+            # no-op passthrough for numpy/native coders and fences the
+            # async dispatch for jax/pallas ones, so the histogram
+            # records real solve time, not dispatch time.
+            t_dev = time.perf_counter()
+            rec = jax.block_until_ready(
+                ev.coder.reconstruct(arrs, wanted=[sid]))
+            observe_ec_stage("reconstruct_device",
+                             time.perf_counter() - t_dev, size)
+            t_stage = time.perf_counter()
+            out = np.asarray(rec[sid]).tobytes()
+            observe_ec_stage("host_staging",
+                             time.perf_counter() - t_stage, size)
+            rspan.set(gathered=len(have))
+            return out
 
     def _fetch_shard_interval(self, ev: EcVolume,
                               locations: dict[int, list[str]],
-                              sid: int, off: int, size: int) -> bytes | None:
+                              sid: int, off: int, size: int,
+                              traceparent: str | None = None
+                              ) -> bytes | None:
         """One shard's interval: local file first, then every remote
-        holder in turn.  Returns None when no source can serve it."""
+        holder in turn.  Returns None when no source can serve it.
+        `traceparent` carries the caller's trace context across the
+        fan-out pool's thread boundary."""
         local = ev.shards.get(sid)
         if local is not None:
             buf = local.read_at(off, size)
             if len(buf) == size:
                 return buf
         me = self.url()
+        hdrs = {"traceparent": traceparent} if traceparent else None
         for url in locations.get(sid, []):
             if url == me:
                 continue
             try:
                 data = rpc.call(
                     f"http://{url}/admin/ec/shard_read?volume={ev.vid}"
-                    f"&shard={sid}&offset={off}&size={size}")
+                    f"&shard={sid}&offset={off}&size={size}",
+                    headers=hdrs)
                 if len(data) == size:
                     return bytes(data)
             except Exception:  # noqa: BLE001 — try next holder
@@ -853,27 +903,39 @@ class VolumeServer:
         hdrs = {"Content-Encoding": "gzip"} \
             if "gzip" in query.get("_content_encoding", "") else None
 
-        def send(url):
-            try:
-                rpc.call(f"http://{url}{path}?{qs}", method, body,
-                         headers=hdrs)
-            except Exception as e:  # noqa: BLE001
-                errors.append(f"{url}: {e}")
+        with trace_span("volume.replicate", vid=vid,
+                        method=method) as rspan:
+            # Sends run on fresh threads where the thread-local trace
+            # context is empty: capture the fan-out span's context here
+            # and pass it explicitly so each replica's server span
+            # parents under it.
+            tp = rspan.traceparent()
+            send_hdrs = dict(hdrs or {})
+            if tp:
+                send_hdrs["traceparent"] = tp
 
-        for loc in lookup.get("locations", []):
-            if loc["url"] == me:
-                continue
-            th = threading.Thread(target=send, args=(loc["url"],))
-            th.start()
-            threads.append(th)
-        for th in threads:
-            th.join()
-        if errors:
-            # A cached location just failed: evict so the next write
-            # re-resolves immediately instead of failing for the TTL.
-            self._vol_loc_cache.pop(vid, None)
-            raise rpc.RpcError(500, "replication failed: " +
-                               "; ".join(errors))
+            def send(url):
+                try:
+                    rpc.call(f"http://{url}{path}?{qs}", method, body,
+                             headers=send_hdrs or None)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"{url}: {e}")
+
+            for loc in lookup.get("locations", []):
+                if loc["url"] == me:
+                    continue
+                th = threading.Thread(target=send, args=(loc["url"],))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            rspan.set(replicas=len(threads), errors=len(errors))
+            if errors:
+                # A cached location just failed: evict so the next write
+                # re-resolves immediately instead of failing for the TTL.
+                self._vol_loc_cache.pop(vid, None)
+                raise rpc.RpcError(500, "replication failed: " +
+                                   "; ".join(errors))
 
     # -- admin handlers ------------------------------------------------------
 

@@ -131,6 +131,20 @@ def main(argv: list[str] | None = None) -> int:
         from ..utils.pprof import setup_profiling
         setup_profiling(flags.get("cpuprofile", ""),
                         flags.get("memprofile", ""))
+    # Distributed-tracing knobs, process-wide on any server command
+    # (trace/tracer.py reads these env vars dynamically; flags just set
+    # them before servers construct):  -debug.traces mounts the
+    # /debug/traces endpoint (operator opt-in, like pprof);
+    # -trace.sample / -trace.slowMs tune head sampling and the
+    # always-sample slow threshold; -trace=false disables recording.
+    if flags.get_bool("debug.traces", False):
+        os.environ["SEAWEEDFS_TPU_TRACES"] = "1"
+    if "trace" in flags and not flags.get_bool("trace", True):
+        os.environ["SEAWEEDFS_TPU_TRACE"] = "0"
+    if flags.get("trace.sample"):
+        os.environ["SEAWEEDFS_TPU_TRACE_SAMPLE"] = flags.get("trace.sample")
+    if flags.get("trace.slowMs"):
+        os.environ["SEAWEEDFS_TPU_TRACE_SLOW_MS"] = flags.get("trace.slowMs")
     # Every cluster-dialing command — servers AND clients (upload,
     # shell, mount, …) — goes through the TLS plane when security.toml
     # configures [grpc.client], matching the reference where each

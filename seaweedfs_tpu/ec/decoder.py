@@ -7,8 +7,11 @@ from __future__ import annotations
 
 import os
 import shutil
+import time
 
 from . import DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, to_ext
+from ..stats.metrics import observe_ec_stage
+from ..trace import span as trace_span
 from ..core import idx as idx_mod
 from ..core import types as t
 from ..core.needle import get_actual_size
@@ -58,7 +61,21 @@ def find_dat_file_size(base_file_name: str) -> int:
 def write_dat_file(base_file_name: str, dat_file_size: int,
                    large_block_size: int = LARGE_BLOCK_SIZE,
                    small_block_size: int = SMALL_BLOCK_SIZE) -> None:
-    """Interleave-copy .ec00-.ec09 back into a .dat of the given size."""
+    """Interleave-copy .ec00-.ec09 back into a .dat of the given size.
+    Host-side stage of ec.to_volume — timed into the EC stage histogram
+    (and spanned on a trace) alongside the device stages, so decode
+    cost is attributable next to kernel and fan-out cost."""
+    t0 = time.perf_counter()
+    with trace_span("ec.dat_rebuild", bytes=dat_file_size):
+        _write_dat_file(base_file_name, dat_file_size,
+                        large_block_size, small_block_size)
+    observe_ec_stage("dat_rebuild", time.perf_counter() - t0,
+                     dat_file_size)
+
+
+def _write_dat_file(base_file_name: str, dat_file_size: int,
+                    large_block_size: int,
+                    small_block_size: int) -> None:
     ins = [open(base_file_name + to_ext(i), "rb")
            for i in range(DATA_SHARDS)]
     try:

@@ -14,6 +14,16 @@ import urllib.parse
 import urllib.request
 
 from ..cluster import rpc
+from ..trace import current_traceparent
+
+
+def _traced(req: urllib.request.Request) -> urllib.request.Request:
+    """Propagate the active trace context on the urllib-based calls
+    (the rpc-pooled calls inject it in rpc._request)."""
+    tp = current_traceparent()
+    if tp:
+        req.add_header("traceparent", tp)
+    return req
 
 
 class FilerProxy:
@@ -26,7 +36,7 @@ class FilerProxy:
         return self.url + urllib.parse.quote(path)
 
     def get(self, path: str, range_header: str = ""):
-        req = urllib.request.Request(self._q(path))
+        req = _traced(urllib.request.Request(self._q(path)))
         if range_header:
             req.add_header("Range", range_header)
         return urllib.request.urlopen(req, timeout=60)
@@ -47,8 +57,8 @@ class FilerProxy:
         with a known length it goes out as-is under Content-Length,
         otherwise chunked transfer-encoding — either way the filer
         consumes it incrementally (its upload route is stream_body)."""
-        req = urllib.request.Request(self._q(path), data=body,
-                                     method="POST")
+        req = _traced(urllib.request.Request(self._q(path), data=body,
+                                             method="POST"))
         if content_type:
             req.add_header("Content-Type", content_type)
         if hasattr(body, "read"):
@@ -144,8 +154,8 @@ class FilerProxy:
         return handle, handle.events()
 
     def kv_get(self, key: str) -> bytes | None:
-        req = urllib.request.Request(self.url + "/.kv/" +
-                                     urllib.parse.quote(key, safe=""))
+        req = _traced(urllib.request.Request(
+            self.url + "/.kv/" + urllib.parse.quote(key, safe="")))
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 return resp.read()

@@ -20,6 +20,7 @@ import urllib.parse
 
 from ..cluster import rpc
 from ..cluster.client import WeedClient
+from ..trace import span as trace_span
 from .entry import Attributes, Entry
 from .filechunks import etag as chunks_etag, total_size
 from .filer import Filer, FilerError
@@ -141,6 +142,8 @@ class FilerServer:
         s.route("GET", "/.ui", self._ui)
         from ..utils.pprof import enable_pprof_routes
         enable_pprof_routes(s)
+        from ..trace import setup_server_tracing
+        setup_server_tracing(s, "filer")
         # Master proxies: mounts and other filer-only clients assign
         # file ids and resolve volumes through the filer (the filer
         # gRPC AssignVolume/LookupVolume surface, filer.proto:30-33).
@@ -368,9 +371,15 @@ class FilerServer:
         raw_chunks: list = []
         manifests: list = []
         try:
-            writer.write(body, into=raw_chunks)
-            chunks = self._manifestize(raw_chunks, collection, ttl,
-                                       created=manifests)
+            # The chunk-upload fan-out is where a slow filer write
+            # hides: each chunk is an assign (master hop) + POST
+            # (volume hop, which itself fans out to replicas) — all
+            # child spans of this one on a trace.
+            with trace_span("filer.write.chunks", path=path) as csp:
+                writer.write(body, into=raw_chunks)
+                chunks = self._manifestize(raw_chunks, collection, ttl,
+                                           created=manifests)
+                csp.set(chunks=len(raw_chunks))
         except Exception:
             # Client died (or a volume write failed) mid-stream: the
             # entry never existed, so free everything that landed —
@@ -385,7 +394,8 @@ class FilerServer:
             ttl_sec=_ttl_seconds(ttl), collection=collection,
             replication=self.replication or "")
         try:
-            with self.filer.with_signatures(self._signatures(query)):
+            with trace_span("filer.create_entry", path=path), \
+                    self.filer.with_signatures(self._signatures(query)):
                 entry = self.filer.create_entry(
                     Entry(path=path, chunks=chunks, attributes=attr))
         except FilerError as e:

@@ -12,6 +12,7 @@ from collections import OrderedDict
 from typing import Iterator
 
 from ..cluster.client import WeedClient
+from ..trace import span as trace_span
 from .entry import FileChunk
 from .filechunks import read_chunk_views, total_size
 
@@ -238,8 +239,13 @@ class ChunkedWriter:
             piece = reader.read(self.chunk_size)
             if not piece:
                 break
-            chunks.append(upload_blob(self.client, piece, self.collection,
-                                      self.replication, self.ttl, pos,
-                                      cipher=self.cipher))
+            # One span per chunk: assign + volume POST, each a child
+            # server span on the trace — a no-op outside a request.
+            with trace_span("filer.chunk", offset=pos,
+                            bytes=len(piece)):
+                chunks.append(upload_blob(
+                    self.client, piece, self.collection,
+                    self.replication, self.ttl, pos,
+                    cipher=self.cipher))
             pos += len(piece)
         return chunks

@@ -22,12 +22,27 @@ The same kernel serves encode (B = parity bit-matrix) and reconstruction
 from __future__ import annotations
 
 import functools
+import os
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ..stats.metrics import observe_ec_stage
+
+
+def _prof_on() -> bool:
+    """Per-stage device-time histograms (SEAWEEDFS_TPU_EC_PROF=0 to
+    disable).  Profiling fences each call with block_until_ready — in
+    the serving paths results are staged to host right away so the
+    fence costs nothing; raw-throughput benchmarks that pipeline
+    dispatches (bench.py drives apply_bitmatrix_pallas directly and is
+    unaffected) can turn it off."""
+    return os.environ.get("SEAWEEDFS_TPU_EC_PROF", "1") \
+        not in ("0", "false")
 
 # Lane-dimension tile: one grid step processes k x BLOCK_N bytes.
 # 8k x BLOCK_N bf16 bit planes = 80*4096*2B = 640KB VMEM for RS(10,4) —
@@ -149,7 +164,14 @@ class PallasCoder:
         if data.shape[0] != self.data_shards:
             raise ValueError(
                 f"expected {self.data_shards} data shards, got {data.shape[0]}")
-        return self._apply(self._parity_pm, data, self.parity_shards)
+        if not _prof_on():
+            return self._apply(self._parity_pm, data, self.parity_shards)
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(
+            self._apply(self._parity_pm, data, self.parity_shards))
+        observe_ec_stage("encode_kernel", time.perf_counter() - t0,
+                         data.shape[0] * data.shape[1])
+        return out
 
     def encode_all(self, data) -> jax.Array:
         data = jnp.asarray(data, jnp.uint8)
@@ -176,7 +198,14 @@ class PallasCoder:
             return {}
         mat_pm, used = self._decode_mat_pm(present, tuple(wanted))
         stacked = jnp.stack([jnp.asarray(shards[s], jnp.uint8) for s in used])
-        rec = self._apply(mat_pm, stacked, len(wanted))
+        if not _prof_on():
+            rec = self._apply(mat_pm, stacked, len(wanted))
+            return {w: rec[i] for i, w in enumerate(wanted)}
+        t0 = time.perf_counter()
+        rec = jax.block_until_ready(
+            self._apply(mat_pm, stacked, len(wanted)))
+        observe_ec_stage("reconstruct_kernel", time.perf_counter() - t0,
+                         stacked.shape[0] * stacked.shape[1])
         return {w: rec[i] for i, w in enumerate(wanted)}
 
     def verify(self, shards) -> bool:

@@ -24,10 +24,12 @@ from __future__ import annotations
 import concurrent.futures
 import os
 import tempfile
+import time
 
 import numpy as np
 
 from ..cluster import rpc
+from ..stats.metrics import observe_ec_stage
 from ..ec import (DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
                   TOTAL_SHARDS, to_ext)
 from ..ec.encoder import (DEFAULT_CHUNK, _chunk_reader,
@@ -125,8 +127,12 @@ def _encode_batch_group(env, mesh, pool, batch, chunk_size,
             for url in locs:
                 env.vs_call(url, "/admin/readonly",
                             {"volume": vid, "readonly": True})
+        t_fetch = time.perf_counter()
         bases = list(pool.map(
             lambda t: _fetch_volume(tmp, *t), batch))
+        observe_ec_stage(
+            "batch_fetch", time.perf_counter() - t_fetch,
+            sum(os.path.getsize(b + ".dat") for b in bases))
 
         # 2. Mesh-encode: lockstep stripe chunks across volumes.  Each
         # volume's chunk sequence is the exact local-encoder chunking
@@ -160,7 +166,14 @@ def _encode_batch_group(env, mesh, pool, batch, chunk_size,
                                    np.uint8)
                 for j, c in enumerate(chunks):
                     stacked[j, :, :c.shape[1]] = c
+                # np.asarray fences the dispatch (device→host copy), so
+                # this is execution-fenced device+staging time for the
+                # batched GF(2) matmul.
+                t_dev = time.perf_counter()
                 parity = np.asarray(batched_encode(stacked, mesh))
+                observe_ec_stage("batch_encode_device",
+                                 time.perf_counter() - t_dev,
+                                 stacked.nbytes)
                 for j, v in enumerate(active):
                     writers[v].write(chunks[j],
                                      parity[j, :, :widths[j]])
@@ -177,10 +190,13 @@ def _encode_batch_group(env, mesh, pool, batch, chunk_size,
         for (vid, locs), base in zip(batch, bases):
             plan = balanced_distribution(collect_ec_nodes(env))
             futs = []
+            t_scatter = time.perf_counter()
+            scattered = 0
             for url, shards in plan.items():
                 for sid in shards:
                     with open(base + to_ext(sid), "rb") as f:
                         payload = f.read()
+                    scattered += len(payload)
                     futs.append(pool.submit(
                         rpc.call,
                         f"http://{url}/admin/ec/receive_shard?"
@@ -188,6 +204,8 @@ def _encode_batch_group(env, mesh, pool, batch, chunk_size,
                         600.0))
             for f in futs:
                 f.result()
+            observe_ec_stage("batch_scatter",
+                             time.perf_counter() - t_scatter, scattered)
             with open(base + ".ecx", "rb") as f:
                 ecx = f.read()
             for url in plan:

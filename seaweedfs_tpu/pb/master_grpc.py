@@ -19,9 +19,29 @@ from concurrent import futures
 import grpc
 
 from ..cluster import rpc as jrpc
+from ..trace import tracer as _tracer
 from . import master_pb2 as pb
 
 GRPC_PORT_DELTA = 10_000  # grpc port = http port + 10000
+
+
+def _begin_grpc_span(ctx, name: str):
+    """Server span for one facade RPC: the gRPC plane bypasses the
+    JsonHttpServer middleware, so the traceparent riding the invocation
+    metadata (cluster/client._grpc_trace_metadata) is extracted here —
+    the same contract as the HTTP header."""
+    if not _tracer.recording_on():
+        return None  # stock deployment: zero tracing cost (the HTTP
+        #              middleware is gated the same way at setup)
+    tp = ""
+    try:
+        for k, v in ctx.invocation_metadata() or ():
+            if k == "traceparent":
+                tp = v
+                break
+    except Exception:  # noqa: BLE001 — a trace must never fail an RPC
+        pass
+    return _tracer.begin_server_span("master", "GRPC", name, tp)
 
 
 def _vinfo_dict(v: "pb.VolumeInformationMessage") -> dict:
@@ -165,16 +185,35 @@ class MasterGrpcServer:
             query["rack"] = req.rack
         if req.data_node:
             query["dataNode"] = req.data_node
+        span = _begin_grpc_span(ctx, "/master_pb.Seaweed/Assign")
+        status = 200  # in-message errors must not trace as "ok"
         try:
             out = self.master._assign(query, b"")
         except jrpc.RpcError as e:
+            status = e.status
             return pb.AssignResponse(error=e.message)
+        except BaseException:
+            status = 500  # span MUST end: grpc worker threads are
+            raise         # pooled, a leaked span mis-parents later RPCs
+        finally:
+            _tracer.end_server_span(span, status)
         return pb.AssignResponse(
             fid=out.get("fid", ""), url=out.get("url", ""),
             public_url=out.get("publicUrl", ""),
             count=out.get("count", 1), auth=out.get("auth", ""))
 
     def _lookup_volume(self, req: "pb.LookupVolumeRequest", ctx):
+        span = _begin_grpc_span(ctx, "/master_pb.Seaweed/LookupVolume")
+        status = 200
+        try:
+            return self._lookup_volume_inner(req)
+        except BaseException:
+            status = 500
+            raise
+        finally:
+            _tracer.end_server_span(span, status)
+
+    def _lookup_volume_inner(self, req: "pb.LookupVolumeRequest"):
         resp = pb.LookupVolumeResponse()
         for vid_str in req.volume_ids:
             entry = resp.volume_id_locations.add(volume_id=vid_str)

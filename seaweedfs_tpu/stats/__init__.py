@@ -6,5 +6,8 @@ sysstats.py reads disk/memory figures (stats/disk.go, memory.go).
 """
 
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
-                      MetricsPusher, Registry, global_registry)
+                      MetricsPusher, Registry, ec_stage_bytes,
+                      ec_stage_seconds, global_registry,
+                      observe_ec_stage)
+from .promcheck import validate_exposition  # noqa: F401
 from .sysstats import disk_status, memory_status  # noqa: F401

@@ -28,12 +28,20 @@ def _fmt_num(v: float) -> str:
     return repr(f)
 
 
+def _esc_label_value(v) -> str:
+    # Prometheus exposition requires all three escapes: backslash first
+    # (or the others' escapes would be double-escaped), then quote, then
+    # newline — an unescaped \n in a label value splits the sample line
+    # and corrupts the whole line-oriented scrape.
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(
-        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
-        for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_esc_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -183,6 +191,7 @@ class Histogram(_Metric):
         class _Timer:
             def __enter__(self):
                 self.t0 = time.perf_counter()
+                return self  # nestable with other context managers
 
             def __exit__(self, *exc):
                 hist.observe(time.perf_counter() - self.t0, **labels)
@@ -257,6 +266,36 @@ class Registry:
 global_registry = Registry()
 
 
+# -- EC pipeline stage instruments ------------------------------------------
+# Process-global singletons every EC code path observes into — the
+# Pallas coder's execution-fenced kernel timings (ops/coder_pallas.py),
+# the batched mesh encode's fetch/device/scatter stages
+# (parallel/cluster_encode.py), and the volume server's distributed
+# reconstruction ladder (shard gather, device solve, host staging).
+# Servers register the SAME objects into their scrape registry
+# (Registry.register accepts an existing metric), so kernel time, host
+# staging, and network fan-out are separately visible on /metrics
+# wherever EC work runs.  Buckets extend past the request-latency
+# defaults: a batched multi-volume encode legitimately takes minutes.
+
+EC_STAGE_BUCKETS = DEFAULT_BUCKETS + (30.0, 60.0, 120.0)
+
+ec_stage_seconds = Histogram(
+    "SeaweedFS_ec_stage_seconds",
+    "EC pipeline stage wall time (device stages are execution-fenced)",
+    ("stage",), buckets=EC_STAGE_BUCKETS)
+
+ec_stage_bytes = Counter(
+    "SeaweedFS_ec_stage_bytes_total",
+    "bytes processed per EC pipeline stage", ("stage",))
+
+
+def observe_ec_stage(stage: str, seconds: float, nbytes: int = 0) -> None:
+    ec_stage_seconds.observe(seconds, stage=stage)
+    if nbytes:
+        ec_stage_bytes.inc(nbytes, stage=stage)
+
+
 class MetricsPusher:
     """LoopPushingMetric (stats/metrics.go:140): periodically POST the
     exposition text to a push gateway."""
@@ -275,7 +314,16 @@ class MetricsPusher:
         self._thread.start()
 
     def stop(self) -> None:
+        """Stop the loop, wait for any in-flight push (bounded — the
+        push itself has a 10s timeout), then flush one final exposition
+        so a short-lived process doesn't lose its last interval."""
         self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=15.0)
+        try:
+            self.push_once()
+        except Exception:  # noqa: BLE001 — gateway down; best effort
+            pass
 
     def push_once(self) -> None:
         body = self.registry.expose().encode()

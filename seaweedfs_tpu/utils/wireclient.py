@@ -12,6 +12,8 @@ from __future__ import annotations
 import socket
 import threading
 
+from ..trace import span as _trace_span
+
 
 class WireClient:
     def __init__(self, host: str, port: int, timeout: float = 10.0):
@@ -48,17 +50,23 @@ class WireClient:
 
     def _call(self, fn):
         """Run one round trip under the lock, redialing once if the
-        pooled connection died between commands."""
-        with self._lock:
-            for attempt in (0, 1):
-                if self._sock is None:
-                    self._connect()
-                try:
-                    return fn()
-                except (OSError, ConnectionError):
-                    self.close_nolock()
-                    if attempt:
-                        raise
+        pooled connection died between commands.
+
+        Binary wire protocols (RESP/OP_MSG/CQL) carry no traceparent
+        header, so the backing-store hop appears on a trace as a client
+        span here instead — a no-op outside an active request."""
+        with _trace_span(f"wire.{type(self).__name__}",
+                         peer=f"{self.host}:{self.port}"):
+            with self._lock:
+                for attempt in (0, 1):
+                    if self._sock is None:
+                        self._connect()
+                    try:
+                        return fn()
+                    except (OSError, ConnectionError):
+                        self.close_nolock()
+                        if attempt:
+                            raise
         raise AssertionError("unreachable")
 
     def close_nolock(self) -> None:

@@ -11,7 +11,7 @@ import urllib.request
 import pytest
 
 from seaweedfs_tpu.stats import (MetricsPusher, Registry, disk_status,
-                                 memory_status)
+                                 memory_status, validate_exposition)
 
 
 # -- primitives ------------------------------------------------------------
@@ -54,6 +54,100 @@ def test_histogram_buckets_and_sum():
     assert 'lat_seconds_sum{op="get"} 5.555' in text
 
 
+def test_label_values_fully_escaped():
+    """Backslash, quote AND newline must all be escaped — an unescaped
+    \\n splits the sample line and corrupts the whole scrape."""
+    reg = Registry()
+    c = reg.counter("esc_total", "escapes", ("path",))
+    c.inc(path='a\\b"c\nd')
+    text = reg.expose()
+    assert 'esc_total{path="a\\\\b\\"c\\nd"} 1' in text
+    assert validate_exposition(text) == []
+
+
+def test_histogram_time_returns_timer():
+    reg = Registry()
+    h = reg.histogram("t_seconds", "timer", buckets=(1.0,))
+    with h.time() as timer:
+        assert timer is not None  # nestable with other ctx managers
+    assert "t_seconds_count 1" in reg.expose()
+
+
+def test_concurrent_observe_while_exposing():
+    """8 writer threads inc/observe while expose() runs in a loop —
+    thread-safety regression test (the exposition must neither crash
+    nor lose increments)."""
+    reg = Registry()
+    c = reg.counter("cc_total", "concurrent", ("t",))
+    h = reg.histogram("ch_seconds", "concurrent", ("t",),
+                      buckets=(0.001, 0.01, 0.1))
+    stop = threading.Event()
+    errors: list = []
+
+    def writer(i: int) -> None:
+        try:
+            for n in range(500):
+                c.inc(t=str(i))
+                h.observe(0.001 * (n % 3), t=str(i))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def scraper() -> None:
+        try:
+            while not stop.is_set():
+                assert validate_exposition(reg.expose()) == []
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    scrape_thread = threading.Thread(target=scraper)
+    scrape_thread.start()
+    writers = [threading.Thread(target=writer, args=(i,))
+               for i in range(8)]
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    scrape_thread.join()
+    assert not errors
+    text = reg.expose()
+    for i in range(8):
+        assert f'cc_total{{t="{i}"}} 500' in text
+        assert f'ch_seconds_count{{t="{i}"}} 500' in text
+    assert validate_exposition(text) == []
+
+
+# -- exposition-format validator (promtool-style) ---------------------------
+
+def test_validator_accepts_all_primitive_expositions():
+    reg = Registry()
+    reg.counter("v_total", "c", ("op",)).inc(op="x")
+    reg.gauge("v_depth", "g").set(3)
+    h = reg.histogram("v_seconds", "h", ("op",), buckets=(0.1, 1.0))
+    h.observe(0.05, op="x")
+    h.observe(5.0, op="x")
+    assert validate_exposition(reg.expose()) == []
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ("m_total{l=\"a\nb\"} 1", "bad"),                     # raw newline
+    ("m_total{l=\"a\\qb\"} 1", "escape"),                 # bad escape
+    ("1bad_name 2", "name"),                              # bad name
+    ("m_total{l=\"v\"} notanumber", "value"),             # bad value
+    ("# TYPE m histogram\nm_bucket{le=\"1\"} 5\n"
+     "m_bucket{le=\"0.5\"} 3\nm_bucket{le=\"+Inf\"} 6",
+     "ascending"),                                        # le order
+    ("# TYPE m histogram\nm_bucket{le=\"1\"} 5\n"
+     "m_bucket{le=\"+Inf\"} 3", "cumulative"),            # non-cumulative
+    ("# TYPE m histogram\nm_bucket{le=\"1\"} 5", "+Inf"),  # no +Inf
+    ("a_total 1\nb_total 1\na_total 2", "interleaved"),   # family split
+    ("# HELP m x\n# HELP m y\nm 1", "duplicate"),         # dup HELP
+])
+def test_validator_rejects_malformed(bad, needle):
+    problems = validate_exposition(bad)
+    assert problems and any(needle in p for p in problems), problems
+
+
 def test_broken_callback_does_not_kill_scrape():
     reg = Registry()
     reg.gauge("bad", "boom", callback=lambda: 1 / 0)
@@ -75,12 +169,18 @@ def stack(tmp_path_factory):
     from seaweedfs_tpu.cluster.client import WeedClient
     from seaweedfs_tpu.cluster.master import MasterServer
     from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.filer.server import FilerServer
     tmp = tmp_path_factory.mktemp("metrics-stack")
     master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp))
     master.start()
     vs = VolumeServer(master.url(), [str(tmp / "vs")], pulse_seconds=60)
     vs.start()
-    yield master, vs, WeedClient(master.url())
+    # metrics_port=0 -> scrape rides its own free port (the filer's /
+    # is user namespace, like the reference's -metricsPort).
+    filer = FilerServer(master.url(), metrics_port=0)
+    filer.start()
+    yield master, vs, WeedClient(master.url()), filer
+    filer.stop()
     vs.stop()
     master.stop()
 
@@ -92,7 +192,7 @@ def _scrape(url: str) -> str:
 
 
 def test_master_and_volume_metrics_endpoints(stack):
-    master, vs, client = stack
+    master, vs, client, _filer = stack
     fid = client.upload_data(b"metrics payload")
     client.download(fid)
     mtext = _scrape(master.url())
@@ -131,10 +231,59 @@ def test_metrics_pusher(stack):
         gw.stop()
 
 
+def test_live_scrapes_pass_promtool_validation(stack):
+    """Every role's live exposition parses clean under the promtool-
+    style validator: master, volume server, and the filer's dedicated
+    metrics port."""
+    master, vs, client, filer = stack
+    from seaweedfs_tpu.filer.client import FilerProxy
+    fid = client.upload_data(b"validate me")
+    client.download(fid)
+    FilerProxy(filer.url()).put("/scrape/f.txt", b"filer traffic")
+    for url in (master.url(), vs.server.url(),
+                filer.metrics_server.url()):
+        text = _scrape(url)
+        assert validate_exposition(text) == [], url
+
+
+def test_pusher_stop_joins_and_flushes(stack):
+    """stop() must join the push thread (bounded) and attempt one final
+    push so a short-lived process doesn't lose its last interval."""
+    from seaweedfs_tpu.cluster import rpc
+    received = []
+    gw = rpc.JsonHttpServer()
+    gw.prefix_route("POST", "/metrics/", lambda p, q, b: (
+        received.append(b), {"ok": True})[-1])
+    gw.start()
+    try:
+        reg = Registry()
+        counter = reg.counter("final_total", "x")
+        # Interval far beyond the test: the loop never fires on its
+        # own, so anything received comes from stop()'s final flush.
+        pusher = MetricsPusher(reg, gw.url(), job="j", instance="i",
+                               interval_seconds=3600.0)
+        pusher.start()
+        counter.inc(7)
+        pusher.stop()
+        assert not pusher._thread.is_alive()
+        assert received and b"final_total 7" in received[-1]
+    finally:
+        gw.stop()
+
+
+def test_pusher_stop_without_start():
+    """stop() before start() must not raise (no thread to join) — it
+    still attempts the final flush, which may fail harmlessly."""
+    reg = Registry()
+    pusher = MetricsPusher(reg, "http://127.0.0.1:9", job="j",
+                           instance="i")
+    pusher.stop()  # unreachable gateway: swallowed
+
+
 def test_benchmark_command(stack):
     """weed benchmark against the live stack (command/benchmark.go)."""
     from seaweedfs_tpu.command import COMMANDS, _load_all, parse_flags
-    master, _vs, _c = stack
+    master, _vs, _c, _f = stack
     _load_all()
     host = master.url().replace("http://", "")
     flags, rest = parse_flags(
@@ -148,7 +297,7 @@ def test_benchmark_cpu_accounting(stack):
     reference's multi-core req/s."""
     from seaweedfs_tpu.command.benchmark_cmd import run_benchmark
     from seaweedfs_tpu.command import parse_flags
-    master, _vs, _c = stack
+    master, _vs, _c, _f = stack
     host = master.url().replace("http://", "")
     flags, rest = parse_flags(
         [f"-master={host}", "-n=24", "-size=256", "-c=4", "-procs=1"])

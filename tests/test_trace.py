@@ -1,0 +1,349 @@
+"""Distributed tracing: traceparent propagation, span buffer, sampling,
+/debug/traces endpoint, shell commands, and the tier-1 smoke-check that
+one filer write produces one retrievable multi-span trace.
+
+Also covers the EC stage histograms (execution-fenced device timings
+from the Pallas coder feeding SeaweedFS_ec_stage_seconds on /metrics).
+"""
+
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.trace import tracer
+
+
+# -- traceparent codec ------------------------------------------------------
+
+def test_traceparent_roundtrip():
+    sp = tracer.Span("ab" * 16, "", "op", "svc", "server", True)
+    parsed = tracer.parse_traceparent(sp.traceparent())
+    assert parsed == ("ab" * 16, sp.span_id, True)
+
+
+@pytest.mark.parametrize("bad", [
+    "", "00-xyz", "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",
+    "00-" + "a" * 31 + "-" + "b" * 16 + "-01",
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",
+])
+def test_traceparent_malformed(bad):
+    assert tracer.parse_traceparent(bad) is None
+
+
+# -- buffer bounds ----------------------------------------------------------
+
+def test_buffer_evicts_oldest_trace():
+    buf = tracer.TraceBuffer(max_traces=4)
+    for i in range(6):
+        sp = tracer.Span(f"{i:032x}", "", "op", "svc", "server", True)
+        sp.duration = 0.001
+        buf.record(sp)
+    assert len(buf.summaries(0)) == 4
+    assert buf.dropped == 2
+    assert buf.get(f"{0:032x}") is None
+    assert buf.get(f"{5:032x}") is not None
+
+
+def test_buffer_caps_spans_per_trace():
+    buf = tracer.TraceBuffer(max_spans=3)
+    for _ in range(5):
+        buf.record(tracer.Span("c" * 32, "", "op", "svc", "server", True))
+    assert len(buf.get("c" * 32)) == 3
+
+
+# -- sampling + slow trigger ------------------------------------------------
+
+@pytest.fixture
+def trace_env():
+    saved = {k: os.environ.get(k) for k in
+             ("SEAWEEDFS_TPU_TRACE", "SEAWEEDFS_TPU_TRACE_SAMPLE",
+              "SEAWEEDFS_TPU_TRACE_SLOW_MS", "SEAWEEDFS_TPU_TRACES")}
+    tracer.BUFFER.clear()
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    tracer.BUFFER.clear()
+
+
+def test_unsampled_fast_request_not_recorded(trace_env):
+    os.environ["SEAWEEDFS_TPU_TRACE_SAMPLE"] = "0"
+    sp = tracer.begin_server_span("svc", "GET", "/x", "")
+    tracer.end_server_span(sp, 200)
+    assert tracer.BUFFER.get(sp.trace_id) is None
+
+
+def test_slow_request_recorded_despite_sampling(trace_env):
+    os.environ["SEAWEEDFS_TPU_TRACE_SAMPLE"] = "0"
+    os.environ["SEAWEEDFS_TPU_TRACE_SLOW_MS"] = "5"
+    sp = tracer.begin_server_span("svc", "GET", "/slow", "")
+    time.sleep(0.02)
+    tracer.end_server_span(sp, 200)
+    spans = tracer.BUFFER.get(sp.trace_id)
+    assert spans and spans[0]["name"] == "GET /slow"
+
+
+def test_disabled_records_nothing(trace_env):
+    os.environ["SEAWEEDFS_TPU_TRACE"] = "0"
+    assert tracer.begin_server_span("svc", "GET", "/x", "") is None
+    with tracer.span("child") as sp:
+        assert sp is tracer.NOOP
+
+
+def test_span_nesting_parent_links(trace_env):
+    root = tracer.begin_server_span("svc", "POST", "/f", "")
+    with tracer.span("outer") as outer:
+        with tracer.span("inner", k="v") as inner:
+            assert inner.trace_id == root.trace_id
+            assert inner.parent_id == outer.span_id
+        assert outer.parent_id == root.span_id
+    # propagated context parents the downstream server span
+    downstream = tracer.begin_server_span(
+        "svc2", "POST", "/g", root.traceparent())
+    assert downstream.trace_id == root.trace_id
+    assert downstream.parent_id == root.span_id
+    tracer.end_server_span(downstream, 200)
+    tracer.end_server_span(root, 200)
+    spans = tracer.BUFFER.get(root.trace_id)
+    assert {s["name"] for s in spans} == \
+        {"POST /f", "outer", "inner", "POST /g"}
+    inner_d = next(s for s in spans if s["name"] == "inner")
+    assert inner_d["attrs"] == {"k": "v"}
+
+
+def test_span_error_status(trace_env):
+    root = tracer.begin_server_span("svc", "GET", "/e", "")
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("nope")
+    tracer.end_server_span(root, 500)
+    spans = tracer.BUFFER.get(root.trace_id)
+    assert all(s["status"] == "error" for s in spans)
+
+
+# -- live stack smoke (tier-1 trace smoke-check) ----------------------------
+
+@pytest.fixture(scope="module")
+def traced_stack(tmp_path_factory):
+    """master + 2 volume servers (2-replica default) + filer, with the
+    /debug/traces endpoint enabled — env must be set BEFORE servers are
+    constructed, since the route mounts at construction (like pprof)."""
+    saved = os.environ.get("SEAWEEDFS_TPU_TRACES")
+    os.environ["SEAWEEDFS_TPU_TRACES"] = "1"
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.filer.server import FilerServer
+    tracer.BUFFER.clear()
+    tmp = tmp_path_factory.mktemp("trace-stack")
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp),
+                          default_replication="001")
+    master.start()
+    vs1 = VolumeServer(master.url(), [str(tmp / "v1")], pulse_seconds=60)
+    vs1.start()
+    vs2 = VolumeServer(master.url(), [str(tmp / "v2")], pulse_seconds=60)
+    vs2.start()
+    filer = FilerServer(master.url())
+    filer.start()
+    yield master, vs1, vs2, filer
+    filer.stop()
+    vs2.stop()
+    vs1.stop()
+    master.stop()
+    if saved is None:
+        os.environ.pop("SEAWEEDFS_TPU_TRACES", None)
+    else:
+        os.environ["SEAWEEDFS_TPU_TRACES"] = saved
+    tracer.BUFFER.clear()
+
+
+def _get_json(url: str) -> dict:
+    import json
+    with urllib.request.urlopen(url) as r:
+        return json.load(r)
+
+
+def test_filer_write_produces_multi_span_trace(traced_stack):
+    """Acceptance: a single filer write against a 2-replica volume
+    yields one trace with >= 4 spans across >= 2 services (filer server
+    span -> volume write span -> replica fan-out spans), consistent
+    trace id, resolvable parent links."""
+    _master, _v1, _v2, filer = traced_stack
+    tracer.BUFFER.clear()
+    from seaweedfs_tpu.filer.client import FilerProxy
+    FilerProxy(filer.url()).put("/traced/hello.txt", b"trace me" * 100)
+
+    out = _get_json(filer.url() + "/debug/traces")
+    roots = [t for t in out["traces"] if "filer" in t["services"]
+             and "POST /traced/hello.txt" in t["root"]]
+    assert roots, f"no filer write trace in {out['traces']}"
+    summary = roots[0]
+    detail = _get_json(
+        filer.url() + f"/debug/traces?trace={summary['trace_id']}")
+    spans = detail["spans"]
+    assert len(spans) >= 4
+    assert all(s["trace_id"] == summary["trace_id"] for s in spans)
+    services = {s["service"] for s in spans}
+    assert {"filer", "volumeServer"} <= services
+    # every non-root parent link resolves inside the trace
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        if s["parent_id"]:
+            assert s["parent_id"] in ids, s
+    # the replication fan-out is visible: a replicate span plus the
+    # replica's own server span (type=replicate POST)
+    names = [s["name"] for s in spans]
+    assert "volume.replicate" in names
+    assert "filer.write.chunks" in names
+    replicas = [s for s in spans if s["service"] == "volumeServer"
+                and s["name"].startswith("POST /")]
+    assert len(replicas) >= 2  # primary write + >=1 fan-out write
+
+
+def test_read_redirect_lookup_is_traced(traced_stack):
+    """A GET landing on the wrong volume server spans its master lookup
+    (volume.loc_lookup) before the 301."""
+    master, vs1, vs2, _filer = traced_stack
+    from seaweedfs_tpu.cluster import rpc
+    from seaweedfs_tpu.cluster.client import WeedClient
+    client = WeedClient(master.url())
+    fid = client.upload_data(b"single copy", replication="000")
+    locs = client.lookup(int(fid.split(",")[0]))
+    holder = locs[0]["url"]
+    other = vs2 if vs1.url() == holder else vs1
+    tracer.BUFFER.clear()
+    assert bytes(rpc.call(f"http://{other.url()}/{fid}")) \
+        == b"single copy"
+    names = [s["name"] for t in tracer.BUFFER.summaries(0)
+             for s in tracer.BUFFER.get(t["trace_id"])]
+    assert "volume.loc_lookup" in names
+
+
+def test_trace_shell_commands(traced_stack):
+    master, _v1, _v2, filer = traced_stack
+    from seaweedfs_tpu.filer.client import FilerProxy
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    FilerProxy(filer.url()).put("/traced/shell.txt", b"shell trace")
+    env = CommandEnv(master.url(), filer_url=filer.url())
+    try:
+        listing = run_command(env, "trace.ls")
+        assert "TRACE" in listing
+        line = next(ln for ln in listing.splitlines()[1:]
+                    if "/traced/shell.txt" in ln)
+        trace_id = line.split()[0]
+        tree = run_command(env, f"trace.get {trace_id}")
+        assert "filer" in tree and "volumeServer" in tree
+        assert "volume.replicate" in tree
+    finally:
+        env.close()
+
+
+def test_traces_endpoint_404_unknown_trace(traced_stack):
+    _m, _v1, _v2, filer = traced_stack
+    from seaweedfs_tpu.cluster import rpc
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(filer.url() + "/debug/traces?trace=" + "d" * 32)
+    assert ei.value.status == 404
+
+
+def test_traces_route_gated_like_pprof(tmp_path):
+    """Without SEAWEEDFS_TPU_TRACES the endpoint must not exist."""
+    saved = os.environ.pop("SEAWEEDFS_TPU_TRACES", None)
+    try:
+        from seaweedfs_tpu.cluster import rpc
+        from seaweedfs_tpu.cluster.master import MasterServer
+        m = MasterServer(volume_size_limit_mb=64,
+                         meta_dir=str(tmp_path))
+        m.start()
+        try:
+            with pytest.raises(rpc.RpcError) as ei:
+                rpc.call(m.url() + "/debug/traces")
+            assert ei.value.status == 404
+        finally:
+            m.stop()
+    finally:
+        if saved is not None:
+            os.environ["SEAWEEDFS_TPU_TRACES"] = saved
+
+
+def test_grpc_facade_extracts_traceparent(trace_env, tmp_path):
+    """The gRPC master facade bypasses the HTTP middleware, so it must
+    extract the traceparent metadata itself: an Assign made inside an
+    active span yields a master server span parented under it."""
+    pytest.importorskip("grpc")
+    # recording is consumer-gated; force it for this in-process reader
+    os.environ["SEAWEEDFS_TPU_TRACE"] = "1"
+    from seaweedfs_tpu.cluster.client import WeedClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.pb.master_grpc import MasterGrpcServer
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60)
+    vs.start()
+    # default port convention (http + 10000) — what use_grpc dials
+    g = MasterGrpcServer(master)
+    g.start()
+    client = WeedClient(master.url(), use_grpc=True)
+    try:
+        tracer.BUFFER.clear()
+        root = tracer.begin_server_span("test", "POST", "/entry", "")
+        client.assign()
+        tracer.end_server_span(root, 200)
+        spans = tracer.BUFFER.get(root.trace_id)
+        grpc_span = next(s for s in spans
+                         if s["name"] == "GRPC /master_pb.Seaweed/Assign")
+        assert grpc_span["service"] == "master"
+        assert grpc_span["parent_id"] == root.span_id
+    finally:
+        client.close()
+        g.stop()
+        vs.stop()
+        master.stop()
+
+
+def test_cli_trace_flags_set_env(trace_env):
+    """-debug.traces / -trace.sample / -trace.slowMs / -trace=false on
+    any server command map onto the tracer's env knobs."""
+    os.environ.pop("SEAWEEDFS_TPU_TRACES", None)
+    from seaweedfs_tpu.command import main
+    assert main(["version", "-debug.traces", "-trace.sample=0.25",
+                 "-trace.slowMs=100", "-trace=false"]) == 0
+    assert os.environ.get("SEAWEEDFS_TPU_TRACES") == "1"
+    assert os.environ.get("SEAWEEDFS_TPU_TRACE") == "0"
+    assert tracer.sample_rate() == 0.25
+    assert tracer.slow_threshold_seconds() == 0.1
+    assert not tracer.enabled()
+
+
+# -- EC stage histograms ----------------------------------------------------
+
+def test_ec_stage_histogram_records_fenced_device_time(traced_stack):
+    """An EC reconstruct run records execution-fenced device time into
+    the *_ec_stage_seconds histogram, visible on a volume server's
+    /metrics scrape."""
+    from seaweedfs_tpu.ops.coder_pallas import PallasCoder
+    coder = PallasCoder(interpret=True)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=(10, 4096), dtype=np.uint8)
+    shards = np.asarray(coder.encode_all(data))
+    present = {i: shards[i] for i in range(2, 14)}  # lose shards 0,1
+    rec = coder.reconstruct(present, wanted=[0, 1])
+    assert np.array_equal(np.asarray(rec[0]), shards[0])
+
+    _m, vs1, _v2, _f = traced_stack
+    with urllib.request.urlopen(
+            vs1.server.url() + "/metrics") as r:
+        text = r.read().decode()
+    assert "SeaweedFS_ec_stage_seconds" in text
+    assert 'stage="encode_kernel"' in text
+    assert 'stage="reconstruct_kernel"' in text
+    assert "SeaweedFS_ec_stage_bytes_total" in text
