@@ -11,7 +11,7 @@ import urllib.parse
 
 from ..core import types as t
 from ..trace import current_traceparent
-from . import rpc
+from . import resilience, rpc
 
 
 def _grpc_trace_metadata():
@@ -125,12 +125,16 @@ class WeedClient:
     (wdclient/masterclient.go tryAllMasters)."""
 
     def __init__(self, master_url: str | list[str],
-                 use_grpc: bool | None = None):
+                 use_grpc: bool | None = None,
+                 retry_policy: "resilience.RetryPolicy | None" = None):
         import os
         urls = master_url if isinstance(master_url, list) \
             else [master_url]
         self.masters = [u.rstrip("/") for u in urls]
         self._master_idx = 0
+        # Write-path policy: upload re-assigns to a fresh volume on
+        # failure, paced by this policy's backoff.
+        self.retry_policy = retry_policy or resilience.RetryPolicy()
         self._secured: bool | None = None  # learned from responses
         self.cache = VidCache()
         self._watch_stop: threading.Event | None = None
@@ -311,6 +315,14 @@ class WeedClient:
         Returns {fid, url, size, etag, is_compressed, cipher_key}.
         `size` is the logical (plaintext) size; cipher_key is b"" unless
         cipher was requested.
+
+        Write-path resilience: a failed PUT (dead/sick volume server)
+        does not surface the first dead server — the client re-assigns,
+        which hands it a FRESH volume/fid, and retries there after a
+        jittered backoff (retry_policy).  Re-sending to a new fid is
+        always safe: the non-idempotent body never replays against the
+        same needle, which is the transport's own no-resend rule lifted
+        to the application layer.
         """
         size = len(data)
         gzipped = False
@@ -324,25 +336,66 @@ class WeedClient:
         elif compress:
             from ..utils.compression import maybe_gzip
             data, gzipped = maybe_gzip(data, name, mime)
-        a = self.assign(collection=collection, replication=replication,
-                        ttl=ttl)
-        fid = a["fid"]
-        url = f"http://{a['url']}/{fid}"
-        q = []
-        if name and not cipher:
-            q.append("name=" + urllib.parse.quote(name))
-        if mime and not cipher:
-            q.append("mime=" + urllib.parse.quote(mime))
-        if a.get("auth"):  # master-minted write JWT (secured cluster)
-            q.append(f"jwt={a['auth']}")
-        if q:
-            url += "?" + "&".join(q)
-        resp = rpc.call(url, "POST", data,
-                        headers={"Content-Encoding": "gzip"}
-                        if gzipped else None)
-        etag = resp.get("eTag", "") if isinstance(resp, dict) else ""
-        return {"fid": fid, "url": a["url"], "size": size, "etag": etag,
-                "is_compressed": gzipped, "cipher_key": key}
+        policy = self.retry_policy
+        deadline = (time.monotonic() + policy.total_deadline
+                    if policy.total_deadline else None)
+        last_err: Exception | None = None
+        for attempt in range(policy.max_attempts):
+            if attempt:
+                resilience.rpc_retries_total.inc(reason="reassign")
+                delay = policy.backoff(attempt - 1)
+                if deadline is not None:
+                    delay = min(delay,
+                                max(0.0, deadline - time.monotonic()))
+                time.sleep(delay)
+            # Per-attempt timeout clipped to what remains of the total
+            # deadline budget: a sick server costs one bounded attempt,
+            # and the whole upload never overstays its budget.
+            timeout = policy.per_attempt_timeout
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                timeout = min(timeout, remaining)
+            try:
+                a = self.assign(collection=collection,
+                                replication=replication, ttl=ttl)
+            except (rpc.RpcError, OSError) as e:
+                last_err = e
+                continue
+            fid = a["fid"]
+            url = f"http://{a['url']}/{fid}"
+            q = []
+            if name and not cipher:
+                q.append("name=" + urllib.parse.quote(name))
+            if mime and not cipher:
+                q.append("mime=" + urllib.parse.quote(mime))
+            if a.get("auth"):  # master-minted write JWT (secured)
+                q.append(f"jwt={a['auth']}")
+            if q:
+                url += "?" + "&".join(q)
+            try:
+                resp = rpc.call(url, "POST", data, timeout=timeout,
+                                headers={"Content-Encoding": "gzip"}
+                                if gzipped else None)
+            except rpc.RpcError as e:
+                if e.status < 500:
+                    raise  # a definitive answer (auth, bad request)
+                # 5xx (failed replication fan-out, sick store): the
+                # volume is suspect — forget it and re-assign.
+                last_err = e
+                self.cache.forget(t.parse_file_id(fid)[0])
+                continue
+            except OSError as e:  # dead server: re-assign elsewhere
+                last_err = e
+                self.cache.forget(t.parse_file_id(fid)[0])
+                continue
+            etag = resp.get("eTag", "") if isinstance(resp, dict) \
+                else ""
+            return {"fid": fid, "url": a["url"], "size": size,
+                    "etag": etag, "is_compressed": gzipped,
+                    "cipher_key": key}
+        raise last_err or rpc.RpcError(503, "upload: no attempt ran")
 
     def download(self, fid: str, cipher_key: bytes = b"") -> bytes:
         """Fetch a needle; opens sealed blobs when the caller holds the
@@ -380,28 +433,45 @@ class WeedClient:
         raise last_err or rpc.RpcError(404, "not found")
 
     def delete(self, fid: str) -> None:
+        """Delete a needle, failing over across replicas exactly like
+        `_download_raw` does — any live replica fans the delete out to
+        its siblings, so the first dead server must not fail the op."""
         vid, _key, _cookie = t.parse_file_id(fid)
         locs = self.lookup(vid)
         if not locs:
             raise rpc.RpcError(404, f"volume {vid} has no locations")
-        url = f"http://{locs[0]['url']}/{fid}"
         # Secured cluster: fetch a delete token via lookup?fileId=
         # (operation/delete_content.go).  Once the master answers
         # without auth the cluster is known-unsecured and the extra
         # lookup is skipped.
+        jwt = ""
         if self._secured is not False:
             resp = self._master_call(
                 f"/dir/lookup?volumeId={vid}&fileId={fid}")
             auth = resp.get("auth", "")
             self._secured = bool(auth)
             if auth:
-                url += f"?jwt={auth}"
-        rpc.call(url, "DELETE")
+                jwt = f"?jwt={auth}"
+        last_err: Exception | None = None
+        for loc in locs:
+            url = f"http://{loc['url']}/{fid}{jwt}"
+            try:
+                rpc.call(url, "DELETE")
+                return
+            except rpc.RpcError as e:
+                last_err = e
+                if e.status == 404 and "volume" in e.message:
+                    self.cache.forget(vid)
+            except OSError as e:  # dead server: next replica
+                last_err = e
+                self.cache.forget(vid)
+        raise last_err or rpc.RpcError(404, "not found")
 
     def submit(self, data: bytes, **kw) -> dict:
-        """upload + return {fid, size, url} (operation/submit.go)."""
-        fid = self.upload_data(data, **kw)
-        vid, _, _ = t.parse_file_id(fid)
-        locs = self.lookup(vid)
-        return {"fid": fid, "size": len(data),
-                "url": locs[0]["url"] if locs else ""}
+        """upload + return its result dict (operation/submit.go):
+        {fid, size, url} plus etag/is_compressed/cipher_key.  Reuses
+        the url the upload already resolved — a transient lookup
+        failure must not fail a write that succeeded — and passes the
+        full dict through so a cipher=True submit never silently drops
+        the one copy of its cipher_key."""
+        return self.upload(data, **kw)

@@ -121,6 +121,8 @@ class MasterServer:
         enable_pprof_routes(s)
         from ..trace import setup_server_tracing
         setup_server_tracing(s, "master")
+        from ..fault.routes import setup_fault_routes
+        setup_fault_routes(s)
         s.route("POST", "/vol/grow", self._grow)
         s.route("POST", "/vol/vacuum", self._vacuum)
         s.route("GET", "/col/list", self._col_list)
@@ -297,7 +299,19 @@ class MasterServer:
         fwd["proxied"] = "1"
         qs = urllib.parse.urlencode(fwd)
         url = leader + path + (f"?{qs}" if qs else "")
-        return rpc.call(url, method, body if method != "GET" else None)
+        try:
+            return rpc.call(url, method,
+                            body if method != "GET" else None)
+        except OSError as e:
+            # A dead/unreachable leader hint (it was just killed; the
+            # election hasn't converged) is a RETRY-ELSEWHERE answer,
+            # not an internal error of THIS follower: surfacing it as a
+            # 500 would count toward this live follower's circuit
+            # breaker and let a failover window open breakers on every
+            # healthy master (clients hammer all seeds during one).
+            raise rpc.RpcError(
+                503, f"leader {leader} unreachable; retry: "
+                     f"{type(e).__name__}: {e}") from None
 
     # -- lifecycle ----------------------------------------------------------
 

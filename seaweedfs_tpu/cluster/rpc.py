@@ -32,7 +32,9 @@ import time
 import urllib.parse
 from typing import Callable
 
+from ..fault import registry as _fault
 from ..trace import tracer as _tracer
+from . import resilience as _res
 
 _REASONS = {200: "OK", 201: "Created", 204: "No Content",
             206: "Partial Content", 301: "Moved Permanently",
@@ -356,6 +358,13 @@ class JsonHttpServer:
             f"SeaweedFS_{subsystem}_request_seconds",
             f"{subsystem} request latency", ("type",))
         self.metrics = (reg, counter, hist)
+        # RPC-plane resilience instruments are process-global singletons
+        # (every role's outbound client shares the pool + breakers);
+        # registering them here puts retry counts, breaker states, and
+        # injected-fault counts on every role's /metrics scrape.
+        reg.register_once(_res.rpc_retries_total)
+        reg.register_once(_res.breaker_state_gauge)
+        reg.register_once(_fault.faults_injected_total)
         if serve_route:
             self.serve_metrics_route(reg)
         return reg
@@ -583,6 +592,12 @@ class JsonHttpServer:
                 headers.get("traceparent", ""))
         try:
             result = fn(*args)
+        except _fault.DropConnection:
+            # Injected mid-exchange disconnect (fault `drop` kind): no
+            # response bytes, just a dead connection — the client sees
+            # EOF exactly as if the process was killed.
+            _tracer.end_server_span(tspan, 500)
+            return False
         except RpcError as e:
             _tracer.end_server_span(tspan, e.status)
             if not self._finish_stream_body(body):
@@ -903,8 +918,27 @@ class _ConnPool:
         case its comment forbids.  A Python timeout raises
         socket.timeout, which takes the no-retry path.  The timeout is
         only re-armed when it differs from the connection's last one
-        (a setsockopt saved per pooled reuse)."""
+        (a setsockopt saved per pooled reuse).
+
+        Per-host circuit breaker: an open breaker fails the acquire
+        fast (BreakerOpen, before any socket work — even pooled reuse,
+        whose idle conns likely predate the partition that opened it);
+        connect failures feed it, and _request records the 5xx/success
+        outcomes.  The rpc.connect fault point fires on every acquire —
+        pooled or fresh — so an armed fault behaves like the host being
+        unreachable, not like a pool-state lottery."""
         key = (scheme, host, port)
+        hostport = f"{host}:{port}"
+        breaker = _res.breaker_for(hostport)
+        if not breaker.allow():
+            raise _res.BreakerOpen(
+                f"{hostport}: circuit breaker open")
+        if _fault.ARMED:
+            try:
+                _fault.hit("rpc.connect", host=hostport)
+            except Exception:
+                breaker.record_failure()
+                raise
         with self._lock:
             pool = self._idle.get(key)
             if pool:
@@ -917,12 +951,19 @@ class _ConnPool:
             # if a rotation lands during our handshake below, this
             # conn keeps the OLD gen and release() will drop it.
             ctx, gen = _client_ssl_context, self.gen
-        sock = socket.create_connection((host, port), timeout=timeout)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        if scheme == "https":
-            import ssl
-            ctx = ctx or ssl.create_default_context()
-            sock = ctx.wrap_socket(sock, server_hostname=host)
+        try:
+            sock = socket.create_connection((host, port),
+                                            timeout=timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if scheme == "https":
+                import ssl
+                ctx = ctx or ssl.create_default_context()
+                sock = ctx.wrap_socket(sock, server_hostname=host)
+        except OSError as e:
+            breaker.record_failure()
+            # No request bytes hit the wire: mark the failure as
+            # always-safe-to-retry for the RetryPolicy classifier.
+            raise _res.ConnectError(f"{hostport}: {e}") from e
         return _Conn(sock, key, gen, timeout), False
 
     def release(self, conn: _Conn) -> None:
@@ -997,7 +1038,14 @@ def _request(url: str, method: str, body, timeout: float,
     for attempt in (0, 1):
         conn, reused = _pool.acquire(scheme, host, port, timeout)
         try:
+            # Fault points fire INSIDE the retry loop's try: an armed
+            # `fail` surfaces as a peer reset and takes the exact
+            # stale-keep-alive path a real one would.
+            if _fault.ARMED:
+                _fault.hit("rpc.send", host=f"{host}:{port}", url=url)
             conn.sock.sendall(req)
+            if _fault.ARMED:
+                _fault.hit("rpc.recv", host=f"{host}:{port}", url=url)
             line = conn.rf.readline(65537)
             if not line:
                 raise ConnectionResetError("server closed connection")
@@ -1023,6 +1071,15 @@ def _request(url: str, method: str, body, timeout: float,
             status = int(parts[1])
             reason = parts[2] if len(parts) > 2 else ""
             headers = _read_headers(conn.rf)
+        # Breaker bookkeeping: a 5xx answer (other than 503 — a live
+        # server redirecting load, e.g. a follower master, is not a
+        # sick one) counts toward opening the host's breaker; anything
+        # else closes it.
+        breaker = _res.breaker_for(f"{host}:{port}")
+        if status >= 500 and status != 503:
+            breaker.record_failure()
+        else:
+            breaker.record_success()
         resp = _Resp(status, reason, headers, conn.rf)
         if status in (301, 302, 307, 308) and max_redirects > 0:
             location = resp.getheader("location")

@@ -31,6 +31,7 @@ from ..ec.encoder import rebuild_ec_files, write_ec_files, \
     write_sorted_file_from_idx
 from ..ec.shard_bits import ShardBits
 from ..ec.volume import EcVolume, NeedleNotFound
+from ..fault import registry as _fault
 from ..stats.metrics import observe_ec_stage
 from ..storage.store import Store
 from ..storage.vacuum import vacuum as vacuum_volume
@@ -100,6 +101,8 @@ class VolumeServer:
         enable_pprof_routes(s)
         from ..trace import setup_server_tracing
         setup_server_tracing(s, "volumeServer")
+        from ..fault.routes import setup_fault_routes
+        setup_fault_routes(s)
         s.route("POST", "/admin/assign_volume", self._admin_assign_volume)
         s.route("POST", "/admin/delete_volume", self._admin_delete_volume)
         s.route("POST", "/admin/readonly", self._admin_readonly)
@@ -258,6 +261,9 @@ class VolumeServer:
                     hb["deleted_volumes"] = [vinfo_to_dict(v)
                                              for v in deleted]
         try:
+            if _fault.ARMED:
+                _fault.hit("master.heartbeat", master=self.master_url,
+                           server=self.url())
             out = rpc.call(f"{self.master_url}/heartbeat", "POST",
                            json.dumps(hb).encode())
             if isinstance(out, dict) and out.get("is_leader") is False:
@@ -412,6 +418,8 @@ class VolumeServer:
 
     def _get_needle(self, path: str, query: dict, body: bytes):
         vid, key, cookie = self._parse_fid_path(path)
+        if _fault.ARMED:
+            _fault.hit("volume.read", vid=vid, server=self.url())
         v = self.store.find_volume(vid)
         if v is None:
             ev = self.ec_volumes.get(vid)
@@ -764,6 +772,9 @@ class VolumeServer:
             if url == me:
                 continue
             try:
+                if _fault.ARMED:
+                    _fault.hit("ec.fetch_shard", holder=url,
+                               vid=ev.vid, shard=sid)
                 data = rpc.call(
                     f"http://{url}/admin/ec/shard_read?volume={ev.vid}"
                     f"&shard={sid}&offset={off}&size={size}",
@@ -827,6 +838,8 @@ class VolumeServer:
     def _post_needle(self, path: str, query: dict, body: bytes) -> dict:
         self._check_write_jwt(path, query)
         vid, key, cookie = self._parse_fid_path(path)
+        if _fault.ARMED:
+            _fault.hit("volume.write", vid=vid, server=self.url())
         v = self.store.find_volume(vid)
         if v is None:
             raise rpc.RpcError(404, f"volume {vid} not on this server")
@@ -849,6 +862,11 @@ class VolumeServer:
         if "mime" in query:
             n.set_mime(query["mime"].encode())
         n.set_last_modified(int(time.time()))
+        # Rollback applies only to a BRAND-NEW needle: for an overwrite
+        # of an existing fid, deleting would tombstone the prior
+        # committed version everywhere — turning a failed update into
+        # data loss.  (Lock-free peek, same as the read path's.)
+        existed = v.nm.get(key) is not None
         # Like store_replicate.go:37-44: writes hit the OS page cache
         # only, unless the request opts into durability with
         # ?fsync=true (the flag is forwarded to replicas in _replicate
@@ -856,7 +874,21 @@ class VolumeServer:
         _offset, size = self.store.write_needle(
             vid, n, fsync=query.get("fsync") == "true")
         if query.get("type") != "replicate":
-            self._replicate(path, query, body, "POST", vid=vid, v=v)
+            try:
+                self._replicate(path, query, body, "POST", vid=vid,
+                                v=v, undo_new=not existed)
+            except Exception:
+                # All-or-fail means ALL-or-fail: a partial fan-out must
+                # not leak the locally-committed copy (the client was
+                # told the write failed and will re-assign; an orphan
+                # here would survive as an unowned needle).  _replicate
+                # already undid the siblings that succeeded.
+                if not existed:
+                    try:
+                        self.store.delete_needle(vid, key)
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+                raise
         return {"size": len(body), "eTag": f"{n.checksum:08x}"}
 
     def _delete_needle(self, path: str, query: dict, body: bytes) -> dict:
@@ -871,10 +903,14 @@ class VolumeServer:
         return {"size": freed}
 
     def _replicate(self, path: str, query: dict, body: bytes,
-                   method: str, vid: int | None = None, v=None) -> None:
+                   method: str, vid: int | None = None, v=None,
+                   undo_new: bool = False) -> None:
         """Fan out to sibling replicas (all-or-fail, store_replicate.go).
         Callers that already resolved the fid/volume pass them in so the
-        single-copy fast path costs no extra parse or lookup."""
+        single-copy fast path costs no extra parse or lookup.
+        undo_new=True (a POST of a needle that did not exist before)
+        deletes the copies that DID land when the fan-out partially
+        fails, so a failed write leaves zero orphans."""
         if vid is None:
             vid = self._parse_fid_path(path)[0]
             v = self.store.find_volume(vid)
@@ -890,6 +926,7 @@ class VolumeServer:
         except Exception:  # noqa: BLE001 — master unreachable: the local
             return         # write stands; repair catches divergence later
         errors = []
+        ok_urls = []
         threads = []
         me = self.url()
         # Preserve the original query (name/mime/...) so replica needle
@@ -916,8 +953,12 @@ class VolumeServer:
 
             def send(url):
                 try:
+                    if _fault.ARMED:
+                        _fault.hit("volume.replicate", replica=url,
+                                   vid=vid)
                     rpc.call(f"http://{url}{path}?{qs}", method, body,
                              headers=send_hdrs or None)
+                    ok_urls.append(url)
                 except Exception as e:  # noqa: BLE001
                     errors.append(f"{url}: {e}")
 
@@ -934,6 +975,20 @@ class VolumeServer:
                 # A cached location just failed: evict so the next write
                 # re-resolves immediately instead of failing for the TTL.
                 self._vol_loc_cache.pop(vid, None)
+                if method == "POST" and ok_urls and undo_new:
+                    # Partial fan-out of a NEW needle: undo the sibling
+                    # copies that DID land, so an all-or-fail failure
+                    # leaves zero orphaned needles anywhere (the caller
+                    # undoes the local copy).  Best effort — a sibling
+                    # that just took the write is alive enough to take
+                    # the delete.  Overwrites are never undone: a
+                    # delete would tombstone the prior version.
+                    for url in ok_urls:
+                        try:
+                            rpc.call(f"http://{url}{path}?{qs}",
+                                     "DELETE")
+                        except Exception:  # noqa: BLE001
+                            pass
                 raise rpc.RpcError(500, "replication failed: " +
                                    "; ".join(errors))
 

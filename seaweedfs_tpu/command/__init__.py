@@ -145,6 +145,27 @@ def main(argv: list[str] | None = None) -> int:
         os.environ["SEAWEEDFS_TPU_TRACE_SAMPLE"] = flags.get("trace.sample")
     if flags.get("trace.slowMs"):
         os.environ["SEAWEEDFS_TPU_TRACE_SLOW_MS"] = flags.get("trace.slowMs")
+    # Fault-injection / resilience knobs (fault/registry.py and
+    # cluster/resilience.py read these env vars when the first server
+    # constructs — after this block):  -faults "point=spec;..." arms
+    # fault points at boot AND mounts /debug/faults; -debug.faults
+    # mounts the endpoint unarmed (runtime arming via fault.set);
+    # -faults.seed replays a probabilistic chaos run;
+    # -breaker.threshold / -breaker.cooldown tune the per-host circuit
+    # breaker in the rpc client pool (threshold 0 disables it).
+    if flags.get("faults"):
+        os.environ["SEAWEEDFS_TPU_FAULTS"] = flags.get("faults")
+    elif flags.get_bool("debug.faults", False):
+        os.environ["SEAWEEDFS_TPU_FAULTS"] = ""
+    if flags.get("faults.seed"):
+        os.environ["SEAWEEDFS_TPU_FAULTS_SEED"] = \
+            flags.get("faults.seed")
+    if flags.get("breaker.threshold"):
+        os.environ["SEAWEEDFS_TPU_BREAKER_THRESHOLD"] = \
+            flags.get("breaker.threshold")
+    if flags.get("breaker.cooldown"):
+        os.environ["SEAWEEDFS_TPU_BREAKER_COOLDOWN"] = \
+            flags.get("breaker.cooldown")
     # Every cluster-dialing command — servers AND clients (upload,
     # shell, mount, …) — goes through the TLS plane when security.toml
     # configures [grpc.client], matching the reference where each

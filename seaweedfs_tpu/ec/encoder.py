@@ -144,13 +144,22 @@ def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
                 # dies (device failure, ENOSPC) while this thread is
                 # blocked on a full queue, a plain q.put would deadlock
                 # the final join forever.
+                delivered = False
                 while not cancelled.is_set():
                     try:
                         q.put(data, timeout=0.2)
+                        delivered = True
                         break
                     except queue.Full:
                         continue
-                if cancelled.is_set():
+                if not delivered:
+                    # The chunk never reached the consumer.  Normally
+                    # the consumer cancelled because it already has its
+                    # own exception in flight (which wins below); if it
+                    # somehow finishes "cleanly", this error surfaces
+                    # instead of silently truncated shard files.
+                    error.append(RuntimeError(
+                        "ec encode cancelled with a chunk undelivered"))
                     return
         except BaseException as e:  # noqa: BLE001 — surfaced below
             error.append(e)

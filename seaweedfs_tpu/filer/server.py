@@ -144,6 +144,8 @@ class FilerServer:
         enable_pprof_routes(s)
         from ..trace import setup_server_tracing
         setup_server_tracing(s, "filer")
+        from ..fault.routes import setup_fault_routes
+        setup_fault_routes(s)
         # Master proxies: mounts and other filer-only clients assign
         # file ids and resolve volumes through the filer (the filer
         # gRPC AssignVolume/LookupVolume surface, filer.proto:30-33).

@@ -29,6 +29,7 @@ import time
 import numpy as np
 
 from ..cluster import rpc
+from ..fault import registry as _fault
 from ..stats.metrics import observe_ec_stage
 from ..ec import (DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
                   TOTAL_SHARDS, to_ext)
@@ -99,6 +100,8 @@ def _fetch_volume(tmpdir: str, vid: int, locs: list[str]) -> str:
     errors = []
     for url in locs:
         try:
+            if _fault.ARMED:
+                _fault.hit("ec.fetch_shard", holder=url, vid=vid)
             rpc.call_to_file(
                 f"http://{url}/admin/volume_file?volume={vid}&ext=.idx",
                 base + ".idx")
@@ -198,10 +201,7 @@ def _encode_batch_group(env, mesh, pool, batch, chunk_size,
                         payload = f.read()
                     scattered += len(payload)
                     futs.append(pool.submit(
-                        rpc.call,
-                        f"http://{url}/admin/ec/receive_shard?"
-                        f"volume={vid}&shard={sid}", "POST", payload,
-                        600.0))
+                        _scatter_shard, url, vid, sid, payload))
             for f in futs:
                 f.result()
             observe_ec_stage("batch_scatter",
@@ -222,6 +222,15 @@ def _encode_batch_group(env, mesh, pool, batch, chunk_size,
             if progress:
                 progress(line)
     return out
+
+
+def _scatter_shard(url: str, vid: int, sid: int,
+                   payload: bytes) -> None:
+    """Push one encoded shard to its placement target."""
+    if _fault.ARMED:
+        _fault.hit("ec.scatter", target=url, vid=vid, shard=sid)
+    rpc.call(f"http://{url}/admin/ec/receive_shard?"
+             f"volume={vid}&shard={sid}", "POST", payload, 600.0)
 
 
 class _ShardWriter:

@@ -20,6 +20,7 @@ Shell entry point: `ec.rebuild -batch` (shell/command_ec.py).
 from __future__ import annotations
 
 import concurrent.futures
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -27,11 +28,23 @@ import numpy as np
 from ..cluster import rpc
 from ..ec import DATA_SHARDS, TOTAL_SHARDS
 from ..ec.shard_bits import ShardBits
+from ..fault import registry as _fault
+from ..utils import env_float as _env_float
 from .sharded_codec import batched_reconstruct
 
 # Column padding granularity: keeps the jitted matmul's N divisible by
 # the mesh col axis and lane-aligned (128 lanes) for any mesh <= 16 wide.
 _COL_ALIGN = 2048
+
+
+# Shard-fetch budgets: each holder attempt gets a bounded slice of a
+# total per-shard deadline, so one dead holder costs one attempt
+# timeout — never a 600s hang that stalls the whole batch (the old
+# behavior: a single all-purpose 600s timeout per call).
+FETCH_ATTEMPT_TIMEOUT = _env_float(
+    "SEAWEEDFS_TPU_EC_FETCH_TIMEOUT", 30.0)
+FETCH_TOTAL_DEADLINE = _env_float(
+    "SEAWEEDFS_TPU_EC_FETCH_DEADLINE", 180.0)
 
 
 def make_mesh(devices=None):
@@ -80,21 +93,41 @@ def plan_rebuilds(env, vids=None) -> RebuildPlan:
     return plan
 
 
-def _fetch_shard(holders: list[str], vid: int, sid: int) -> bytes:
+def _fetch_shard(holders: list[str], vid: int, sid: int,
+                 attempt_timeout: float | None = None,
+                 total_deadline: float | None = None) -> bytes:
     """Fetch one shard, failing over across EVERY holder of it (the
     reference read path walks all sourceDataNodes,
     store_ec.go:264-320) with a second retry round for transient
-    errors — one flaky node must not fail a whole batch."""
+    errors — one flaky node must not fail a whole batch.
+
+    Every holder attempt runs under `attempt_timeout`, and all attempts
+    together under `total_deadline`: a dead holder costs one bounded
+    attempt before failover, and a shard with only dead holders fails
+    the batch within the deadline instead of hanging it."""
+    attempt_timeout = attempt_timeout or FETCH_ATTEMPT_TIMEOUT
+    total_deadline = total_deadline or FETCH_TOTAL_DEADLINE
+    deadline = time.monotonic() + total_deadline
     errors: list[str] = []
     permanent: set[str] = set()
     for attempt in range(2):
         for url in holders:
             if url in permanent:
                 continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                errors.append(f"deadline {total_deadline:g}s exhausted")
+                raise rpc.RpcError(
+                    502, f"shard {vid}.{sid} unreachable within "
+                         f"deadline: " + "; ".join(errors[:6]))
             try:
+                if _fault.ARMED:
+                    _fault.hit("ec.fetch_shard", holder=url, vid=vid,
+                               shard=sid)
                 data = rpc.call(
                     f"http://{url}/admin/ec/shard_file?volume={vid}"
-                    f"&shard={sid}", timeout=600.0)
+                    f"&shard={sid}",
+                    timeout=min(attempt_timeout, remaining))
                 if not isinstance(data, (bytes, bytearray)):
                     raise rpc.RpcError(
                         410, f"shard {vid}.{sid}: non-binary reply")
@@ -238,6 +271,9 @@ def _push_shard(vid: int, sid: int, payload: bytes, target: str,
     errors: list[str] = []
     for src in sources:
         try:
+            if _fault.ARMED:
+                _fault.hit("ec.scatter", target=target, vid=vid,
+                           shard=sid)
             rpc.call(
                 f"http://{target}/admin/ec/receive_shard?volume={vid}"
                 f"&shard={sid}&ecx_source={src}",

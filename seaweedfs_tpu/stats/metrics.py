@@ -235,6 +235,15 @@ class Registry:
             self._metrics.append(metric)
         return metric
 
+    def register_once(self, metric: _Metric):
+        """register() for shared singletons: a second registration into
+        the same registry is a no-op instead of a duplicate exposition
+        block (which would fail promcheck)."""
+        with self._lock:
+            if metric not in self._metrics:
+                self._metrics.append(metric)
+        return metric
+
     def counter(self, name: str, help_: str,
                 label_names: tuple[str, ...] = ()) -> Counter:
         return self.register(Counter(name, help_, label_names))

@@ -50,16 +50,11 @@ import time
 from collections import OrderedDict
 from contextlib import contextmanager
 
+from ..utils import env_float as _env_float
+
 TRACEPARENT_HEADER = "traceparent"
 
 _local = threading.local()
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
 
 
 def enabled() -> bool:

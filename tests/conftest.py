@@ -11,8 +11,25 @@ on the real chip.
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from seaweedfs_tpu.utils.jaxenv import force_cpu  # noqa: E402
 
 force_cpu(device_count=8)
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_resilience_state():
+    """Per-host circuit breakers are process-global and keyed by
+    host:port; free_port() can re-issue a port a previous test drove
+    into the open state.  Start every test with clean breakers (and
+    leave no armed fault points behind) so failure-handling tests stay
+    order-independent."""
+    from seaweedfs_tpu import fault
+    from seaweedfs_tpu.cluster import resilience
+    resilience.reset_breakers()
+    yield
+    fault.disarm_all()
+    resilience.reset_breakers()

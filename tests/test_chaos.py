@@ -1,0 +1,310 @@
+"""Chaos scenarios: writes/reads/rebuilds in flight while fault points
+are armed.  Fast and deterministic (tier-1): every failure is injected
+through seaweedfs_tpu.fault, never by killing processes or sleeping
+out real timeouts."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu import fault
+from seaweedfs_tpu.cluster import resilience, rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.parallel import cluster_rebuild
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fault.disarm_all()
+    resilience.reset_breakers()
+    yield
+    fault.disarm_all()
+    resilience.reset_breakers()
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """master + 3 volume servers, all one rack so 00x replication can
+    place every copy."""
+    tmp = tmp_path_factory.mktemp("chaos")
+    master = MasterServer(volume_size_limit_mb=16, meta_dir=str(tmp))
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)],
+                          max_volume_counts=[50], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+# -- upload during replica death: the re-assign path -------------------------
+
+def test_upload_survives_connect_failures_via_reassign(tmp_path):
+    """Acceptance: with rpc.connect armed fail-twice against the only
+    volume server, WeedClient.upload still succeeds — each failed PUT
+    re-assigns (fresh volume/fid) after a jittered backoff."""
+    master = MasterServer(volume_size_limit_mb=16,
+                          meta_dir=str(tmp_path))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60)
+    vs.start()
+    try:
+        client = WeedClient(master.url())
+        client.retry_policy = resilience.RetryPolicy(
+            max_attempts=3, base_delay=0.01, max_delay=0.05)
+        # Pre-grow the volumes: otherwise the master's own allocation
+        # RPCs to the volume server (also riding the faultable client
+        # pool) would consume the two armed failures before the
+        # client's PUT ever dials.
+        client.upload_data(b"warm")
+        before = resilience.rpc_retries_total.value(reason="reassign")
+        fault.arm("rpc.connect", f"fail*2~{vs.url()}")
+        out = client.upload(b"survives the chaos")
+        assert client.download(out["fid"]) == b"survives the chaos"
+        after = resilience.rpc_retries_total.value(reason="reassign")
+        assert after == before + 2   # two failed attempts, two backoffs
+        assert not fault.ARMED       # fail*2 exhausted
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_upload_reassigns_past_failed_replication(cluster):
+    """A 500 from a failed fan-out is a write failure like any other:
+    the client re-assigns and the next attempt lands."""
+    _master, _servers = cluster
+    client = WeedClient(_master.url())
+    client.retry_policy = resilience.RetryPolicy(
+        max_attempts=3, base_delay=0.01, max_delay=0.05)
+    fault.arm("volume.replicate", "fail*1")
+    out = client.upload(b"replicated payload", replication="001")
+    assert client.download(out["fid"]) == b"replicated payload"
+
+
+# -- read during partition: breaker + failover -------------------------------
+
+def test_read_failover_and_breaker_during_partition(cluster):
+    """One replica partitioned away (every dial to it fails): reads
+    fail over to the healthy replica; after K consecutive failures the
+    victim's breaker opens and reads stop paying the dial at all."""
+    master, _servers = cluster
+    client = WeedClient(master.url())
+    fid = client.upload_data(b"partition me", replication="001")
+    vid = int(fid.split(",")[0])
+    locs = client.lookup(vid)
+    assert len(locs) == 2
+    victim = locs[0]["url"]
+    fault.arm("rpc.connect", f"fail*100~{victim}")
+    # Every read succeeds throughout the partition (failover), and the
+    # victim's breaker accumulates its consecutive connect failures.
+    for _ in range(2 * resilience.BREAKER_THRESHOLD + 2):
+        assert client.download(fid) == b"partition me"
+    b = resilience.breaker_for(victim)
+    assert b.state == "open"
+    # Open breaker = fail fast: reads keep succeeding but no longer
+    # consume fault hits on the victim (BreakerOpen fires before the
+    # dial is even attempted).
+    spec = fault.ARMED["rpc.connect"]
+    triggered_when_open = spec.triggered
+    for _ in range(6):
+        assert client.download(fid) == b"partition me"
+    assert spec.triggered == triggered_when_open
+    # Partition heals: after the cooldown the half-open probe closes
+    # the breaker and the victim serves again.
+    fault.disarm_all()
+    b.cooldown = 0.05
+    time.sleep(0.06)
+    assert bytes(rpc.call(f"http://{victim}/{fid}")) == b"partition me"
+    assert b.state == "closed"
+
+
+# -- master failover mid-assign ----------------------------------------------
+
+def test_master_failover_mid_assign(cluster):
+    """An assign that dies on the wire rotates to the next master seed
+    and completes — the client never surfaces the first dead master."""
+    master, _servers = cluster
+    hostport = master.url().split("://")[-1]
+    client = WeedClient([master.url(), master.url()])
+    fault.arm("rpc.connect", f"fail*1~{hostport}")
+    a = client.assign()
+    assert a["fid"]
+    assert fault.ARMED == {}  # the one injected failure was consumed
+
+
+# -- rebuild with a dead shard holder ----------------------------------------
+
+def test_rebuild_fetch_fails_over_past_dead_holder():
+    """A shard fetch walks every holder: the first one 'dead' (armed
+    fault), the second healthy — the batch must not notice."""
+    dead = rpc.JsonHttpServer()
+    dead.route("GET", "/admin/ec/shard_file", lambda q, b: b"\x01" * 32)
+    dead.start()
+    live = rpc.JsonHttpServer()
+    live.route("GET", "/admin/ec/shard_file", lambda q, b: b"\x01" * 32)
+    live.start()
+    try:
+        dead_hp = f"127.0.0.1:{dead.port}"
+        live_hp = f"127.0.0.1:{live.port}"
+        fault.arm("ec.fetch_shard", f"fail*10~{dead_hp}")
+        data = cluster_rebuild._fetch_shard(
+            [dead_hp, live_hp], 3, 1,
+            attempt_timeout=5.0, total_deadline=10.0)
+        assert data == b"\x01" * 32
+        assert fault.ARMED["ec.fetch_shard"].triggered >= 1
+    finally:
+        dead.stop()
+        live.stop()
+
+
+def test_rebuild_fetch_bounded_deadline_on_hung_holder():
+    """A holder that accepts the connection and then hangs costs one
+    per-attempt timeout per round under a total deadline — never the
+    old one-600s-hang-per-dead-holder behavior."""
+    hung = rpc.JsonHttpServer()
+    hung.route("GET", "/admin/ec/shard_file",
+               lambda q, b: time.sleep(30) or b"late")
+    hung.start()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(rpc.RpcError) as ei:
+            cluster_rebuild._fetch_shard(
+                [f"127.0.0.1:{hung.port}"], 3, 1,
+                attempt_timeout=0.3, total_deadline=0.5)
+        elapsed = time.monotonic() - t0
+        assert ei.value.status == 502
+        assert elapsed < 5.0
+    finally:
+        hung.stop()
+
+
+# -- partial replication leaves zero orphans ---------------------------------
+
+def _get_status(url: str, fid: str) -> int:
+    try:
+        rpc.call(f"http://{url}/{fid}")
+        return 200
+    except rpc.RpcError as e:
+        return e.status
+
+
+def test_partial_replication_rolls_back_local_commit(cluster):
+    """Acceptance: a failed all-or-fail fan-out deletes the
+    locally-committed needle — the 500 the client sees is the whole
+    truth, with no orphan left on the primary."""
+    master, _servers = cluster
+    client = WeedClient(master.url())
+    a = client.assign(replication="001")
+    fid = a["fid"]
+    vid = int(fid.split(",")[0])
+    fault.arm("volume.replicate", "fail*1")
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{a['url']}/{fid}", "POST", b"half-landed")
+    assert ei.value.status == 500
+    assert "replication failed" in ei.value.message
+    # Zero orphaned needles anywhere: the primary rolled back its
+    # commit, the sibling never stored it (its redirect answers are
+    # fine — only a 200 would be an orphan).
+    for loc in client.lookup(vid):
+        assert _get_status(loc["url"], fid) != 200
+    # Disarmed, the same fid writes cleanly everywhere.
+    rpc.call(f"http://{a['url']}/{fid}", "POST", b"landed")
+    for loc in client.lookup(vid):
+        assert bytes(rpc.call(f"http://{loc['url']}/{fid}")) == \
+            b"landed"
+
+
+def test_partial_replication_undoes_committed_siblings(cluster):
+    """Three copies, the LAST sibling fails: the sibling that already
+    committed gets its copy deleted too — zero orphans on every
+    surviving replica."""
+    master, servers = cluster
+    client = WeedClient(master.url())
+    a = client.assign(replication="002")
+    fid = a["fid"]
+    vid = int(fid.split(",")[0])
+    locs = client.lookup(vid)
+    assert len(locs) == 3
+    siblings = [l["url"] for l in locs if l["url"] != a["url"]]
+    # Fail the fan-out to exactly one sibling; the other commits first
+    # and must then be rolled back.
+    fault.arm("volume.replicate", f"fail*1~{siblings[-1]}")
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{a['url']}/{fid}", "POST", b"three-way")
+    assert ei.value.status == 500
+    for url in (a["url"], *siblings):
+        assert _get_status(url, fid) != 200, f"orphan left on {url}"
+
+
+def test_failed_overwrite_never_tombstones_prior_version(cluster):
+    """Rollback-by-delete applies only to brand-new needles: when the
+    failed fan-out was an OVERWRITE of an existing fid, deleting would
+    destroy the previous committed version everywhere."""
+    master, _servers = cluster
+    client = WeedClient(master.url())
+    a = client.assign(replication="001")
+    fid = a["fid"]
+    rpc.call(f"http://{a['url']}/{fid}", "POST", b"version-1")
+    fault.arm("volume.replicate", "fail*1")
+    with pytest.raises(rpc.RpcError):
+        rpc.call(f"http://{a['url']}/{fid}", "POST", b"version-2")
+    # The fid must still resolve — a failed update is not a delete.
+    out = bytes(rpc.call(f"http://{a['url']}/{fid}"))
+    assert out in (b"version-1", b"version-2")
+
+
+def test_submit_preserves_cipher_key(cluster):
+    """submit() passes upload's full result through: a cipher=True
+    submit must hand back the one copy of the cipher key."""
+    master, _servers = cluster
+    client = WeedClient(master.url())
+    out = client.submit(b"sealed payload", cipher=True)
+    assert out["cipher_key"]
+    assert client.download(out["fid"],
+                           cipher_key=out["cipher_key"]) == \
+        b"sealed payload"
+
+
+# -- reproducible chaos ------------------------------------------------------
+
+def test_probabilistic_chaos_replays_from_seed(monkeypatch, tmp_path):
+    """A @prob chaos run is a pure function of its seed: the same seed
+    produces the same injected-failure sequence against live traffic."""
+    master = MasterServer(volume_size_limit_mb=16,
+                          meta_dir=str(tmp_path))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60)
+    vs.start()
+    try:
+        client = WeedClient(master.url())
+        fid = client.upload_data(b"seeded chaos")
+        url = client.lookup(int(fid.split(",")[0]))[0]["url"]
+
+        def run(seed: str) -> list[int]:
+            monkeypatch.setenv("SEAWEEDFS_TPU_FAULTS_SEED", seed)
+            fault.arm("volume.read", "status:503@0.5")
+            out = []
+            for _ in range(24):
+                out.append(_get_status(url, fid))
+            fault.disarm_all()
+            return out
+
+        a, b, c = run("7"), run("7"), run("8")
+        assert a == b
+        assert set(a) == {200, 503}
+        assert a != c
+    finally:
+        vs.stop()
+        master.stop()

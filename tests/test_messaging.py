@@ -120,19 +120,22 @@ def test_two_brokers_placement_and_redirect(stack):
     b2.start()
     try:
         c = MessagingClient(b1.url())
-        c.configure_topic("multi", "t", partition_count=8)
+        # 32 partitions: with 8, consistent hashing over two
+        # random-port broker urls lands ALL partitions on one broker
+        # ~0.8% of runs — an inherent flake, not a placement bug.
+        c.configure_topic("multi", "t", partition_count=32)
         # Both brokers agree on placement for every partition.
-        for p in range(8):
+        for p in range(32):
             o1 = b1._owner_of("multi", "t", p)
             o2 = b2._owner_of("multi", "t", p)
             assert o1 == o2
-        owners = {b1._owner_of("multi", "t", p) for p in range(8)}
+        owners = {b1._owner_of("multi", "t", p) for p in range(32)}
         assert owners == {b1.url(), b2.url()}  # spread over both
         # Publishing through the "wrong" broker redirects transparently.
         for i in range(16):
             c.publish("multi", "t", f"m{i}", key=f"k{i}")
         total = 0
-        for p in range(8):
+        for p in range(32):
             total += len(c.fetch("multi", "t", p)["messages"])
         assert total == 16
         # find_broker agrees with where messages actually landed.

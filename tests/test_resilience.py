@@ -1,0 +1,249 @@
+"""Unified RPC resilience policy: RetryPolicy classification/backoff
+and the per-host circuit breaker, plus their wiring into the rpc
+client pool and /metrics."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import resilience, rpc
+from seaweedfs_tpu.cluster.resilience import (BreakerOpen, CircuitBreaker,
+                                              ConnectError, RetryPolicy)
+
+
+@pytest.fixture(autouse=True)
+def _clean_breakers():
+    resilience.reset_breakers()
+    yield
+    resilience.reset_breakers()
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+def test_backoff_full_jitter_bounds():
+    p = RetryPolicy(base_delay=0.1, max_delay=1.0)
+    for attempt in range(8):
+        cap = min(1.0, 0.1 * 2 ** attempt)
+        for _ in range(20):
+            d = p.backoff(attempt)
+            assert 0.0 <= d <= cap
+
+
+def test_retries_connect_errors_even_non_idempotent():
+    calls = []
+
+    def fn(attempt, timeout):
+        calls.append(attempt)
+        if len(calls) < 3:
+            raise ConnectError("dial failed")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=3, base_delay=0.001, max_delay=0.002)
+    assert p.run(fn, idempotent=False) == "ok"
+    assert calls == [0, 1, 2]
+
+
+def test_non_idempotent_never_retries_after_send():
+    """Once bytes may have hit the wire (a plain ConnectionError), a
+    non-idempotent body must not be re-sent."""
+    calls = []
+
+    def fn(attempt, timeout):
+        calls.append(attempt)
+        raise ConnectionResetError("mid-exchange")
+
+    p = RetryPolicy(max_attempts=3, base_delay=0.001)
+    with pytest.raises(ConnectionResetError):
+        p.run(fn, idempotent=False)
+    assert calls == [0]
+    # The same failure IS retried when the call is idempotent.
+    calls.clear()
+    with pytest.raises(ConnectionResetError):
+        p.run(fn, idempotent=True)
+    assert calls == [0, 1, 2]
+
+
+def test_5xx_retried_only_when_idempotent():
+    calls = []
+
+    def fn(attempt, timeout):
+        calls.append(attempt)
+        raise rpc.RpcError(503, "unavailable")
+
+    p = RetryPolicy(max_attempts=2, base_delay=0.001)
+    with pytest.raises(rpc.RpcError):
+        p.run(fn, idempotent=False)
+    assert calls == [0]
+    calls.clear()
+    with pytest.raises(rpc.RpcError):
+        p.run(fn, idempotent=True)
+    assert calls == [0, 1]
+
+
+def test_4xx_never_retried():
+    calls = []
+
+    def fn(attempt, timeout):
+        calls.append(attempt)
+        raise rpc.RpcError(404, "not found")
+
+    with pytest.raises(rpc.RpcError):
+        RetryPolicy(max_attempts=3, base_delay=0.001).run(fn)
+    assert calls == [0]
+
+
+def test_total_deadline_bounds_attempts_and_timeout():
+    """Per-attempt timeout is clipped to what remains of the total
+    deadline, and the loop stops once the budget is spent."""
+    seen = []
+
+    def fn(attempt, timeout):
+        seen.append(timeout)
+        time.sleep(0.05)
+        raise ConnectError("down")
+
+    p = RetryPolicy(max_attempts=50, base_delay=0.0, max_delay=0.0,
+                    per_attempt_timeout=10.0, total_deadline=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectError):
+        p.run(fn)
+    assert time.monotonic() - t0 < 2.0
+    assert len(seen) < 50          # deadline cut the attempt loop
+    assert all(t <= 10.0 for t in seen)
+    assert seen[0] <= 0.2 + 0.01   # clipped to the remaining budget
+
+
+def test_retry_counter_increments():
+    before = resilience.rpc_retries_total.value(reason="connect")
+
+    def fn(attempt, timeout):
+        if attempt == 0:
+            raise ConnectError("dial")
+        return "ok"
+
+    RetryPolicy(max_attempts=2, base_delay=0.001).run(fn)
+    after = resilience.rpc_retries_total.value(reason="connect")
+    assert after == before + 1
+
+
+# -- CircuitBreaker ----------------------------------------------------------
+
+def test_breaker_opens_after_threshold_and_half_open_probe():
+    b = CircuitBreaker(threshold=3, cooldown=0.1)
+    assert b.state == "closed"
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()
+    time.sleep(0.12)
+    assert b.allow()               # the half-open probe
+    assert b.state == "half-open"
+    assert not b.allow()           # only ONE probe at a time
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+
+
+def test_breaker_half_open_failure_reopens():
+    b = CircuitBreaker(threshold=1, cooldown=0.05)
+    b.record_failure()
+    assert b.state == "open"
+    time.sleep(0.06)
+    assert b.allow()
+    b.record_failure()             # probe failed
+    assert b.state == "open"
+    assert not b.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker(threshold=3, cooldown=1.0)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"     # never 3 consecutive
+
+
+def test_breaker_disabled_with_zero_threshold():
+    b = CircuitBreaker(threshold=0, cooldown=0.01)
+    for _ in range(10):
+        b.record_failure()
+    assert b.allow()
+
+
+def test_breaker_thread_safety_smoke():
+    b = CircuitBreaker(threshold=5, cooldown=0.01)
+
+    def churn():
+        for i in range(500):
+            b.allow()
+            (b.record_failure if i % 3 else b.record_success)()
+
+    threads = [threading.Thread(target=churn) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert b.state in ("closed", "half-open", "open")
+
+
+# -- pool integration --------------------------------------------------------
+
+def test_pool_connect_failures_open_breaker_and_fail_fast():
+    """Dial failures to a dead host open its breaker; once open, the
+    acquire fails fast with BreakerOpen (no socket work at all)."""
+    port = rpc.free_port()  # nothing listens here
+    url = f"http://127.0.0.1:{port}/x"
+    for _ in range(resilience.BREAKER_THRESHOLD):
+        with pytest.raises(ConnectionError):
+            rpc.call(url, timeout=2.0)
+    b = resilience.breaker_for(f"127.0.0.1:{port}")
+    assert b.state == "open"
+    t0 = time.monotonic()
+    with pytest.raises(BreakerOpen):
+        rpc.call(url, timeout=30.0)
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_pool_dial_failure_is_connect_error():
+    port = rpc.free_port()
+    with pytest.raises(ConnectError):
+        rpc.call(f"http://127.0.0.1:{port}/x", timeout=2.0)
+
+
+def test_success_closes_breaker_again():
+    server = rpc.JsonHttpServer()
+    server.route("GET", "/ok", lambda q, b: {"ok": True})
+    server.start()
+    try:
+        hostport = f"127.0.0.1:{server.port}"
+        b = resilience.breaker_for(hostport)
+        for _ in range(resilience.BREAKER_THRESHOLD):
+            b.record_failure()
+        assert b.state == "open"
+        b.cooldown = 0.01
+        time.sleep(0.02)
+        assert rpc.call(f"http://{hostport}/ok") == {"ok": True}
+        assert b.state == "closed"
+    finally:
+        server.stop()
+
+
+def test_resilience_metrics_on_scrape():
+    server = rpc.JsonHttpServer()
+    reg = server.enable_metrics("testrole")
+    text = reg.expose()
+    assert "SeaweedFS_rpc_retries_total" in text
+    assert "SeaweedFS_rpc_breaker_state" in text
+    assert "SeaweedFS_faults_injected_total" in text
+    # Registering twice (two servers sharing a registry) must not
+    # duplicate the exposition blocks.
+    server2 = rpc.JsonHttpServer()
+    server2.enable_metrics("testrole2", registry=reg,
+                           serve_route=False)
+    text = reg.expose()
+    assert text.count(
+        "# TYPE SeaweedFS_rpc_retries_total counter") == 1
