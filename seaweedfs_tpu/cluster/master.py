@@ -123,6 +123,13 @@ class MasterServer:
         setup_server_tracing(s, "master")
         from ..fault.routes import setup_fault_routes
         setup_fault_routes(s)
+        from ..events import events_enabled, setup_event_routes
+        setup_event_routes(s)
+        s.route("GET", "/cluster/healthz", self._healthz)
+        if events_enabled():
+            # The aggregation endpoint honors the same kill switch as
+            # /debug/events — -events=false unmounts both surfaces.
+            s.route("GET", "/cluster/events", self._cluster_events)
         s.route("POST", "/vol/grow", self._grow)
         s.route("POST", "/vol/vacuum", self._vacuum)
         s.route("GET", "/col/list", self._col_list)
@@ -146,9 +153,16 @@ class MasterServer:
                   callback=lambda: float(self.topo.max_volume_id))
         reg.gauge("SeaweedFS_master_is_leader", "1 on the raft leader",
                   callback=lambda: 1.0 if self.is_leader() else 0.0)
+        reg.gauge("SeaweedFS_node_health",
+                  "per data node: 1 = heartbeat fresh, 0 = stale",
+                  ("node",), callback=self._node_health_values)
         self._grow_lock = threading.Lock()
         self._hb_apply_lock = threading.Lock()  # guards the lock table
         self._hb_node_locks: dict[str, threading.Lock] = {}
+        # Nodes currently registered via heartbeat: a key leaving this
+        # set (dead-node sweep) emits heartbeat.lost, re-entering emits
+        # heartbeat.recovered — the journal's liveness timeline.
+        self._hb_known: set[str] = set()
         # Exclusive admin lock (wdclient/exclusive_locks): one shell at a
         # time may run mutating maintenance commands.
         self._admin_lock = threading.Lock()
@@ -394,12 +408,22 @@ class MasterServer:
         with self._hb_apply_lock:
             node_lock = self._hb_node_locks.setdefault(
                 node_key, threading.Lock())
+            if node_key not in self._hb_known:
+                self._hb_known.add(node_key)
+                from ..events import emit as emit_event
+                emit_event("heartbeat.recovered", node=node_key,
+                           data_center=hb.get("data_center", ""),
+                           rack=hb.get("rack", ""))
         with node_lock:
             dn = self.topo.register_data_node(
                 hb.get("data_center", "DefaultDataCenter"),
                 hb.get("rack", "DefaultRack"),
                 hb["ip"], hb["port"], hb.get("public_url", ""),
                 hb.get("max_volume_count", 7))
+            # Per-directory disk status (all/used/free/percent_used)
+            # rides every heartbeat — the health rollup's capacity view.
+            if "disks" in hb:
+                dn.disk_statuses = hb["disks"]
             seq = hb.get("seq")
             if seq is not None:
                 # The epoch changes when the volume server restarts, so
@@ -570,6 +594,10 @@ class MasterServer:
                     if grown == 0:
                         raise rpc.RpcError(
                             406, "no free volumes and cannot grow")
+                    from ..events import emit as emit_event
+                    emit_event("volume.grow", node=self.url(),
+                               count=grown, reason="assign",
+                               collection=option.collection)
         try:
             fid, count, locs = self.topo.pick_for_write(count, option,
                                                         layout)
@@ -607,6 +635,10 @@ class MasterServer:
                 option.replica_placement).to_byte(),
             ttl=TTL.parse(option.ttl).to_uint32(),
             compact_revision=0), server)
+        from ..events import emit as emit_event
+        emit_event("volume.assign", node=server.url(), vid=vid,
+                   collection=option.collection,
+                   replication=option.replica_placement)
 
     def _lookup(self, query: dict, body: bytes) -> dict:
         if not self.is_leader():
@@ -665,6 +697,10 @@ class MasterServer:
                                          self._allocate_volume,
                                          ) if count is None else \
                 self._grow_n(option, count)
+        if grown:
+            from ..events import emit as emit_event
+            emit_event("volume.grow", node=self.url(), count=grown,
+                       reason="explicit", collection=option.collection)
         return {"count": grown}
 
     def _grow_n(self, option: VolumeGrowOption, n: int) -> int:
@@ -723,6 +759,162 @@ class MasterServer:
                            "term": self.raft.current_term,
                            "commit_index": self.raft.commit_index}
         return out
+
+    # -- health rollup + event aggregation -----------------------------------
+
+    def _node_health_values(self) -> dict:
+        """SeaweedFS_node_health{node=} callback: 1 while a node's last
+        heartbeat is within the dead-node threshold, else 0."""
+        now = time.time()
+        fresh = 2 * self.topo.pulse_seconds
+        return {(dn.url(),): 1.0 if now - dn.last_seen <= fresh else 0.0
+                for dn in list(self.topo.leaves())}
+
+    def health_report(self) -> tuple[bool, dict]:
+        """Derived cluster health: per-node liveness (heartbeat age,
+        outbound breaker state, disk fill) and per-volume/EC-volume
+        health (missing shards, readonly, garbage ratio).  Returns
+        (healthy, detail) — the /cluster/healthz and cluster.check
+        core."""
+        from ..ec import DATA_SHARDS, TOTAL_SHARDS
+        from . import resilience as _res
+        now = time.time()
+        fresh = 2 * self.topo.pulse_seconds
+        problems: list[str] = []
+        nodes = []
+        volumes = []
+        with self.topo._lock:
+            leaves = list(self.topo.leaves())
+            ec_map = {vid: {sid: [dn.url() for dn in dns]
+                            for sid, dns in loc.locations.items() if dns}
+                      for vid, loc in self.topo.ec_shard_map.items()}
+        for dn in leaves:
+            age = now - dn.last_seen
+            alive = age <= fresh
+            breaker = _res._breakers.get(dn.url())
+            row = {"node": dn.url(), "heartbeat_age": round(age, 3),
+                   "alive": alive,
+                   "breaker": breaker.state if breaker else "closed",
+                   "volumes": len(dn.volumes),
+                   "ec_shards": len(dn.ec_shards),
+                   "disks": getattr(dn, "disk_statuses", [])}
+            nodes.append(row)
+            if not alive:
+                problems.append(
+                    f"node {dn.url()}: heartbeat stale {age:.1f}s")
+            if row["breaker"] == "open":
+                problems.append(f"node {dn.url()}: circuit breaker open")
+            for d in row["disks"]:
+                if d.get("percent_used", 0) >= 95.0:
+                    problems.append(
+                        f"node {dn.url()}: disk {d.get('dir', '?')} "
+                        f"{d['percent_used']:.1f}% full")
+            for v in list(dn.volumes.values()):
+                ratio = (v.deleted_byte_count / v.size) if v.size else 0.0
+                volumes.append({"id": v.id, "node": dn.url(),
+                                "collection": v.collection,
+                                "read_only": v.read_only,
+                                "garbage_ratio": round(ratio, 4)})
+        if not leaves:
+            problems.append("no live data nodes")
+        ec_volumes = []
+        for vid, locs in sorted(ec_map.items()):
+            missing = [s for s in range(TOTAL_SHARDS) if s not in locs]
+            ec_volumes.append({"id": vid, "present": len(locs),
+                               "missing": missing})
+            if len(locs) < DATA_SHARDS:
+                problems.append(
+                    f"ec volume {vid}: UNRECOVERABLE — only "
+                    f"{len(locs)} of {TOTAL_SHARDS} shards survive")
+            elif missing:
+                problems.append(
+                    f"ec volume {vid}: degraded — missing shards "
+                    f"{missing}")
+        doc = {"healthy": not problems, "problems": problems,
+               "leader": self.leader_url(), "is_leader": self.is_leader(),
+               "nodes": nodes, "volumes": volumes,
+               "ec_volumes": ec_volumes}
+        return not problems, doc
+
+    def _healthz(self, query: dict, body: bytes):
+        """GET /cluster/healthz — 200/503 for load balancers, JSON
+        detail for humans.  A follower answers for itself: 200 while a
+        leader is known (it can proxy), 503 when the cluster is
+        leaderless."""
+        if not self.is_leader():
+            leader = self.raft.leader()
+            return (200 if leader else 503,
+                    {"healthy": bool(leader), "is_leader": False,
+                     "leader": leader,
+                     "problems": [] if leader else ["no leader elected"]})
+        ok, doc = self.health_report()
+        return (200 if ok else 503, doc)
+
+    def _cluster_events(self, query: dict, body: bytes):
+        """GET /cluster/events — master-side aggregation into one
+        cluster timeline: this process's journal merged with every
+        registered data node's /debug/events, deduplicated by
+        (journal token, seq) so roles sharing an in-process journal
+        are not double-counted."""
+        import urllib.parse
+
+        from ..events import JOURNAL, TYPES
+        type_ = query.get("type", "")
+        if type_ and type_ not in TYPES:
+            raise rpc.RpcError(400, f"unknown event type {type_!r}")
+        severity = query.get("severity", "")
+        try:
+            since = float(query.get("since", 0) or 0)
+            limit = int(query.get("limit", 0) or 0)
+        except ValueError:
+            raise rpc.RpcError(400, "since/limit must be numbers") \
+                from None
+        fwd = {k: v for k, v in (("type", type_),
+                                 ("since", query.get("since", "")),
+                                 ("severity", severity)) if v}
+        qs = urllib.parse.urlencode(fwd)
+        merged: dict[tuple, dict] = {}
+        for ev in JOURNAL.snapshot(type_=type_, since=since,
+                                   severity=severity):
+            merged[(JOURNAL.token, ev["seq"])] = ev
+        # Fan the per-node fetches out: during an incident (exactly
+        # when this timeline is being polled) unreachable nodes are
+        # likely, and N serial 5s connect timeouts would stall the
+        # handler thread for the whole window.
+        nodes = list(self.topo.leaves())
+
+        def _fetch(dn):
+            url = f"http://{dn.url()}/debug/events" \
+                + (f"?{qs}" if qs else "")
+            try:
+                out = rpc.call(url, timeout=5.0)
+                return dn, out if isinstance(out, dict) else None
+            except Exception:  # noqa: BLE001 — endpoint off / node gone
+                return dn, None
+
+        results = []
+        threads = []
+        for dn in nodes:
+            th = threading.Thread(
+                target=lambda d=dn: results.append(_fetch(d)))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        reached, failed = 1, 0
+        for dn, out in results:
+            if out is None:
+                failed += 1
+                continue
+            reached += 1
+            token = out.get("token", dn.url())
+            for ev in out.get("events", []):
+                merged.setdefault((token, ev.get("seq", 0)), ev)
+        events = sorted(merged.values(), key=lambda e: e["ts"])
+        if limit > 0:
+            events = events[-limit:]
+        return {"events": events, "servers_reached": reached,
+                "servers_failed": failed}
 
     def _vol_list(self, query: dict, body: bytes) -> dict:
         """Detailed topology dump (master VolumeList RPC): every node with
@@ -826,10 +1018,33 @@ class MasterServer:
                     except Exception:  # noqa: BLE001
                         pass
                 continue
-            for dn in self.topo.collect_dead_nodes():
+            self._sweep_dead_nodes()
+
+    def _sweep_dead_nodes(self) -> None:
+        """One dead-node collection round — the sweep loop's body,
+        callable directly so tests can drive heartbeat.lost through the
+        real path without waiting out a pulse interval."""
+        from ..events import emit as emit_event
+        from ..trace import root_span
+        for dn in self.topo.collect_dead_nodes():
+            with root_span("master.dead_node_sweep", "master",
+                           node=dn.url()):
+                # Snapshot what the node held BEFORE unregistering:
+                # unregister_ec_shards drains dn.ec_shards, and both
+                # the journal record and the location broadcast must
+                # report the pre-death holdings.
+                held_volumes = sorted(dn.volumes)
+                held_ec = sorted(dn.ec_shards)
                 self.topo.unregister_data_node(dn)
+                self._hb_known.discard(dn.url())
+                emit_event("heartbeat.lost", node=dn.url(),
+                           severity="warn",
+                           age_seconds=round(
+                               time.time() - dn.last_seen, 3),
+                           volumes=len(held_volumes),
+                           ec_shards=len(held_ec))
                 # Dead node: every vid it held needs re-lookup.
-                vids = sorted(set(dn.volumes) | set(dn.ec_shards))
+                vids = sorted(set(held_volumes) | set(held_ec))
                 if vids:
                     self._broadcast_locations({
                         "url": dn.url(), "public_url": dn.public_url,

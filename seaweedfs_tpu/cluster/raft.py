@@ -583,6 +583,7 @@ class RaftNode:
         # the term actually advances.  The same-term step-down path
         # (leader discovery) must keep voted_for or a node could grant
         # two votes in one term (two leaders possible).
+        was_leader = self.state == LEADER
         if term > self.current_term:
             self.voted_for = None
         self.current_term = term
@@ -590,10 +591,23 @@ class RaftNode:
         if leader is not None:
             self.leader_id = leader
         self._save_state()
+        if was_leader:
+            from ..events import emit as emit_event
+            from ..trace import root_span
+            with root_span("raft.stepdown", "master", node=self.id):
+                emit_event("leader.stepdown", node=self.id,
+                           severity="warn", term=term,
+                           new_leader=leader or "")
 
     def _become_leader(self) -> None:
         self.state = LEADER
         self.leader_id = self.id
+        from ..events import emit as emit_event
+        from ..trace import root_span
+        with root_span("raft.elect", "master", node=self.id):
+            emit_event("leader.elect", node=self.id,
+                       term=self.current_term,
+                       peers=sorted(self.peers))
         # Barrier no-op (§8): entries inherited from prior terms can't
         # be count-committed; committing a current-term entry commits
         # them transitively, so the new leader's state machine catches

@@ -75,13 +75,14 @@ class CircuitBreaker:
     re-opens it for another cooldown.
     """
 
-    __slots__ = ("threshold", "cooldown", "_state", "_failures",
+    __slots__ = ("threshold", "cooldown", "host", "_state", "_failures",
                  "_opened_at", "_probe_at", "_lock")
 
     def __init__(self, threshold: int = BREAKER_THRESHOLD,
-                 cooldown: float = BREAKER_COOLDOWN):
+                 cooldown: float = BREAKER_COOLDOWN, host: str = ""):
         self.threshold = threshold
         self.cooldown = cooldown
+        self.host = host
         self._state = CLOSED
         self._failures = 0
         self._opened_at = 0.0
@@ -91,6 +92,13 @@ class CircuitBreaker:
     @property
     def state(self) -> str:
         return _STATE_NAMES[self._state]
+
+    def _emit(self, type_: str, **attrs) -> None:
+        """Journal a state transition — called OUTSIDE the breaker lock
+        (the journal is cheap but must never nest under it)."""
+        from ..events import emit as emit_event
+        emit_event(type_, severity="warn" if type_ == "breaker.open"
+                   else "info", host=self.host, **attrs)
 
     def allow(self) -> bool:
         # Hot path: a closed breaker (the universal steady state) is one
@@ -106,34 +114,47 @@ class CircuitBreaker:
                     return False
                 self._state = HALF_OPEN
                 self._probe_at = now
-                return True  # the half-open probe
-            # HALF_OPEN: one probe in flight.  If the prober died
-            # without recording an outcome, let a new probe through
-            # after another cooldown rather than staying stuck open.
-            if now - self._probe_at >= self.cooldown:
-                self._probe_at = now
-                return True
-            return False
+                half_open = True
+            else:
+                # HALF_OPEN: one probe in flight.  If the prober died
+                # without recording an outcome, let a new probe through
+                # after another cooldown rather than staying stuck open.
+                if now - self._probe_at >= self.cooldown:
+                    self._probe_at = now
+                    return True
+                return False
+        if half_open:
+            self._emit("breaker.half_open")
+        return True  # the half-open probe
 
     def record_success(self) -> None:
         if self._state == CLOSED and self._failures == 0:
             return  # lock-free steady state
         with self._lock:
+            closed = self._state != CLOSED
             self._state = CLOSED
             self._failures = 0
+        if closed:
+            self._emit("breaker.close")
 
     def record_failure(self) -> None:
         if self.threshold <= 0:
             return
+        opened = reopened = False
         with self._lock:
             if self._state == HALF_OPEN:
                 self._state = OPEN
                 self._opened_at = time.monotonic()
-                return
-            self._failures += 1
-            if self._failures >= self.threshold:
-                self._state = OPEN
-                self._opened_at = time.monotonic()
+                opened = reopened = True
+            else:
+                self._failures += 1
+                if self._failures >= self.threshold:
+                    opened = self._state != OPEN
+                    self._state = OPEN
+                    self._opened_at = time.monotonic()
+        if opened:
+            self._emit("breaker.open", failures=self.threshold,
+                       probe_failed=reopened)
 
 
 _breakers: dict[str, CircuitBreaker] = {}
@@ -144,7 +165,8 @@ def breaker_for(hostport: str) -> CircuitBreaker:
     b = _breakers.get(hostport)
     if b is None:
         with _breakers_lock:
-            b = _breakers.setdefault(hostport, CircuitBreaker())
+            b = _breakers.setdefault(hostport,
+                                     CircuitBreaker(host=hostport))
     return b
 
 

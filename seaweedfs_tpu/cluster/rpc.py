@@ -32,6 +32,7 @@ import time
 import urllib.parse
 from typing import Callable
 
+from ..events import journal as _events
 from ..fault import registry as _fault
 from ..trace import tracer as _tracer
 from . import resilience as _res
@@ -365,6 +366,7 @@ class JsonHttpServer:
         reg.register_once(_res.rpc_retries_total)
         reg.register_once(_res.breaker_state_gauge)
         reg.register_once(_fault.faults_injected_total)
+        reg.register_once(_events.events_total)
         if serve_route:
             self.serve_metrics_route(reg)
         return reg
@@ -1134,6 +1136,30 @@ def call(url: str, method: str = "GET", body: bytes | None = None,
             "application/json"):
         return json.loads(data or b"{}")
     return data
+
+
+def call_status(url: str, method: str = "GET",
+                body: bytes | None = None, timeout: float = 10.0,
+                headers: dict | None = None):
+    """Like call() but returns (status, parsed-body) without raising on
+    HTTP errors — for endpoints whose status code IS the answer and
+    whose error responses carry a full JSON document
+    (/cluster/healthz)."""
+    resp, conn = _request(url, method, body, timeout,
+                          req_headers=headers)
+    try:
+        data = resp.read()
+    except Exception:
+        conn.close()
+        raise
+    _finish(conn, resp)
+    if (resp.getheader("content-type") or "").startswith(
+            "application/json"):
+        try:
+            return resp.status, json.loads(data or b"{}")
+        except ValueError:
+            pass
+    return resp.status, data
 
 
 def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:

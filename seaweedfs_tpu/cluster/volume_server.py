@@ -31,6 +31,7 @@ from ..ec.encoder import rebuild_ec_files, write_ec_files, \
     write_sorted_file_from_idx
 from ..ec.shard_bits import ShardBits
 from ..ec.volume import EcVolume, NeedleNotFound
+from ..events import emit as emit_event
 from ..fault import registry as _fault
 from ..stats.metrics import observe_ec_stage
 from ..storage.store import Store
@@ -103,6 +104,8 @@ class VolumeServer:
         setup_server_tracing(s, "volumeServer")
         from ..fault.routes import setup_fault_routes
         setup_fault_routes(s)
+        from ..events import setup_event_routes
+        setup_event_routes(s)
         s.route("POST", "/admin/assign_volume", self._admin_assign_volume)
         s.route("POST", "/admin/delete_volume", self._admin_delete_volume)
         s.route("POST", "/admin/readonly", self._admin_readonly)
@@ -202,6 +205,22 @@ class VolumeServer:
                   ("dir",), callback=lambda: {
                       (l.directory,): disk_status(l.directory)["free"]
                       for l in self.store.locations})
+        # The rest of the reference DiskStatus fields (disk.go): total
+        # capacity, used bytes, and fill percentage per directory — the
+        # same numbers the heartbeat feeds the master's health rollup.
+        reg.gauge("SeaweedFS_disk_all_bytes", "total disk bytes",
+                  ("dir",), callback=lambda: {
+                      (l.directory,): disk_status(l.directory)["all"]
+                      for l in self.store.locations})
+        reg.gauge("SeaweedFS_disk_used_bytes", "used disk bytes",
+                  ("dir",), callback=lambda: {
+                      (l.directory,): disk_status(l.directory)["used"]
+                      for l in self.store.locations})
+        reg.gauge("SeaweedFS_disk_percent_used",
+                  "disk fill percentage", ("dir",), callback=lambda: {
+                      (l.directory,):
+                      disk_status(l.directory)["percent_used"]
+                      for l in self.store.locations})
         reg.gauge("SeaweedFS_memory_rss_bytes", "resident set size",
                   callback=lambda: float(memory_status()["rss"]))
         # EC pipeline stage instruments are process-global singletons
@@ -213,6 +232,18 @@ class VolumeServer:
         reg.register(ec_stage_bytes)
 
     # -- heartbeats ---------------------------------------------------------
+
+    def _disk_statuses(self) -> list[dict]:
+        """Per-directory DiskStatus for the heartbeat: the master's
+        health rollup watches percent_used without a per-node scrape."""
+        from ..stats.sysstats import disk_status
+        out = []
+        for loc in self.store.locations:
+            try:
+                out.append(disk_status(loc.directory))
+            except OSError:
+                continue
+        return out
 
     def _ec_shard_infos(self) -> list[dict]:
         out = []
@@ -247,6 +278,7 @@ class VolumeServer:
                 "max_volume_count": sum(l.max_volume_count
                                         for l in self.store.locations),
                 "ec_shards": self._ec_shard_infos(),
+                "disks": self._disk_statuses(),
             }
             if full:
                 hb["volumes"] = [
@@ -975,6 +1007,13 @@ class VolumeServer:
                 # A cached location just failed: evict so the next write
                 # re-resolves immediately instead of failing for the TTL.
                 self._vol_loc_cache.pop(vid, None)
+                if method == "POST" and undo_new:
+                    # The failed NEW write is being undone everywhere —
+                    # siblings below, the local copy by the caller.
+                    emit_event("replication.rollback", node=me,
+                               severity="warn", vid=vid,
+                               committed_siblings=len(ok_urls),
+                               failed=len(errors))
                 if method == "POST" and ok_urls and undo_new:
                     # Partial fan-out of a NEW needle: undo the sibling
                     # copies that DID land, so an all-or-fail failure
@@ -1037,6 +1076,9 @@ class VolumeServer:
         req = json.loads(body)
         self.store.mark_volume_readonly(req["volume"],
                                         req.get("readonly", True))
+        emit_event("volume.readonly", node=self.url(),
+                   vid=req["volume"],
+                   readonly=req.get("readonly", True))
         self._send_heartbeat(full=True)
         return {}
 
@@ -1097,12 +1139,28 @@ class VolumeServer:
         if v is None:
             raise rpc.RpcError(404, f"volume {vid} not here")
         v.set_readonly(True)
+        emit_event("volume.readonly", node=self.url(), vid=vid,
+                   readonly=True, reason="ec.generate")
         v.sync()
         base = v.file_name()
-        write_sorted_file_from_idx(base)
-        write_ec_files(base)
+        dat_bytes = v.dat_size()
+        emit_event("ec.encode.start", node=self.url(), vid=vid,
+                   dat_bytes=dat_bytes)
+        t0 = time.perf_counter()
+        try:
+            write_sorted_file_from_idx(base)
+            write_ec_files(base)
+        except Exception as e:
+            emit_event("ec.encode.finish", node=self.url(),
+                       severity="error", vid=vid,
+                       seconds=round(time.perf_counter() - t0, 6),
+                       error=f"{type(e).__name__}: {e}")
+            raise
         from ..ec.volume_info import save_volume_info
         save_volume_info(base, v.version)
+        emit_event("ec.encode.finish", node=self.url(), vid=vid,
+                   seconds=round(time.perf_counter() - t0, 6),
+                   dat_bytes=dat_bytes, shards=TOTAL_SHARDS)
         return {"shards": list(range(TOTAL_SHARDS))}
 
     def _ec_mount(self, query: dict, body: bytes) -> dict:
@@ -1128,8 +1186,21 @@ class VolumeServer:
 
     def _ec_rebuild(self, query: dict, body: bytes) -> dict:
         req = json.loads(body)
-        base = self._volume_base(req["volume"])
-        generated = rebuild_ec_files(base)
+        vid = req["volume"]
+        base = self._volume_base(vid)
+        emit_event("ec.rebuild.start", node=self.url(), vid=vid)
+        t0 = time.perf_counter()
+        try:
+            generated = rebuild_ec_files(base)
+        except Exception as e:
+            emit_event("ec.rebuild.finish", node=self.url(),
+                       severity="error", vid=vid,
+                       seconds=round(time.perf_counter() - t0, 6),
+                       error=f"{type(e).__name__}: {e}")
+            raise
+        emit_event("ec.rebuild.finish", node=self.url(), vid=vid,
+                   seconds=round(time.perf_counter() - t0, 6),
+                   rebuilt=generated)
         return {"rebuilt_shards": generated}
 
     def _ec_delete_shards(self, query: dict, body: bytes) -> dict:

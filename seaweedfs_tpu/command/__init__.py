@@ -101,6 +101,18 @@ def usage() -> str:
     lines = ["usage: weed <command> [flags] [args]", "", "commands:"]
     for name in sorted(COMMANDS):
         lines.append(f"  {name:<18} {COMMANDS[name].short}")
+    lines += [
+        "",
+        "global flags (any command):",
+        "  -v <level>            glog verbosity (glog.v(n) gates; "
+        "env WEED_V)",
+        "  -events.file <path>   append cluster events as JSONL "
+        "(journal persistence)",
+        "  -events.buffer <n>    event ring capacity (default 2048); "
+        "-events=false unmounts /debug/events + /cluster/events",
+        "  -debug.traces / -debug.faults   mount /debug/traces and "
+        "/debug/faults",
+    ]
     return "\n".join(lines)
 
 
@@ -116,7 +128,12 @@ def main(argv: list[str] | None = None) -> int:
         print(f"unknown command {name!r}\n\n{usage()}", file=sys.stderr)
         return 2
     flags, rest = parse_flags(args)
-    glog.setup(verbosity=flags.get_int("v", 0))
+    # Global -v <level> wires glog verbosity on every command (server
+    # roles included) so `glog.v(n)` gates actually fire; without the
+    # flag the WEED_V env still applies (setup's None path) instead of
+    # being clobbered to 0.
+    glog.setup(verbosity=flags.get_int("v", 0) if "v" in flags
+               else None)
     # Offset width flavor: the reference's 5BytesOffset BUILD tag
     # (storage/types/offset_5bytes.go) as a process-wide config —
     # `-offsetBytes=5` on any command, or WEED_OFFSET_BYTES=5.
@@ -166,6 +183,19 @@ def main(argv: list[str] | None = None) -> int:
     if flags.get("breaker.cooldown"):
         os.environ["SEAWEEDFS_TPU_BREAKER_COOLDOWN"] = \
             flags.get("breaker.cooldown")
+    # Event-journal knobs (events/journal.py reads these when servers
+    # construct):  -events.file appends every event as a JSONL line
+    # (durable timeline beyond the in-memory ring); -events.buffer
+    # sizes the ring; -events=false is the kill switch that also
+    # unmounts /debug/events.
+    if flags.get("events.file"):
+        os.environ["SEAWEEDFS_TPU_EVENTS_FILE"] = \
+            flags.get("events.file")
+    if flags.get("events.buffer"):
+        os.environ["SEAWEEDFS_TPU_EVENTS_BUFFER"] = \
+            flags.get("events.buffer")
+    if "events" in flags and not flags.get_bool("events", True):
+        os.environ["SEAWEEDFS_TPU_EVENTS"] = "0"
     # Every cluster-dialing command — servers AND clients (upload,
     # shell, mount, …) — goes through the TLS plane when security.toml
     # configures [grpc.client], matching the reference where each

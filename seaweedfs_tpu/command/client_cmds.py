@@ -41,7 +41,11 @@ def run_upload(flags: Flags, args: list[str]) -> int:
                             replication=flags.get("replication") or None,
                             ttl=flags.get("ttl", ""))
         res["fileName"] = os.path.basename(p)
-        results.append(res)
+        # submit() passes the full upload dict through, including the
+        # bytes cipher_key (b"" when no cipher); hex it for the JSON
+        # report instead of crashing json.dumps.
+        results.append({k: (v.hex() if isinstance(v, bytes) else v)
+                        for k, v in res.items()})
     print(json.dumps(results, indent=2))
     return 0
 

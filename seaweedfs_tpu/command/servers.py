@@ -4,6 +4,17 @@
 Each starts the corresponding in-process server object and blocks until
 SIGINT/SIGTERM.  `weed server` composes master + volume (+ filer + s3)
 in one process, like the reference's all-in-one command.
+
+Global flags every server role honors (parsed by the dispatcher,
+command/__init__.py, before the role starts):
+
+  -v <level>          glog verbosity — arms the `glog.v(n)` gates
+                      (env WEED_V when the flag is absent)
+  -events.file <path> persist the cluster event journal as JSONL
+  -events.buffer <n>  event ring capacity; -events=false unmounts the
+                      event endpoints
+  -debug.traces / -debug.faults / -faults "point=spec;..."
+                      observability and fault-injection opt-ins
 """
 
 from __future__ import annotations

@@ -1,0 +1,195 @@
+"""Cluster event journal: typed, ring-buffered state-transition records.
+
+PR 1 (traces) answers "where did this request spend its time" and PR 2
+(faults/resilience) makes failures injectable and survivable — but a
+breaker trip, a lost heartbeat, a 4-shard EC rebuild, or a rollback
+after partial replication leaves no queryable record, only interleaved
+glog lines per process.  This module is the missing timeline: every
+cluster state transition lands as one structured record
+
+    {ts, type, node, severity, attrs, trace_id, seq}
+
+in a bounded per-process ring (`JOURNAL`), served by `/debug/events`
+(events/routes.py), aggregated cluster-wide by the master's
+`/cluster/events` and the shell's `events.ls`, and counted on every
+`/metrics` scrape as `SeaweedFS_events_total{type=}`.
+
+The catalog of event types is STATIC (`TYPES`) — like the fault-point
+catalog (fault/registry.py POINTS), every type has an emit site in the
+tree and a driver in tests/test_events.py; emitting a type that is not
+in the catalog raises, so a typo'd or orphaned emit site fails the
+smoke test instead of silently fragmenting the timeline.
+
+Cost contract: events are state transitions (elections, rebuilds,
+breaker trips), not per-request traffic, and the emit path when nobody
+is watching is a catalog dict check + a bounded `deque.append` + one
+counter increment — no locks beyond the counter's, no I/O unless the
+operator opted into JSONL persistence (`-events.file` /
+SEAWEEDFS_TPU_EVENTS_FILE).  The ring's boundedness and wrap behavior
+are asserted by test (tests/test_events.py).
+
+`trace_id` links a timeline row to its `/debug/traces` spans: emit()
+reads the thread's active span (trace/tracer.py), so an event raised
+inside a traced request — or inside a background operation wrapped in
+`tracer.root_span` (sweeps, elections, batch EC jobs) — carries the
+trace id of the work that caused it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from ..stats.metrics import Counter
+from ..trace import tracer as _tracer
+
+# Static event-type catalog.  Every entry has an emit site in the tree
+# and a driver in tests/test_events.py::test_every_event_type_is_emitted;
+# adding a type without both fails that smoke test, and emitting a type
+# that is not listed here raises ValueError.
+TYPES: dict[str, str] = {
+    "volume.assign": "a volume replica allocated onto a data node",
+    "volume.grow": "volume layout grown with new writable volumes",
+    "volume.readonly": "a volume switched readonly/writable",
+    "volume.vacuum": "volume compaction reclaimed deleted space",
+    "heartbeat.lost": "the master stopped hearing a data node",
+    "heartbeat.recovered": "a data node (re)registered with the master",
+    "leader.elect": "a raft node won an election",
+    "leader.stepdown": "a raft leader was deposed",
+    "ec.encode.start": "EC encode began (volume -> 14 shards)",
+    "ec.encode.finish": "EC encode finished, with per-stage "
+                        "byte/second attrs",
+    "ec.rebuild.start": "EC rebuild of missing shards began",
+    "ec.rebuild.finish": "EC rebuild finished, with per-stage "
+                         "byte/second attrs",
+    "breaker.open": "a per-host circuit breaker opened",
+    "breaker.half_open": "an open breaker let a probe request through",
+    "breaker.close": "a breaker closed after a successful probe",
+    "replication.rollback": "a partial replication fan-out was rolled "
+                            "back (zero orphans)",
+    "fault.injected": "an armed fault point triggered",
+    "tier.move": "a volume .dat moved between local disk and a "
+                 "remote tier",
+}
+
+SEVERITIES = ("info", "warn", "error")
+
+events_total = Counter("SeaweedFS_events_total",
+                       "cluster events by type", ("type",))
+
+
+def _env_capacity() -> int:
+    try:
+        return int(os.environ.get("SEAWEEDFS_TPU_EVENTS_BUFFER",
+                                  "") or 2048)
+    except ValueError:
+        return 2048
+
+
+class EventJournal:
+    """Bounded per-process event ring.
+
+    `emit` is safe from any thread: the ring is a `deque(maxlen=...)`
+    whose append is atomic under the GIL, so concurrent emitters never
+    need a lock on the hot path; `seq` assignment rides a dedicated
+    lock because it must be unique (it is the cross-process dedup key,
+    with `token`, for `events.ls` / `/cluster/events` aggregation over
+    roles that share one in-process journal in test stacks).
+    """
+
+    def __init__(self, capacity: int | None = None):
+        self.capacity = capacity if capacity is not None \
+            else _env_capacity()
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        # Process identity for aggregation dedup: two servers in one
+        # process serve the SAME journal; the (token, seq) pair lets
+        # events.ls collapse those duplicates while keeping genuinely
+        # distinct processes' events apart.
+        self.token = os.urandom(4).hex()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self.emitted = 0
+        # JSONL persistence (optional): resolved lazily from the env on
+        # first emit so the CLI's -events.file flag (which sets the env
+        # before servers construct) wins over import order.
+        self._sink_path: str | None | type(...) = ...
+        self._sink_lock = threading.Lock()
+
+    # -- emission ------------------------------------------------------------
+
+    def emit(self, type_: str, node: str = "", severity: str = "info",
+             **attrs) -> dict:
+        """Record one event.  Unknown types and severities raise — the
+        catalog is static so the timeline can be trusted and the smoke
+        test can enumerate it."""
+        if type_ not in TYPES:
+            raise ValueError(
+                f"unknown event type {type_!r} (not in events.TYPES)")
+        if severity not in SEVERITIES:
+            raise ValueError(
+                f"unknown severity {severity!r} (one of {SEVERITIES})")
+        sp = _tracer.current_span()
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+            self.emitted += 1  # under the lock: dropped = emitted -
+            #                    len(ring) must not undercount on races
+        ev = {"ts": time.time(), "type": type_, "node": node,
+              "severity": severity, "attrs": attrs,
+              "trace_id": sp.trace_id if sp is not None else "",
+              "seq": seq}
+        self._ring.append(ev)
+        events_total.inc(type=type_)
+        if self._sink_path is ...:
+            self._sink_path = os.environ.get(
+                "SEAWEEDFS_TPU_EVENTS_FILE") or None
+        if self._sink_path:
+            self._write_sink(ev)
+        return ev
+
+    def _write_sink(self, ev: dict) -> None:
+        """Append one JSONL line; a broken sink must never fail the
+        operation that emitted the event."""
+        try:
+            with self._sink_lock, open(self._sink_path, "a") as f:
+                f.write(json.dumps(ev) + "\n")
+        except OSError:
+            pass
+
+    def set_sink(self, path: str | None) -> None:
+        """Override the JSONL sink (tests; runtime reconfiguration)."""
+        with self._sink_lock:
+            self._sink_path = path
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def dropped(self) -> int:
+        return self.emitted - len(self._ring)
+
+    def snapshot(self, type_: str = "", since: float = 0.0,
+                 severity: str = "", limit: int = 0) -> list[dict]:
+        """Matching events oldest-first (a timeline reads forward).
+        `limit` keeps the NEWEST matches — the tail is what an operator
+        paging a live cluster wants."""
+        out = [ev for ev in list(self._ring)
+               if (not type_ or ev["type"] == type_)
+               and (not severity or ev["severity"] == severity)
+               and ev["ts"] >= since]
+        return out[-limit:] if limit > 0 else out
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self.emitted = 0
+
+
+JOURNAL = EventJournal()
+
+
+def emit(type_: str, node: str = "", severity: str = "info",
+         **attrs) -> dict:
+    """Module-level shorthand for JOURNAL.emit — what call sites use."""
+    return JOURNAL.emit(type_, node=node, severity=severity, **attrs)

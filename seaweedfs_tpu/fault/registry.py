@@ -172,6 +172,11 @@ class FaultSpec:
                     disarm(self.point)
             self.triggered += 1
         faults_injected_total.inc(point=self.point)
+        from ..events import emit as emit_event
+        emit_event("fault.injected", severity="warn", point=self.point,
+                   kind=self.kind, spec=self.raw,
+                   **{k: str(v) for k, v in ctx.items()
+                      if k not in ("point", "kind", "spec", "node")})
         where = f"{self.point}" + (f" {ctx}" if ctx else "")
         if self.kind == "delay":
             time.sleep(self.arg)

@@ -146,6 +146,8 @@ class FilerServer:
         setup_server_tracing(s, "filer")
         from ..fault.routes import setup_fault_routes
         setup_fault_routes(s)
+        from ..events import setup_event_routes
+        setup_event_routes(s)
         # Master proxies: mounts and other filer-only clients assign
         # file ids and resolve volumes through the filer (the filer
         # gRPC AssignVolume/LookupVolume surface, filer.proto:30-33).

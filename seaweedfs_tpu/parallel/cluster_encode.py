@@ -29,8 +29,10 @@ import time
 import numpy as np
 
 from ..cluster import rpc
+from ..events import emit as emit_event
 from ..fault import registry as _fault
-from ..stats.metrics import observe_ec_stage
+from ..stats.metrics import observe_batch_stage, stage_attrs
+from ..trace import root_span
 from ..ec import (DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
                   TOTAL_SHARDS, to_ext)
 from ..ec.encoder import (DEFAULT_CHUNK, _chunk_reader,
@@ -118,6 +120,32 @@ def _fetch_volume(tmpdir: str, vid: int, locs: list[str]) -> str:
 
 def _encode_batch_group(env, mesh, pool, batch, chunk_size,
                         progress) -> list[str]:
+    """Fetch, mesh-encode, scatter one sub-batch of volumes — journaled
+    as ec.encode.start/finish with per-stage byte/second attrs, under a
+    root span so the timeline row links to a /debug/traces trace."""
+    vids = [vid for vid, _locs in batch]
+    with root_span("ec.batch_encode", "ec", volumes=len(vids)):
+        emit_event("ec.encode.start", volumes=vids, batch=True)
+        t0 = time.perf_counter()
+        stages: dict[str, list[float]] = {}  # stage -> [seconds, bytes]
+        try:
+            out = _encode_batch_group_inner(env, mesh, pool, batch,
+                                            chunk_size, progress, stages)
+        except Exception as e:
+            emit_event("ec.encode.finish", severity="error",
+                       volumes=vids, batch=True,
+                       seconds=round(time.perf_counter() - t0, 6),
+                       error=f"{type(e).__name__}: {e}",
+                       **stage_attrs(stages))
+            raise
+        emit_event("ec.encode.finish", volumes=vids, batch=True,
+                   seconds=round(time.perf_counter() - t0, 6),
+                   **stage_attrs(stages))
+        return out
+
+
+def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
+                              progress, stages) -> list[str]:
     """Fetch, mesh-encode, scatter one sub-batch of volumes."""
     from ..shell.command_ec import balanced_distribution, collect_ec_nodes
     vol_axis = mesh.shape["vol"]
@@ -133,8 +161,8 @@ def _encode_batch_group(env, mesh, pool, batch, chunk_size,
         t_fetch = time.perf_counter()
         bases = list(pool.map(
             lambda t: _fetch_volume(tmp, *t), batch))
-        observe_ec_stage(
-            "batch_fetch", time.perf_counter() - t_fetch,
+        observe_batch_stage(
+            stages, "batch_fetch", time.perf_counter() - t_fetch,
             sum(os.path.getsize(b + ".dat") for b in bases))
 
         # 2. Mesh-encode: lockstep stripe chunks across volumes.  Each
@@ -174,9 +202,9 @@ def _encode_batch_group(env, mesh, pool, batch, chunk_size,
                 # batched GF(2) matmul.
                 t_dev = time.perf_counter()
                 parity = np.asarray(batched_encode(stacked, mesh))
-                observe_ec_stage("batch_encode_device",
-                                 time.perf_counter() - t_dev,
-                                 stacked.nbytes)
+                observe_batch_stage(stages, "batch_encode_device",
+                               time.perf_counter() - t_dev,
+                               stacked.nbytes)
                 for j, v in enumerate(active):
                     writers[v].write(chunks[j],
                                      parity[j, :, :widths[j]])
@@ -204,8 +232,8 @@ def _encode_batch_group(env, mesh, pool, batch, chunk_size,
                         _scatter_shard, url, vid, sid, payload))
             for f in futs:
                 f.result()
-            observe_ec_stage("batch_scatter",
-                             time.perf_counter() - t_scatter, scattered)
+            observe_batch_stage(stages, "batch_scatter",
+                           time.perf_counter() - t_scatter, scattered)
             with open(base + ".ecx", "rb") as f:
                 ecx = f.read()
             for url in plan:

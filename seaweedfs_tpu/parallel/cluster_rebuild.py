@@ -28,7 +28,10 @@ import numpy as np
 from ..cluster import rpc
 from ..ec import DATA_SHARDS, TOTAL_SHARDS
 from ..ec.shard_bits import ShardBits
+from ..events import emit as emit_event
 from ..fault import registry as _fault
+from ..stats.metrics import observe_batch_stage, stage_attrs
+from ..trace import root_span
 from ..utils import env_float as _env_float
 from .sharded_codec import batched_reconstruct
 
@@ -204,6 +207,37 @@ def batch_rebuild(env, vids=None, mesh=None, max_batch_bytes=1 << 28,
 
 def _rebuild_group(env, mesh, pool, picker, present, missing, entries,
                    max_batch_bytes, matrix_kind, progress) -> list[str]:
+    """One survivor-signature group — journaled as
+    ec.rebuild.start/finish with per-stage byte/second attrs, under a
+    root span so the timeline row links to a /debug/traces trace."""
+    vids = [vid for vid, _locs in entries]
+    with root_span("ec.batch_rebuild", "ec", volumes=len(vids),
+                   missing=list(missing)):
+        emit_event("ec.rebuild.start", volumes=vids, batch=True,
+                   missing=list(missing))
+        t0 = time.perf_counter()
+        stages: dict[str, list[float]] = {}  # stage -> [seconds, bytes]
+        try:
+            out = _rebuild_group_inner(env, mesh, pool, picker, present,
+                                       missing, entries, max_batch_bytes,
+                                       matrix_kind, progress, stages)
+        except Exception as e:
+            emit_event("ec.rebuild.finish", severity="error",
+                       volumes=vids, batch=True, missing=list(missing),
+                       seconds=round(time.perf_counter() - t0, 6),
+                       error=f"{type(e).__name__}: {e}",
+                       **stage_attrs(stages))
+            raise
+        emit_event("ec.rebuild.finish", volumes=vids, batch=True,
+                   missing=list(missing),
+                   seconds=round(time.perf_counter() - t0, 6),
+                   **stage_attrs(stages))
+        return out
+
+
+def _rebuild_group_inner(env, mesh, pool, picker, present, missing,
+                         entries, max_batch_bytes, matrix_kind,
+                         progress, stages) -> list[str]:
     used = present[:DATA_SHARDS]
     vol_axis = mesh.shape["vol"]
     col_axis = mesh.shape["col"]
@@ -212,6 +246,7 @@ def _rebuild_group(env, mesh, pool, picker, present, missing, entries,
     i = 0
     while i < len(entries):
         # Probe the first volume's shard size to bound the sub-batch.
+        t_gather = time.perf_counter()
         vid0, locs0 = entries[i]
         rows0 = _fetch_rows(pool, vid0, locs0, used)
         shard_bytes = len(rows0[0])
@@ -224,6 +259,10 @@ def _rebuild_group(env, mesh, pool, picker, present, missing, entries,
         futs = [[pool.submit(_fetch_shard, locs[sid], vid, sid)
                  for sid in used] for vid, locs in chunk[1:]]
         fetched = [rows0] + [[f.result() for f in row] for row in futs]
+        observe_batch_stage(stages, "batch_gather",
+                       time.perf_counter() - t_gather,
+                       sum(len(row) for rows in fetched
+                           for row in rows))
         sizes = [len(rows[0]) for rows in fetched]
         n_pad = _pad_to(max(sizes), align)
         v_pad = _pad_to(len(chunk), vol_axis)
@@ -236,20 +275,29 @@ def _rebuild_group(env, mesh, pool, picker, present, missing, entries,
                         f"disagree on size ({len(row)} vs {sizes[v]})")
                 stacked[v, r, :len(row)] = np.frombuffer(row, np.uint8)
         # ONE compiled step for the whole sub-batch: volumes sharded on
-        # "vol", byte columns on "col", no collectives.
+        # "vol", byte columns on "col", no collectives.  np.asarray
+        # fences the dispatch, so this is execution-fenced device time.
+        t_dev = time.perf_counter()
         rebuilt = np.asarray(batched_reconstruct(
             stacked, present, missing, mesh,
             matrix_kind=matrix_kind))
+        observe_batch_stage(stages, "batch_rebuild_device",
+                       time.perf_counter() - t_dev, stacked.nbytes)
+        t_scatter = time.perf_counter()
+        scattered = 0
         for v, (vid, locs) in enumerate(chunk):
+            shards = [rebuilt[v, m, :sizes[v]].tobytes()
+                      for m in range(len(missing))]
+            scattered += sum(len(s) for s in shards)
             placed = _scatter_volume(
-                env, pool, picker, vid, locs, missing,
-                [rebuilt[v, m, :sizes[v]].tobytes()
-                 for m in range(len(missing))])
+                env, pool, picker, vid, locs, missing, shards)
             out.append(f"volume {vid}: rebuilt shards "
                        f"{list(missing)} -> " +
                        ", ".join(f"{s}@{u}" for s, u in placed))
             if progress:
                 progress(out[-1])
+        observe_batch_stage(stages, "batch_scatter",
+                       time.perf_counter() - t_scatter, scattered)
         i += chunk_v
     return out
 

@@ -31,6 +31,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import rs_bitmatrix
 from ..ops.coder_jax import apply_bitmatrix, plane_major
 
+# jax.shard_map landed as a top-level API after 0.4.x; on the 0.4
+# toolchain the same function lives under jax.experimental.shard_map.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover — exercised on the 0.4.x image
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def _parity_pm(data_shards: int, parity_shards: int,
                kind: str = "vandermonde") -> np.ndarray:
@@ -156,7 +163,7 @@ def all_to_all_reconstruct(stacked, present: tuple[int, ...],
             lambda x: apply_bitmatrix(pm, x, wanted_count))(gathered)
         return out  # (v_loc, wanted, chunk) — column-sharded result
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(_shard_map(
         local, mesh=mesh,
         in_specs=P("vol", "col", None),
         out_specs=P("vol", None, "col")))
@@ -226,7 +233,7 @@ def ring_reconstruct(stacked, present: tuple[int, ...],
         acc = jax.lax.fori_loop(1, n_ring, step, acc)
         return acc  # chip d holds the reduced chunk d
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(_shard_map(
         local, mesh=mesh,
         in_specs=P("vol", "col", None),
         out_specs=P("vol", None, "col")))

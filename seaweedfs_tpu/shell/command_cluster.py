@@ -1,0 +1,134 @@
+"""events.ls / cluster.check — the cluster timeline and health rollup.
+
+Events are recorded per process into a bounded ring (events/journal.py)
+and served by each server's `/debug/events`.  `events.ls` aggregates
+across every reachable server — master, all registered volume servers,
+and the filer when configured — deduplicating by each journal's
+(token, seq) identity, because roles sharing one process (test stacks,
+`weed server`) share one journal.  `cluster.check` renders the master's
+`/cluster/healthz` rollup: per-node liveness (heartbeat age, breaker
+state, disk fill) and per-volume/EC-volume health.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..cluster import rpc
+from ..events import TYPES
+from .commands import Command, register
+from .env import CommandEnv, ShellError
+
+
+@register
+class EventsLs(Command):
+    name = "events.ls"
+    help = ("events.ls [-type T] [-severity S] [-since TS] [-limit N] "
+            "[-server host:port] [-types] — one cluster timeline "
+            "merged from every reachable server's /debug/events")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, _rest = self.parse_flags(args)
+        if flags.get("types"):
+            lines = [f"{'TYPE':22}  DESCRIPTION"]
+            for name in sorted(TYPES):
+                lines.append(f"{name:22}  {TYPES[name]}")
+            return "\n".join(lines)
+        type_ = flags.get("type", "")
+        if type_ and type_ not in TYPES:
+            raise ShellError(f"unknown event type {type_!r} "
+                             "(events.ls -types)")
+        limit = int(flags.get("limit", "50"))
+        qs_parts = [f"type={type_}" if type_ else "",
+                    f"severity={flags['severity']}"
+                    if flags.get("severity") else "",
+                    f"since={flags['since']}"
+                    if flags.get("since") else ""]
+        qs = "&".join(p for p in qs_parts if p)
+        merged: dict[tuple, dict] = {}
+        reached = 0
+        for url in env.debug_servers(flags):
+            try:
+                out = rpc.call(f"{url}/debug/events"
+                               + (f"?{qs}" if qs else ""), timeout=5.0)
+            except Exception:  # noqa: BLE001 — endpoint off / gone
+                continue
+            if not isinstance(out, dict):
+                continue
+            reached += 1
+            token = out.get("token", url)
+            for ev in out.get("events", []):
+                merged.setdefault((token, ev.get("seq", 0)), ev)
+        if not reached:
+            raise ShellError("no /debug/events endpoint reachable")
+        rows = sorted(merged.values(), key=lambda e: e["ts"])[-limit:]
+        if not rows:
+            return "no events recorded"
+        lines = [f"{'AT':12}  {'SEV':5}  {'TYPE':22}  {'NODE':21}  "
+                 "ATTRS"]
+        for ev in rows:
+            at = time.strftime("%H:%M:%S",
+                               time.localtime(ev["ts"])) \
+                + f".{int(ev['ts'] % 1 * 1000):03d}"
+            attrs = " ".join(f"{k}={v}" for k, v in
+                             sorted(ev.get("attrs", {}).items()))
+            if ev.get("trace_id"):
+                attrs += f"  trace={ev['trace_id']}"
+            lines.append(f"{at:12}  {ev['severity']:5}  "
+                         f"{ev['type']:22}  "
+                         f"{ev.get('node', '') or '-':21}  {attrs}")
+        return "\n".join(lines)
+
+
+@register
+class ClusterCheck(Command):
+    name = "cluster.check"
+    help = ("cluster.check — health rollup from the master's "
+            "/cluster/healthz: node liveness, disk fill, volume and "
+            "EC-shard health; exit text is HEALTHY or the problem list")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, _rest = self.parse_flags(args)
+        url = flags.get("server")
+        base = (url if "://" in url else f"http://{url}") if url \
+            else env.master_url
+        try:
+            status, doc = rpc.call_status(f"{base}/cluster/healthz",
+                                          timeout=10.0)
+        except Exception as e:  # noqa: BLE001
+            raise ShellError(
+                f"cannot reach {base}/cluster/healthz: {e}") from None
+        if not isinstance(doc, dict):
+            raise ShellError(f"unexpected healthz reply: {doc!r}")
+        lines = [("HEALTHY" if doc.get("healthy")
+                  else f"UNHEALTHY (HTTP {status})")
+                 + f"  leader={doc.get('leader', '?')}"]
+        for p in doc.get("problems", []):
+            lines.append(f"  !! {p}")
+        nodes = doc.get("nodes", [])
+        if nodes:
+            lines.append("")
+            lines.append(f"{'NODE':21}  {'HB AGE':>7}  {'BREAKER':9}  "
+                         f"{'VOLS':>4}  {'EC':>3}  DISK")
+            for n in nodes:
+                disk = ", ".join(
+                    f"{d.get('dir', '?')} {d.get('percent_used', 0):.0f}%"
+                    for d in n.get("disks", [])) or "-"
+                lines.append(
+                    f"{n['node']:21}  {n['heartbeat_age']:7.1f}  "
+                    f"{n['breaker']:9}  {n['volumes']:4d}  "
+                    f"{n['ec_shards']:3d}  {disk}")
+        ec = doc.get("ec_volumes", [])
+        if ec:
+            lines.append("")
+            lines.append(f"{'EC VOLUME':>9}  {'SHARDS':>6}  MISSING")
+            for v in ec:
+                missing = ",".join(map(str, v["missing"])) or "-"
+                lines.append(f"{v['id']:9d}  {v['present']:6d}  "
+                             f"{missing}")
+        ro = [v for v in doc.get("volumes", []) if v.get("read_only")]
+        if ro:
+            lines.append("")
+            lines.append("readonly volumes: " + ", ".join(
+                f"{v['id']}@{v['node']}" for v in ro))
+        return "\n".join(lines)

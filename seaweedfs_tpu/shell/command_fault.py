@@ -17,21 +17,6 @@ from .commands import Command, register
 from .env import CommandEnv, ShellError
 
 
-def _fault_servers(env: CommandEnv, flags: dict) -> list[str]:
-    """Base URLs to query, master first (same walk as trace.ls)."""
-    if flags.get("server"):
-        url = flags["server"]
-        return [url if "://" in url else f"http://{url}"]
-    urls = [env.master_url]
-    try:
-        urls += [f"http://{n['url']}" for n in env.data_nodes()]
-    except Exception:  # noqa: BLE001 — master down: others may answer
-        pass
-    if env.filer_url:
-        urls.append(env.filer_url)
-    return urls
-
-
 def _fetch(url: str, qs: str = "", method: str = "GET") -> dict | None:
     try:
         out = rpc.call(f"{url}/debug/faults{qs}", method, timeout=5.0)
@@ -54,7 +39,7 @@ class FaultLs(Command):
             lines.append(f"{name:18}  {_registry.POINTS[name]}")
         reached = 0
         armed_lines: list[str] = []
-        for url in _fault_servers(env, flags):
+        for url in env.debug_servers(flags):
             out = _fetch(url)
             if out is None:
                 continue
@@ -107,7 +92,7 @@ class FaultSet(Command):
         qs = (f"?point={urllib.parse.quote(point)}"
               f"&spec={urllib.parse.quote(spec)}")
         done, failed = [], []
-        for url in _fault_servers(env, flags):
+        for url in env.debug_servers(flags):
             out = _fetch(url, qs, method="POST")
             (done if out is not None else failed).append(url)
         if not done:

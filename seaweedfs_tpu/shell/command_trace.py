@@ -15,21 +15,6 @@ from .commands import Command, register
 from .env import CommandEnv, ShellError
 
 
-def _trace_servers(env: CommandEnv, flags: dict) -> list[str]:
-    """Base URLs to query, newest master first."""
-    if flags.get("server"):
-        url = flags["server"]
-        return [url if "://" in url else f"http://{url}"]
-    urls = [env.master_url]
-    try:
-        urls += [f"http://{n['url']}" for n in env.data_nodes()]
-    except Exception:  # noqa: BLE001 — master down: filer may still answer
-        pass
-    if env.filer_url:
-        urls.append(env.filer_url)
-    return urls
-
-
 def _fetch(url: str, qs: str) -> dict | None:
     try:
         out = rpc.call(f"{url}/debug/traces{qs}", timeout=5.0)
@@ -49,7 +34,7 @@ class TraceLs(Command):
         limit = int(flags.get("limit", "50"))
         merged: dict[str, dict] = {}
         reached = 0
-        for url in _trace_servers(env, flags):
+        for url in env.debug_servers(flags):
             out = _fetch(url, f"?limit={limit}")
             if out is None:
                 continue
@@ -99,7 +84,7 @@ class TraceGet(Command):
             raise ShellError("trace.get requires a trace id (trace.ls)")
         trace_id = rest[0]
         spans: dict[str, dict] = {}
-        for url in _trace_servers(env, flags):
+        for url in env.debug_servers(flags):
             out = _fetch(url, f"?trace={trace_id}")
             if out is None:
                 continue

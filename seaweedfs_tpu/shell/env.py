@@ -73,6 +73,24 @@ class CommandEnv:
         return {int(s): [d["url"] for d in dns]
                 for s, dns in resp.get("ecShards", {}).items()}
 
+    def debug_servers(self, flags: dict) -> list[str]:
+        """Base URLs for per-process debug surfaces (/debug/traces,
+        /debug/faults, /debug/events): master first, then every
+        registered volume server, then the filer — or just the
+        -server flag's target.  The shared walk behind trace.ls,
+        fault.ls/set, and events.ls."""
+        if flags.get("server"):
+            url = flags["server"]
+            return [url if "://" in url else f"http://{url}"]
+        urls = [self.master_url]
+        try:
+            urls += [f"http://{n['url']}" for n in self.data_nodes()]
+        except Exception:  # noqa: BLE001 — master down: others may
+            pass           # still answer
+        if self.filer_url:
+            urls.append(self.filer_url)
+        return urls
+
     # -- volume server RPC shorthands ---------------------------------------
 
     def vs_call(self, url: str, path: str, payload: dict | None = None,

@@ -305,6 +305,28 @@ def observe_ec_stage(stage: str, seconds: float, nbytes: int = 0) -> None:
         ec_stage_bytes.inc(nbytes, stage=stage)
 
 
+def observe_batch_stage(stages: dict, stage: str, seconds: float,
+                        nbytes: int) -> None:
+    """observe_ec_stage plus a per-batch accumulator: the batched EC
+    encode/rebuild report per-stage totals on their finish events
+    (events/journal.py), not just in the process histograms.  `stages`
+    maps stage -> [seconds, bytes]."""
+    observe_ec_stage(stage, seconds, nbytes)
+    acc = stages.setdefault(stage, [0.0, 0])
+    acc[0] += seconds
+    acc[1] += nbytes
+
+
+def stage_attrs(stages: dict) -> dict:
+    """Flatten an observe_batch_stage accumulator into event attrs:
+    {<stage>_seconds, <stage>_bytes}."""
+    out = {}
+    for stage, (seconds, nbytes) in stages.items():
+        out[f"{stage}_seconds"] = round(seconds, 6)
+        out[f"{stage}_bytes"] = int(nbytes)
+    return out
+
+
 class MetricsPusher:
     """LoopPushingMetric (stats/metrics.go:140): periodically POST the
     exposition text to a push gateway."""

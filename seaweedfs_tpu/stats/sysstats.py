@@ -30,7 +30,10 @@ def proc_cpu_seconds() -> float:
 
 
 def memory_status() -> dict:
-    """Process memory from /proc/self/status (memory.go)."""
+    """Process memory from /proc/self/status (memory.go), falling back
+    to getrusage off-Linux so the volume server's RSS gauge and /debug
+    status stay meaningful on macOS (no procfs there; ru_maxrss is the
+    peak RSS — bytes on macOS, kilobytes on Linux)."""
     out = {"rss": 0, "vms": 0}
     try:
         with open("/proc/self/status") as f:
@@ -41,4 +44,9 @@ def memory_status() -> dict:
                     out["vms"] = int(line.split()[1]) * 1024
     except OSError:
         pass
+    if not out["rss"]:
+        import resource
+        import sys
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        out["rss"] = peak if sys.platform == "darwin" else peak * 1024
     return out

@@ -78,6 +78,9 @@ def move_dat_to_remote(volume: Volume, dest_spec: str,
         dat.close()
     if not keep_local:
         os.remove(base + ".dat")
+    from ..events import emit as emit_event
+    emit_event("tier.move", vid=volume.vid, direction="upload",
+               dest=dest_spec, bytes=size, keep_local=keep_local)
     return info
 
 
@@ -111,6 +114,11 @@ def move_dat_from_remote(volume: Volume, keep_remote: bool = False,
     os.remove(vif_path(base))
     if not keep_remote:
         backend.delete(fdesc["key"])
+    from ..events import emit as emit_event
+    emit_event("tier.move", vid=volume.vid, direction="download",
+               source=fdesc["backend_spec"],
+               bytes=fdesc.get("file_size", 0),
+               keep_remote=keep_remote)
 
 
 def open_remote_volume(dir_: str, collection: str, vid: int) -> Volume:

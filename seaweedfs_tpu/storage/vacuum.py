@@ -146,8 +146,20 @@ def vacuum(volume: Volume) -> None:
 
     Holds the (reentrant) vacuum lock across both phases so concurrent
     vacuum() calls fully serialize instead of one consuming the
-    other's staged snapshot between its phases.
+    other's staged snapshot between its phases.  Journaled as a
+    volume.vacuum event with the reclaimed bytes and garbage ratios.
     """
+    import time as _time
+
+    from ..events import emit as emit_event
     with volume.vacuum_lock:
+        before_bytes = volume.dat_size()
+        before_ratio = volume.garbage_ratio()
+        t0 = _time.perf_counter()
         compact(volume)
         commit_compact(volume)
+        emit_event("volume.vacuum", vid=volume.vid,
+                   seconds=round(_time.perf_counter() - t0, 6),
+                   reclaimed_bytes=before_bytes - volume.dat_size(),
+                   garbage_before=round(before_ratio, 4),
+                   garbage_after=round(volume.garbage_ratio(), 4))

@@ -11,7 +11,7 @@ Public surface:
 from .tracer import (BUFFER, NOOP, Span, TraceBuffer,  # noqa: F401
                      begin_server_span, current_span,
                      current_traceparent, enabled, end_server_span,
-                     parse_traceparent, recording_on, sample_rate,
-                     slow_threshold_seconds, span)
+                     parse_traceparent, recording_on, root_span,
+                     sample_rate, slow_threshold_seconds, span)
 from .routes import (setup_server_tracing,  # noqa: F401
                      traces_route_enabled)

@@ -270,6 +270,38 @@ def end_server_span(span: Span | None, status: int = 200) -> None:
 
 
 @contextmanager
+def root_span(name: str, service: str, **attrs):
+    """Root span for background operations that start outside any
+    request — the master's dead-node sweep, raft elections, batch EC
+    encode/rebuild jobs.  Gives the operation a trace id so the events
+    it emits (events/journal.py) link to a /debug/traces timeline, and
+    the operation itself shows up as a trace.  Inside an existing trace
+    this degrades to a plain child span; with tracing disabled it is
+    the usual no-op."""
+    if not enabled():
+        yield NOOP
+        return
+    prev = getattr(_local, "span", None)
+    if prev is not None:
+        with span(name, **attrs) as sp:
+            yield sp
+        return
+    sp = Span(os.urandom(16).hex(), "", name, service, "internal",
+              recording_on())
+    sp.attrs.update(attrs)
+    _local.span = sp
+    try:
+        yield sp
+    except BaseException as e:
+        sp.status = "error"
+        sp.attrs["error"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _local.span = None
+        _finish(sp)
+
+
+@contextmanager
 def span(name: str, **attrs):
     """Child span of whatever is active on this thread.  With no active
     trace this is a no-op — traces begin at server spans, so free-

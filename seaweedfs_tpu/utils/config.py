@@ -10,8 +10,19 @@ variables override file values, matching viper's `WEED_` AutomaticEnv with
 from __future__ import annotations
 
 import os
-import tomllib
 from typing import Any
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.10: stdlib tomllib is 3.11+
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:
+        # No TOML parser available.  The common case — no config file
+        # on disk — must still work (every CLI command loads
+        # security.toml at startup and an absent file is an empty
+        # config); only actually PARSING a file requires the parser.
+        tomllib = None  # type: ignore[assignment]
 
 SEARCH_PATHS = [".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"]
 
@@ -68,6 +79,11 @@ def load_configuration(name: str, required: bool = False,
     for d in search_paths or SEARCH_PATHS:
         path = os.path.join(d, name + ".toml")
         if os.path.isfile(path):
+            if tomllib is None:
+                raise RuntimeError(
+                    f"found {path} but no TOML parser is available "
+                    "(stdlib tomllib needs Python 3.11+; or pip "
+                    "install tomli)")
             with open(path, "rb") as f:
                 return Configuration(tomllib.load(f))
     if required:

@@ -1,0 +1,582 @@
+"""Cluster event journal + health rollup: the static event-type
+catalog, the bounded ring, /debug/events filtering, the master's
+/cluster/healthz + /cluster/events aggregation, events.ls /
+cluster.check, and the anti-rot smoke test proving EVERY cataloged
+event type is emitted through its real code path (with a trace id
+linking it to /debug/traces when tracing is on)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import events, fault
+from seaweedfs_tpu.cluster import resilience, rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.events import JOURNAL, TYPES, EventJournal
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.stats.promcheck import validate_exposition
+from seaweedfs_tpu.trace import root_span
+
+
+# -- journal unit tests ------------------------------------------------------
+
+def test_unknown_type_and_severity_raise():
+    j = EventJournal(capacity=8)
+    with pytest.raises(ValueError):
+        j.emit("no.such.event")
+    with pytest.raises(ValueError):
+        j.emit("volume.grow", severity="catastrophic")
+    assert j.emitted == 0
+
+
+def test_ring_is_bounded_and_wrap_retains_newest():
+    j = EventJournal(capacity=4)
+    # The hot-path contract: the ring is a bounded deque — an unbounded
+    # journal would grow without limit on a long-lived server.
+    assert j._ring.maxlen == 4
+    for i in range(10):
+        j.emit("volume.grow", count=i)
+    got = [ev["attrs"]["count"] for ev in j.snapshot()]
+    assert got == [6, 7, 8, 9]          # newest retained, oldest gone
+    assert j.emitted == 10 and j.dropped == 6
+
+
+def test_concurrent_emit_from_threads():
+    j = EventJournal(capacity=10000)
+    n_threads, per_thread = 8, 200
+
+    def worker(k):
+        for i in range(per_thread):
+            j.emit("fault.injected", severity="warn", thread=k, i=i)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = j.snapshot()
+    assert len(evs) == n_threads * per_thread == j.emitted
+    seqs = [ev["seq"] for ev in evs]
+    assert len(set(seqs)) == len(seqs)  # seq is unique under races
+
+
+def test_snapshot_filters_and_limit():
+    j = EventJournal(capacity=64)
+    j.emit("volume.grow", count=1)
+    time.sleep(0.01)
+    cut = time.time()
+    j.emit("volume.vacuum", vid=3)
+    j.emit("heartbeat.lost", severity="warn", node="a:1")
+    assert [e["type"] for e in j.snapshot(type_="volume.vacuum")] == \
+        ["volume.vacuum"]
+    assert [e["type"] for e in j.snapshot(severity="warn")] == \
+        ["heartbeat.lost"]
+    assert all(e["ts"] >= cut for e in j.snapshot(since=cut))
+    assert len(j.snapshot(since=cut)) == 2
+    assert [e["type"] for e in j.snapshot(limit=1)] == \
+        ["heartbeat.lost"]  # limit keeps the newest
+
+
+def test_jsonl_sink(tmp_path):
+    j = EventJournal(capacity=8)
+    path = str(tmp_path / "events.jsonl")
+    j.set_sink(path)
+    j.emit("volume.grow", count=2)
+    j.emit("tier.move", vid=9, direction="upload")
+    lines = [json.loads(ln) for ln in
+             open(path).read().strip().split("\n")]
+    assert [ev["type"] for ev in lines] == ["volume.grow", "tier.move"]
+    assert lines[1]["attrs"]["vid"] == 9
+
+
+def test_event_carries_active_trace_id():
+    j = EventJournal(capacity=8)
+    with root_span("unit.op", "test") as sp:
+        ev = j.emit("volume.grow", count=1)
+        assert ev["trace_id"] == sp.trace_id != ""
+    assert j.emit("volume.grow", count=2)["trace_id"] == ""
+
+
+# -- /debug/events endpoint --------------------------------------------------
+
+def test_debug_events_endpoint_filters(monkeypatch):
+    server = rpc.JsonHttpServer()
+    events.setup_event_routes(server)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}/debug/events"
+    marker = os.urandom(4).hex()
+    try:
+        JOURNAL.emit("volume.grow", marker=marker)
+        cut = time.time()
+        JOURNAL.emit("heartbeat.lost", severity="warn", node="x:1",
+                     marker=marker)
+        out = rpc.call(f"{base}?type=volume.grow")
+        assert out["token"] == JOURNAL.token
+        assert all(e["type"] == "volume.grow" for e in out["events"])
+        assert any(e["attrs"].get("marker") == marker
+                   for e in out["events"])
+        out = rpc.call(f"{base}?since={cut}&severity=warn")
+        assert any(e["attrs"].get("marker") == marker
+                   for e in out["events"])
+        assert all(e["severity"] == "warn" and e["ts"] >= cut
+                   for e in out["events"])
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.call(f"{base}?type=bogus.type")
+        assert ei.value.status == 400
+        out = rpc.call(f"{base}?limit=1")
+        assert len(out["events"]) == 1
+    finally:
+        server.stop()
+
+
+def test_debug_events_kill_switch(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_EVENTS", "0")
+    server = rpc.JsonHttpServer()
+    events.setup_event_routes(server)
+    server.start()
+    try:
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.call(f"http://127.0.0.1:{server.port}/debug/events")
+        assert ei.value.status == 404
+    finally:
+        server.stop()
+
+
+# -- satellites: sysstats fallback + glog -v ---------------------------------
+
+def test_memory_status_falls_back_off_linux(monkeypatch):
+    """No /proc/self/status (macOS): RSS must come from getrusage, not
+    silently read zero."""
+    import builtins
+    real_open = builtins.open
+
+    def fake_open(path, *a, **k):
+        if path == "/proc/self/status":
+            raise OSError("no procfs")
+        return real_open(path, *a, **k)
+
+    monkeypatch.setattr(builtins, "open", fake_open)
+    from seaweedfs_tpu.stats.sysstats import memory_status
+    assert memory_status()["rss"] > 0
+
+
+def test_cli_v_flag_configures_glog(monkeypatch):
+    from seaweedfs_tpu.command import main
+    from seaweedfs_tpu.utils import glog
+    old = glog._verbosity
+    try:
+        assert main(["version", "-v", "2"]) == 0
+        assert glog._verbosity == 2
+        assert glog.v(2).on and not glog.v(3).on
+        # Without the flag the WEED_V env applies instead of being
+        # clobbered back to 0.
+        monkeypatch.setenv("WEED_V", "1")
+        assert main(["version"]) == 0
+        assert glog._verbosity == 1
+    finally:
+        glog._verbosity = old
+
+
+# -- mini-cluster: every event type through its real code path ---------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """Raft master (so elections are real) + two volume servers + a
+    stub EC peer, with tracing recording on so every event can carry a
+    trace id."""
+    saved = {k: os.environ.get(k)
+             for k in ("SEAWEEDFS_TPU_TRACES", "SEAWEEDFS_TPU_TRACE")}
+    os.environ["SEAWEEDFS_TPU_TRACES"] = "1"
+    os.environ.pop("SEAWEEDFS_TPU_TRACE", None)
+    tmp = tmp_path_factory.mktemp("events-smoke")
+    port = rpc.free_port()
+    master = MasterServer(port=port, volume_size_limit_mb=16,
+                          meta_dir=str(tmp / "meta"),
+                          pulse_seconds=60,
+                          peers=[f"http://127.0.0.1:{port}"])
+    master.start()
+    deadline = time.time() + 15
+    while not master.is_leader():
+        if time.time() > deadline:
+            raise TimeoutError("single-node raft never elected")
+        time.sleep(0.05)
+    servers = []
+    for i in range(2):
+        d = tmp / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)],
+                          max_volume_counts=[200], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    stub = rpc.JsonHttpServer()
+    stub.route("GET", "/ping", lambda q, b: {"pong": True})
+    stub.start()
+    client = WeedClient(master.url())
+    yield master, servers, stub, client, tmp
+    stub.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+_COLLECTION_N = [0]
+
+
+def _new_volume(cl, prefix: str):
+    """One fresh volume with a needle in it; returns (vid, holder_url,
+    fid).  Uses /vol/grow?count=1 so each driver costs one volume, not
+    a 7-volume layout growth."""
+    master, _servers, _stub, client, _tmp = cl
+    _COLLECTION_N[0] += 1
+    col = f"{prefix}{_COLLECTION_N[0]}"
+    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}",
+             "POST")
+    a = rpc.call(f"{master.url()}/dir/assign?collection={col}")
+    rpc.call(f"http://{a['url']}/{a['fid']}", "POST",
+             b"event journal payload " * 64)
+    return int(a["fid"].split(",")[0]), a["url"], a["fid"]
+
+
+def _drive_volume_assign(cl):
+    master, _s, _st, _c, _t = cl
+    _COLLECTION_N[0] += 1
+    rpc.call(f"{master.url()}/dir/assign?"
+             f"collection=assigncol{_COLLECTION_N[0]}")
+
+
+def _drive_volume_grow(cl):
+    _drive_volume_assign(cl)  # an assign with no writable volume grows
+
+
+def _drive_volume_readonly(cl):
+    vid, url, _fid = _new_volume(cl, "rocol")
+    rpc.call_json(f"http://{url}/admin/readonly", "POST",
+                  {"volume": vid, "readonly": True})
+
+
+def _drive_volume_vacuum(cl):
+    _master, _s, _st, client, _t = cl
+    vid, url, fid = _new_volume(cl, "vaccol")
+    rpc.call(f"http://{url}/{fid}", "DELETE")
+    rpc.call_json(f"http://{url}/admin/vacuum", "POST", {"volume": vid})
+
+
+def _drive_heartbeat_lost(cl):
+    master, servers, _st, _c, _t = cl
+    vs = servers[1]
+    dn = next(d for d in master.topo.leaves() if d.url() == vs.url())
+    dn.last_seen = 0.0
+    master._sweep_dead_nodes()
+    vs._send_heartbeat(full=True)  # restore for later drivers
+
+
+def _drive_heartbeat_recovered(cl):
+    _drive_heartbeat_lost(cl)  # re-registration after a sweep death
+
+
+def _drive_leader_elect(cl):
+    master, _s, _st, _c, _t = cl
+    raft = master.raft
+    with raft._lock:
+        raft._become_follower(raft.current_term + 1, None)
+    deadline = time.time() + 15
+    while not master.is_leader():
+        if time.time() > deadline:
+            raise TimeoutError("raft never re-elected")
+        time.sleep(0.05)
+
+
+def _drive_leader_stepdown(cl):
+    _drive_leader_elect(cl)  # the forced step-down emits it
+
+
+def _drive_ec_encode(cl):
+    vid, url, _fid = _new_volume(cl, "eccol")
+    rpc.call_json(f"http://{url}/admin/ec/generate", "POST",
+                  {"volume": vid})
+
+
+def _drive_ec_rebuild(cl):
+    vid, url, _fid = _new_volume(cl, "ecrcol")
+    rpc.call_json(f"http://{url}/admin/ec/generate", "POST",
+                  {"volume": vid})
+    # Real shard loss: two of the 14 shard files gone, then rebuild.
+    rpc.call_json(f"http://{url}/admin/ec/delete_shards", "POST",
+                  {"volume": vid, "shards": [3, 7]})
+    out = rpc.call_json(f"http://{url}/admin/ec/rebuild", "POST",
+                        {"volume": vid})
+    assert sorted(out["rebuilt_shards"]) == [3, 7]
+
+
+def _drive_breaker_open(cl):
+    _m, _s, stub, _c, _t = cl
+    hostport = f"127.0.0.1:{stub.port}"
+    fault.arm("rpc.connect", f"fail~{hostport}")
+    try:
+        with root_span("drive.breaker_open", "test"):
+            for _ in range(resilience.BREAKER_THRESHOLD):
+                with pytest.raises(ConnectionError):
+                    rpc.call(f"http://{hostport}/ping")
+        assert resilience.breaker_for(hostport).state == "open"
+    finally:
+        fault.disarm_all()
+        resilience.reset_breakers()
+
+
+def _drive_breaker_half_open(cl):
+    b = resilience.CircuitBreaker(threshold=1, cooldown=0.05,
+                                  host="probe.test:1")
+    with root_span("drive.breaker_half_open", "test"):
+        b.record_failure()
+        time.sleep(0.06)
+        assert b.allow()             # the half-open probe
+        assert b.state == "half-open"
+
+
+def _drive_breaker_close(cl):
+    b = resilience.CircuitBreaker(threshold=1, cooldown=0.05,
+                                  host="close.test:1")
+    with root_span("drive.breaker_close", "test"):
+        b.record_failure()
+        time.sleep(0.06)
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed"
+
+
+def _drive_replication_rollback(cl):
+    master, _s, _st, _c, _t = cl
+    _COLLECTION_N[0] += 1
+    a = rpc.call(f"{master.url()}/dir/assign?replication=001"
+                 f"&collection=repcol{_COLLECTION_N[0]}")
+    fault.arm("volume.replicate", "fail*1")
+    try:
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.call(f"http://{a['url']}/{a['fid']}", "POST", b"x")
+        assert ei.value.status == 500
+    finally:
+        fault.disarm_all()
+
+
+def _drive_fault_injected(cl):
+    _m, _s, _st, client, _t = cl
+    _vid, url, fid = _new_volume(cl, "faultcol")
+    fault.arm("volume.read", "status:500*1")
+    try:
+        with pytest.raises(rpc.RpcError):
+            rpc.call(f"http://{url}/{fid}")
+    finally:
+        fault.disarm_all()
+
+
+def _drive_tier_move(cl):
+    _m, _s, _st, _c, tmp = cl
+    vid, url, _fid = _new_volume(cl, "tiercol")
+    rpc.call_json(f"http://{url}/admin/readonly", "POST",
+                  {"volume": vid, "readonly": True})
+    rpc.call_json(f"http://{url}/admin/tier_upload", "POST",
+                  {"volume": vid, "dest": f"local://{tmp}/tier"})
+    rpc.call_json(f"http://{url}/admin/tier_download", "POST",
+                  {"volume": vid})
+
+
+DRIVERS = {
+    "volume.assign": _drive_volume_assign,
+    "volume.grow": _drive_volume_grow,
+    "volume.readonly": _drive_volume_readonly,
+    "volume.vacuum": _drive_volume_vacuum,
+    "heartbeat.lost": _drive_heartbeat_lost,
+    "heartbeat.recovered": _drive_heartbeat_recovered,
+    "leader.elect": _drive_leader_elect,
+    "leader.stepdown": _drive_leader_stepdown,
+    "ec.encode.start": _drive_ec_encode,
+    "ec.encode.finish": _drive_ec_encode,
+    "ec.rebuild.start": _drive_ec_rebuild,
+    "ec.rebuild.finish": _drive_ec_rebuild,
+    "breaker.open": _drive_breaker_open,
+    "breaker.half_open": _drive_breaker_half_open,
+    "breaker.close": _drive_breaker_close,
+    "replication.rollback": _drive_replication_rollback,
+    "fault.injected": _drive_fault_injected,
+    "tier.move": _drive_tier_move,
+}
+
+
+def test_driver_catalog_matches_registry():
+    """Adding an event type without an emission driver (or vice versa)
+    fails here: the catalog and the smoke suite move in lockstep."""
+    assert set(DRIVERS) == set(TYPES)
+
+
+@pytest.mark.parametrize("etype", sorted(TYPES))
+def test_every_event_type_is_emitted(cluster, etype):
+    """Drive the real code path hosting each event's emit site, observe
+    the event land in the journal with a non-empty trace id (tracing is
+    on for this cluster).  An emit site that code motion orphaned shows
+    up as zero new events."""
+    before_seq = JOURNAL._seq
+    before = events.events_total.value(type=etype)
+    DRIVERS[etype](cluster)
+    after = events.events_total.value(type=etype)
+    assert after > before, f"event type {etype} never emitted"
+    fresh = [ev for ev in JOURNAL.snapshot(type_=etype)
+             if ev["seq"] > before_seq]
+    assert fresh, f"no fresh {etype} event in the ring"
+    for ev in fresh:
+        assert ev["trace_id"], \
+            f"{etype} emitted without a trace id: {ev}"
+        assert ev["severity"] in events.SEVERITIES
+
+
+# -- health rollup -----------------------------------------------------------
+
+def test_healthz_degraded_then_repaired(cluster):
+    """The acceptance flow: a mounted EC volume loses shards ->
+    /cluster/healthz turns 503 and cluster.check names the degraded
+    volume; after repair both report healthy."""
+    master, servers, _st, _c, _t = cluster
+    vid, url, _fid = _new_volume(cluster, "healthec")
+    rpc.call_json(f"http://{url}/admin/ec/generate", "POST",
+                  {"volume": vid})
+    rpc.call_json(f"http://{url}/admin/ec/mount", "POST",
+                  {"volume": vid})
+    status, doc = rpc.call_status(f"{master.url()}/cluster/healthz")
+    assert status == 200 and doc["healthy"], doc["problems"]
+
+    rpc.call_json(f"http://{url}/admin/ec/delete_shards", "POST",
+                  {"volume": vid, "shards": [2, 5]})
+    status, doc = rpc.call_status(f"{master.url()}/cluster/healthz")
+    assert status == 503 and not doc["healthy"]
+    assert any(f"ec volume {vid}" in p and "degraded" in p
+               for p in doc["problems"]), doc["problems"]
+    row = next(v for v in doc["ec_volumes"] if v["id"] == vid)
+    assert row["missing"] == [2, 5] and row["present"] == 12
+    # Node rows carry the heartbeat-fed disk status.
+    assert any(d.get("percent_used") is not None
+               for n in doc["nodes"] for d in n["disks"])
+
+    env = CommandEnv(master.url())
+    try:
+        out = run_command(env, "cluster.check")
+        assert "UNHEALTHY" in out
+        assert f"ec volume {vid}" in out and "degraded" in out
+
+        # Repair: rebuild the lost shards and remount.
+        rpc.call_json(f"http://{url}/admin/ec/rebuild", "POST",
+                      {"volume": vid})
+        rpc.call_json(f"http://{url}/admin/ec/mount", "POST",
+                      {"volume": vid})
+        status, doc = rpc.call_status(
+            f"{master.url()}/cluster/healthz")
+        assert status == 200 and doc["healthy"], doc["problems"]
+        out = run_command(env, "cluster.check")
+        assert out.startswith("HEALTHY")
+    finally:
+        env.close()
+
+
+def test_events_ls_and_cluster_aggregation(cluster):
+    master, _s, _st, _c, _t = cluster
+    env = CommandEnv(master.url())
+    try:
+        out = run_command(env, "events.ls -limit 500")
+        assert "volume.assign" in out and "heartbeat.lost" in out
+        out = run_command(env, "events.ls -types")
+        for t in TYPES:
+            assert t in out
+        out = run_command(env, "events.ls -type volume.grow")
+        lines = [ln for ln in out.splitlines()[1:] if ln.strip()]
+        assert lines and all("volume.grow" in ln for ln in lines)
+        with pytest.raises(Exception):
+            run_command(env, "events.ls -type bogus")
+    finally:
+        env.close()
+    # Master-side aggregation endpoint (single timeline, deduplicated).
+    out = rpc.call(f"{master.url()}/cluster/events?limit=1000")
+    assert out["servers_reached"] >= 1
+    types = {e["type"] for e in out["events"]}
+    assert "volume.assign" in types and "ec.encode.finish" in types
+    ts = [e["ts"] for e in out["events"]]
+    assert ts == sorted(ts)  # one merged, ordered timeline
+
+
+def test_node_health_gauge_and_live_scrapes_validate(cluster):
+    """Every live role's /metrics carries the events counter and passes
+    the promtool-style validator after the full smoke drove real
+    traffic through it."""
+    master, servers, _st, _c, _t = cluster
+    mtext = rpc.call(f"{master.url()}/metrics").decode()
+    assert "SeaweedFS_events_total" in mtext
+    assert 'SeaweedFS_node_health{node="' in mtext
+    assert "SeaweedFS_node_health" in mtext
+    for vs in servers:
+        vtext = rpc.call(f"http://{vs.url()}/metrics").decode()
+        assert "SeaweedFS_disk_percent_used" in vtext
+        assert "SeaweedFS_disk_all_bytes" in vtext
+        assert "SeaweedFS_disk_used_bytes" in vtext
+        assert validate_exposition(vtext) == [], vs.url()
+    assert validate_exposition(mtext) == []
+
+
+# -- cross-process aggregation -----------------------------------------------
+
+def test_cluster_events_aggregates_across_processes(tmp_path):
+    """A volume server in a SEPARATE process: its journal entries are
+    only reachable over HTTP, so /cluster/events must pull and merge
+    them — in-process sharing can't fake this one."""
+    import subprocess
+    import sys
+
+    master = MasterServer(volume_size_limit_mb=16,
+                          meta_dir=str(tmp_path / "meta"),
+                          pulse_seconds=60)
+    master.start()
+    vport = rpc.free_port()
+    data = tmp_path / "vsdata"
+    data.mkdir()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "volume",
+         f"-port={vport}", f"-dir={data}", "-max=8",
+         f"-mserver=127.0.0.1:{master.server.port}"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 30
+        while not list(master.topo.leaves()):
+            if time.time() > deadline:
+                raise TimeoutError("subprocess volume server never "
+                                   "registered")
+            time.sleep(0.2)
+        rpc.call(f"{master.url()}/vol/grow?count=1", "POST")
+        vol_list = rpc.call(f"{master.url()}/vol/list")
+        node = vol_list["topology"]["data_centers"][0]["racks"][0][
+            "nodes"][0]
+        vid = node["volumes"][0]["id"]
+        # Emit an event INSIDE the subprocess (its own journal).
+        rpc.call_json(f"http://127.0.0.1:{vport}/admin/readonly",
+                      "POST", {"volume": vid, "readonly": True})
+        out = rpc.call(f"{master.url()}/cluster/events"
+                       f"?type=volume.readonly")
+        assert any(e["node"] == f"127.0.0.1:{vport}"
+                   and e["attrs"].get("vid") == vid
+                   for e in out["events"]), out
+        assert out["servers_reached"] >= 2
+        # The master's own journal contributes too: one timeline.
+        out = rpc.call(f"{master.url()}/cluster/events?limit=1000")
+        types = {e["type"] for e in out["events"]}
+        assert "heartbeat.recovered" in types
+        assert "volume.assign" in types
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        master.stop()

@@ -190,8 +190,17 @@ def test_partition_heal_cycles_converge_identically(tmp_path):
             net.isolate(victim.id, urls)
             time.sleep(0.6)
             net.heal()
-        leader = _wait_leader(nodes, timeout=15)
-        leader.propose({"v": "fin"}, timeout=10)
+        # Post-heal election churn can depose the leader between the
+        # wait and the propose; re-resolve and retry like a client.
+        for _attempt in range(5):
+            leader = _wait_leader(nodes, timeout=15)
+            try:
+                leader.propose({"v": "fin"}, timeout=10)
+                break
+            except (TimeoutError, NotLeader):
+                time.sleep(0.2)
+        else:
+            raise AssertionError("fin never committed")
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
             tails = [_vals(s) for s in sinks]

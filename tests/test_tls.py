@@ -142,9 +142,8 @@ ca = "{certs / 'ca.crt'}"
 cert = "{certs / 'server.crt'}"
 key  = "{certs / 'server.key'}"
 '''
-    import tomllib
-
-    from seaweedfs_tpu.utils.config import Configuration
+    from seaweedfs_tpu.utils.config import (Configuration,
+                                            tomllib)  # tomli fallback on 3.10
     cfg = Configuration(tomllib.loads(cfg_text))
     ctx = load_server_tls(cfg, "s3")
     assert ctx.verify_mode == ssl.CERT_NONE
@@ -154,9 +153,8 @@ key  = "{certs / 'server.key'}"
 
 
 def test_client_auth_validation(certs):
-    import tomllib
-
-    from seaweedfs_tpu.utils.config import Configuration
+    from seaweedfs_tpu.utils.config import (Configuration,
+                                            tomllib)  # tomli fallback on 3.10
     bad = Configuration(tomllib.loads(f'''
 [grpc.master]
 cert = "{certs / 'server.crt'}"
