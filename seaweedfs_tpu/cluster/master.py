@@ -38,7 +38,8 @@ def _vinfo_from_dict(d: dict) -> VolumeInfo:
         replica_placement=d.get("replica_placement", 0),
         ttl=d.get("ttl", 0), compact_revision=d.get("compact_revision", 0),
         max_file_key=d.get("max_file_key", 0),
-        version=d.get("version", 3))
+        version=d.get("version", 3),
+        corrupt_count=d.get("corrupt_count", 0))
 
 
 def vinfo_to_dict(v: VolumeInfo) -> dict:
@@ -50,6 +51,7 @@ def vinfo_to_dict(v: VolumeInfo) -> dict:
         "replica_placement": v.replica_placement, "ttl": v.ttl,
         "compact_revision": v.compact_revision,
         "max_file_key": v.max_file_key, "version": v.version,
+        "corrupt_count": v.corrupt_count,
     }
 
 
@@ -424,6 +426,11 @@ class MasterServer:
             # rides every heartbeat — the health rollup's capacity view.
             if "disks" in hb:
                 dn.disk_statuses = hb["disks"]
+            if "ec_corrupt" in hb:
+                # vid -> unrepaired corrupt shard blocks (scrub): the
+                # health rollup reports these EC volumes degraded.
+                dn.ec_corrupt = {int(k): v for k, v in
+                                 hb["ec_corrupt"].items()}
             seq = hb.get("seq")
             if seq is not None:
                 # The epoch changes when the volume server restarts, so
@@ -809,12 +816,27 @@ class MasterServer:
                     problems.append(
                         f"node {dn.url()}: disk {d.get('dir', '?')} "
                         f"{d['percent_used']:.1f}% full")
+            for vid, cnt in sorted(getattr(dn, "ec_corrupt",
+                                           {}).items()):
+                problems.append(
+                    f"ec volume {vid}: {cnt} corrupt shard block(s) "
+                    f"on {dn.url()} unrepaired")
             for v in list(dn.volumes.values()):
                 ratio = (v.deleted_byte_count / v.size) if v.size else 0.0
                 volumes.append({"id": v.id, "node": dn.url(),
                                 "collection": v.collection,
                                 "read_only": v.read_only,
+                                "corrupt": v.corrupt_count,
                                 "garbage_ratio": round(ratio, 4)})
+                if v.corrupt_count:
+                    # Unrepaired corruption = degraded, exactly like
+                    # missing EC shards: the data is at reduced
+                    # redundancy until the scrub (or an operator
+                    # volume.scrub -repair) heals it.
+                    problems.append(
+                        f"volume {v.id} on {dn.url()}: "
+                        f"{v.corrupt_count} corrupt needle(s) "
+                        f"quarantined, unrepaired")
         if not leaves:
             problems.append("no live data nodes")
         ec_volumes = []

@@ -33,10 +33,12 @@ from ..ec.shard_bits import ShardBits
 from ..ec.volume import EcVolume, NeedleNotFound
 from ..events import emit as emit_event
 from ..fault import registry as _fault
-from ..stats.metrics import observe_ec_stage
+from ..stats.metrics import needle_repairs_total, observe_ec_stage
+from ..storage.scrub import ScrubDaemon
 from ..storage.store import Store
 from ..storage.vacuum import vacuum as vacuum_volume
-from ..storage.volume import NotFoundError, VolumeError
+from ..storage.volume import (CorruptNeedleError, NotFoundError,
+                              VolumeError)
 from ..trace import span as trace_span
 from . import rpc
 
@@ -51,7 +53,10 @@ class VolumeServer:
                  pulse_seconds: int = 2,
                  jwt_signing_key: str = "",
                  ssl_context=None,
-                 read_redirect: bool = True):
+                 read_redirect: bool = True,
+                 scrub_mbps: float = 32.0,
+                 scrub_interval: float = 3600.0,
+                 fsync: bool = False):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -94,6 +99,18 @@ class VolumeServer:
         self._ec_read_pool: concurrent.futures.ThreadPoolExecutor | None = None
         self._ec_pool_lock = threading.Lock()
         self._load_ec_volumes()
+        # -fsync: force per-write durability (every POST behaves like
+        # ?fsync=true — zero-loss acks for users who want them).
+        self.fsync_writes = fsync
+        # Background integrity sweep + self-healing (storage/scrub.py):
+        # repairs route through this server because they need master
+        # lookups (replica fetch) and the EC shard fan-out (decode).
+        self.scrub = ScrubDaemon(
+            self.store, self.ec_volumes, node=self.url(),
+            mbps=scrub_mbps, interval=scrub_interval,
+            repair_needle=self._repair_needle_from_replica,
+            repair_ec_block=self._repair_ec_block,
+            on_change=lambda: self._send_heartbeat(full=True))
         s = self.server
         s.route("GET", "/admin/status", self._admin_status)
         s.route("POST", "/admin/status", self._admin_status)
@@ -112,6 +129,10 @@ class VolumeServer:
         s.route("POST", "/admin/configure_replication",
                 self._admin_configure_replication)
         s.route("POST", "/admin/vacuum", self._admin_vacuum)
+        s.route("POST", "/admin/scrub", self._admin_scrub)
+        s.route("GET", "/admin/scrub/status", self._admin_scrub_status)
+        s.route("POST", "/admin/scrub/repair", self._admin_scrub_repair)
+        s.route("GET", "/admin/needle_raw", self._admin_needle_raw)
         s.route("POST", "/admin/ec/generate", self._ec_generate)
         s.route("POST", "/admin/ec/mount", self._ec_mount)
         s.route("POST", "/admin/ec/unmount", self._ec_unmount)
@@ -149,9 +170,11 @@ class VolumeServer:
         self.server.start()
         self._send_heartbeat(full=True)
         self._hb_thread.start()
+        self.scrub.start()
 
     def stop(self) -> None:
         self._stop.set()
+        self.scrub.stop()
         self.server.stop()
         with self._ec_pool_lock:
             if self._ec_read_pool is not None:
@@ -230,6 +253,16 @@ class VolumeServer:
         from ..stats.metrics import ec_stage_bytes, ec_stage_seconds
         reg.register(ec_stage_seconds)
         reg.register(ec_stage_bytes)
+        # Scrub + self-healing instruments (process-global singletons,
+        # storage/scrub.py) on this server's scrape.
+        from ..stats.metrics import (scrub_bytes_total,
+                                     scrub_checked_total,
+                                     scrub_corrupt_total,
+                                     scrub_sweeps_total)
+        for m in (scrub_checked_total, scrub_bytes_total,
+                  scrub_corrupt_total, scrub_sweeps_total,
+                  needle_repairs_total):
+            reg.register_once(m)
 
     # -- heartbeats ---------------------------------------------------------
 
@@ -279,6 +312,9 @@ class VolumeServer:
                                         for l in self.store.locations),
                 "ec_shards": self._ec_shard_infos(),
                 "disks": self._disk_statuses(),
+                # Detected-but-unrepaired EC shard corruption (scrub):
+                # the master's healthz reports these volumes degraded.
+                "ec_corrupt": self.scrub.ec_corrupt_counts(),
             }
             if full:
                 hb["volumes"] = [
@@ -404,6 +440,11 @@ class VolumeServer:
                 n = self.store.read_needle(vid, key, cookie)
             except NotFoundError as e:
                 raise rpc.RpcError(404, str(e)) from None
+            except CorruptNeedleError as e:
+                # A probe must answer what IS here: 503 flags a rotten
+                # local copy so fsck/replica-repair treat this holder
+                # as unhealthy without transferring a body.
+                raise rpc.RpcError(503, str(e)) from None
             except VolumeError as e:
                 raise rpc.RpcError(403, str(e)) from None
             size = len(n.data)
@@ -476,6 +517,11 @@ class VolumeServer:
                                              min_size=self.SENDFILE_MIN)
                 except NotFoundError as e:
                     raise rpc.RpcError(404, str(e)) from None
+                except (CorruptNeedleError, OSError) as e:
+                    # Degraded read: heal in line and serve the
+                    # repaired bytes rather than erroring.
+                    n = self._degraded_read(v, vid, key, cookie, e)
+                    return self._serve_needle(n, query)
                 except VolumeError as e:
                     raise rpc.RpcError(403, str(e)) from None
                 if sl is not None:
@@ -513,7 +559,17 @@ class VolumeServer:
             try:
                 n = self.store.read_needle(vid, key, cookie)
             except NotFoundError as e:
-                raise rpc.RpcError(404, str(e)) from None
+                if key in v.repair_tickets:
+                    # Quarantined (tombstoned) corrupt needle: a
+                    # replica may still hold it — degraded read.
+                    n = self._degraded_read(v, vid, key, cookie, e)
+                else:
+                    raise rpc.RpcError(404, str(e)) from None
+            except (CorruptNeedleError, OSError) as e:
+                # CRC failure or a dying sector on the read path: the
+                # same self-healing repair the scrub uses, in line —
+                # the client gets the repaired bytes, not an error.
+                n = self._degraded_read(v, vid, key, cookie, e)
             except VolumeError as e:
                 raise rpc.RpcError(403, str(e)) from None
         return self._serve_needle(n, query)
@@ -572,7 +628,10 @@ class VolumeServer:
         it is octet-stream, and a named needle gets inline/attachment
         disposition (?dl=true).  Returns (headers, not_modified)."""
         from email.utils import formatdate, parsedate_to_datetime
-        hdrs = {"ETag": f'"{etag}"'}
+        # The stored CRC as an explicit header on HEAD and GET alike:
+        # volume.fsck -crc and replica repair compare content identity
+        # across holders without bodies (and without unquoting ETags).
+        hdrs = {"ETag": f'"{etag}"', "X-Needle-Checksum": etag}
         if last_modified:
             hdrs["Last-Modified"] = formatdate(last_modified,
                                                usegmt=True)
@@ -725,10 +784,20 @@ class VolumeServer:
                                               size)
         if data is not None:
             return data
-        # 3. reconstruct from >=10 other shard intervals.  Fan the reads
-        # out in parallel — latency is the slowest single fetch, not the
-        # sum of 13 round-trips (store_ec.go:322-376 launches one
-        # goroutine per shard; recoverOneRemoteEcShardInterval).
+        # 3. reconstruct from >=10 other shard intervals.
+        return self._reconstruct_shard_interval(ev, sid, off, size)
+
+    def _reconstruct_shard_interval(self, ev: EcVolume, sid: int,
+                                    off: int, size: int) -> bytes:
+        """One shard interval through the decode path: gather the SAME
+        byte range from >=10 sibling shards (local files first, then
+        remote holders) and solve wanted=[sid] on the device coder.
+        Fan the reads out in parallel — latency is the slowest single
+        fetch, not the sum of 13 round-trips (store_ec.go:322-376
+        launches one goroutine per shard;
+        recoverOneRemoteEcShardInterval).  Shared by the degraded read
+        ladder and the scrub's corrupt-block repair."""
+        locations = self._ec_shard_locations(ev.vid)
         with trace_span("ec.reconstruct", vid=ev.vid, shard=sid,
                         size=size) as rspan:
             # Pool threads have no thread-local trace context — hand
@@ -817,6 +886,157 @@ class VolumeServer:
                 continue
         return None
 
+    # -- self-healing repair (the scrub daemon calls back here) --------------
+
+    def _degraded_read(self, v, vid: int, key: int,
+                       cookie: int | None, err: Exception) -> Needle:
+        """Read-path fallback: a CRC-failing (or unreadable, or
+        quarantined) needle triggers the same repair the scrub uses,
+        inline, and the request is served the repaired bytes — a
+        degraded read, not an error (store_ec.go's degraded ladder
+        applied to replication)."""
+        emit_event("needle.corrupt", node=self.url(), severity="error",
+                   vid=vid, key=f"{key:x}", kind="needle", path="read",
+                   error=str(err)[:200])
+        n = self._repair_needle_from_replica(v, key)
+        if n is None:
+            if isinstance(err, CorruptNeedleError):
+                # Proven rot with no healthy source: quarantine so the
+                # bad bytes are never served, and report degraded.
+                if v.quarantine_needle(key, node=self.url()):
+                    self._send_heartbeat(full=True)
+            raise rpc.RpcError(
+                500, f"needle {key:x} corrupt/unreadable and no "
+                     f"replica could repair it: {err}")
+        if cookie is not None and n.cookie != cookie:
+            raise rpc.RpcError(403,
+                               f"cookie mismatch for needle {key:x}")
+        return n
+
+    def _repair_needle_from_replica(self, v, key: int) -> Needle | None:
+        """Fetch the raw CRC-verified record of one needle from a
+        healthy sibling replica (/admin/needle_raw — which never
+        serves rotten bytes) and rewrite it in place, closing the
+        repair ticket.  Returns the healed Needle, or None when no
+        replica could supply a sound copy."""
+        vid = v.vid
+        try:
+            lookup = self._lookup_volume(vid)
+        except Exception:  # noqa: BLE001 — master down: cannot locate
+            return None
+        me = self.url()
+        for loc in lookup.get("locations", []):
+            url = loc.get("url")
+            if not url or url == me:
+                continue
+            try:
+                blob = rpc.call(f"http://{url}/admin/needle_raw?"
+                                f"volume={vid}&key={key}")
+                n = Needle.from_bytes(bytes(blob), v.version)
+            except Exception:  # noqa: BLE001 — next replica
+                continue
+            if n.id != key:
+                continue
+            v.repair_needle(n)
+            needle_repairs_total.inc(source="replica")
+            emit_event("needle.repaired", node=me, vid=vid,
+                       key=f"{key:x}", source="replica", replica=url)
+            return n
+        return None
+
+    def _repair_ec_block(self, ev: EcVolume, sid: int, offset: int,
+                         size: int, block_index: int,
+                         want_crc: int) -> bool:
+        """Reconstruct one corrupt shard block through the EC decode
+        path (>=10 sibling shard intervals -> one GF solve on the
+        device coder) and pwrite it back in place — ONLY if the
+        reconstruction reproduces the recorded checksum.  A wrong
+        solve (a second, still-undetected corrupt source shard) must
+        leave the original bytes untouched: overwriting a 1-bit flip
+        with fresh garbage would destroy evidence a later repair
+        round could still use."""
+        from ..core.crc import crc32c
+        try:
+            data = self._reconstruct_shard_interval(ev, sid, offset,
+                                                    size)
+        except Exception:  # noqa: BLE001 — not enough healthy shards
+            return False
+        shard = ev.shards.get(sid)
+        if shard is None or len(data) != size or \
+                crc32c(data) != want_crc:
+            return False
+        with open(shard.path, "r+b") as f:
+            os.pwrite(f.fileno(), data, offset)
+            os.fsync(f.fileno())
+        needle_repairs_total.inc(source="ec")
+        emit_event("needle.repaired", node=self.url(), vid=ev.vid,
+                   shard=sid, block=block_index, source="ec",
+                   bytes=size)
+        return True
+
+    def _admin_scrub(self, query: dict, body: bytes) -> dict:
+        """POST /admin/scrub {volume?, repair?}: run one integrity
+        sweep now (volume.scrub shell command, tests).  The follow-up
+        full heartbeat republishes corrupt counts so /cluster/healthz
+        reflects the sweep immediately."""
+        req = json.loads(body) if body else {}
+        out = self.scrub.scrub_all(repair=bool(req.get("repair")),
+                                   vid=req.get("volume"))
+        self._send_heartbeat(full=True)
+        return out
+
+    def _admin_scrub_status(self, query: dict, body: bytes) -> dict:
+        volumes = []
+        for loc in self.store.locations:
+            for v in loc.volumes.values():
+                volumes.append({
+                    "id": v.vid, "last_scrub": v.last_scrub,
+                    "corrupt_count": v.corrupt_count(),
+                    "tickets": sorted(f"{k:x}"
+                                      for k in v.repair_tickets)})
+        return {"volumes": volumes,
+                "ec_corrupt": {str(vid): [list(b) for b in blocks]
+                               for vid, blocks in
+                               self.scrub.ec_corrupt_snapshot().items()}}
+
+    def _admin_scrub_repair(self, query: dict, body: bytes) -> dict:
+        """POST /admin/scrub/repair {volume, key}: targeted repair of
+        one needle from a replica — volume.check.disk drives this to
+        sync a replica that diverged (missing/rotten needle)."""
+        req = json.loads(body)
+        v = self.store.find_volume(req["volume"])
+        if v is None:
+            raise rpc.RpcError(404,
+                               f"volume {req['volume']} not here")
+        key = int(req["key"])
+        n = self._repair_needle_from_replica(v, key)
+        if n is None:
+            raise rpc.RpcError(
+                500, f"needle {key:x}: no replica could supply a "
+                     f"healthy copy")
+        self._send_heartbeat(full=True)
+        return {"volume": v.vid, "key": f"{key:x}",
+                "size": len(n.data)}
+
+    def _admin_needle_raw(self, query: dict, body: bytes):
+        """GET /admin/needle_raw?volume=&key=: the raw CRC-verified
+        record bytes of one live needle — what a sibling pulls to heal
+        its copy.  Never serves rotten bytes: a local CRC failure is a
+        503, so replica repair cannot propagate corruption."""
+        vid = int(query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise rpc.RpcError(404, f"volume {vid} not on this server")
+        try:
+            blob = v.read_needle_blob(int(query["key"]))
+        except NotFoundError as e:
+            raise rpc.RpcError(404, str(e)) from None
+        except (CorruptNeedleError, OSError) as e:
+            raise rpc.RpcError(503, str(e)) from None
+        return (200, blob,
+                {"Content-Type": "application/octet-stream",
+                 "X-Volume-Version": str(v.version)})
+
     def _ui(self, query: dict, body: bytes):
         """Status page (the reference's volume UI, server/volume_ui)."""
         from html import escape as esc
@@ -904,7 +1124,8 @@ class VolumeServer:
         # ?fsync=true (the flag is forwarded to replicas in _replicate
         # so every copy honors it).
         _offset, size = self.store.write_needle(
-            vid, n, fsync=query.get("fsync") == "true")
+            vid, n, fsync=self.fsync_writes or
+            query.get("fsync") == "true")
         if query.get("type") != "replicate":
             try:
                 self._replicate(path, query, body, "POST", vid=vid,
@@ -1208,6 +1429,12 @@ class VolumeServer:
         vid, shard_ids = req["volume"], req["shards"]
         base = self._volume_base(vid)
         ev = self.ec_volumes.get(vid)
+        from ..ec.integrity import ShardChecksums, ecc_lock
+        with ecc_lock(base):
+            ecc = ShardChecksums.load(base)
+            for sid in shard_ids:
+                ecc.drop_shard(sid)
+            ecc.save()
         for sid in shard_ids:
             if ev is not None and sid in ev.shards:
                 ev.shards.pop(sid).close()
@@ -1223,7 +1450,7 @@ class VolumeServer:
             ev = self.ec_volumes.pop(vid, None)
             if ev is not None:
                 ev.close()
-            for ext in (".ecx", ".ecj", ".vif"):
+            for ext in (".ecx", ".ecj", ".vif", ".ecc"):
                 try:
                     os.remove(base + ext)
                 except FileNotFoundError:
@@ -1262,10 +1489,19 @@ class VolumeServer:
         shard_ids = req["shards"]
         base = self._volume_base(vid)
         os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+        from ..ec.integrity import ShardChecksums, ecc_lock
         for sid in shard_ids:
             rpc.call_to_file(f"http://{source}/admin/ec/shard_file?"
                              f"volume={vid}&shard={sid}",
                              base + to_ext(sid))
+        with ecc_lock(base):
+            ecc = ShardChecksums.load(base)
+            for sid in shard_ids:
+                # The pull replaced the shard bytes: any recorded
+                # checksum is stale — drop it so the next scrub
+                # fingerprints the fresh copy (trust-on-first-scrub).
+                ecc.drop_shard(sid)
+            ecc.save()
         if req.get("copy_ecx", False):
             for ext in (".ecx", ".ecj", ".vif"):
                 try:
@@ -1300,14 +1536,26 @@ class VolumeServer:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, base + to_ext(sid))
+        # Per-volume serialization: the shared .ecc sidecar update is
+        # load-modify-save, and concurrent receives for the same
+        # volume must not lose each other's entries (receives for
+        # OTHER volumes shouldn't stall behind this).
+        with self._ec_recv_lock:
+            vlock = self._ec_recv_vlocks.setdefault(
+                vid, threading.Lock())
+        from ..ec.integrity import (BlockCrcAccumulator,
+                                    ShardChecksums, ecc_lock)
+        with vlock, ecc_lock(base):
+            # Fingerprint the pushed bytes so the scrub can verify
+            # this shard from its first sweep (the body IS the
+            # intended content; ec/integrity.py).
+            ecc = ShardChecksums.load(base)
+            acc = BlockCrcAccumulator(ecc.block)
+            acc.feed(body)
+            ecc.set_shard(sid, acc.finalize())
+            ecc.save()
         source = query.get("ecx_source", "")
         if source:
-            # Per-volume serialization: concurrent receives for the same
-            # volume must not double-pull the sidecars, but receives for
-            # OTHER volumes shouldn't stall behind these downloads.
-            with self._ec_recv_lock:
-                vlock = self._ec_recv_vlocks.setdefault(
-                    vid, threading.Lock())
             with vlock:
                 if not os.path.exists(base + ".ecx"):
                     for ext in (".ecx", ".vif", ".ecj"):

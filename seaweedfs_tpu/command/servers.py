@@ -163,7 +163,15 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         rack=flags.get("rack", "DefaultRack"),
         jwt_signing_key=flags.get("jwt.key", ""),
         ssl_context=_security("volume"),
-        read_redirect=flags.get_bool("read.redirect", True))
+        read_redirect=flags.get_bool("read.redirect", True),
+        # Data-integrity knobs: -fsync forces per-write durability
+        # (every POST acks only after .dat AND .idx are fsynced);
+        # -scrub.mbps bounds the background integrity sweep's disk
+        # bandwidth and -scrub.interval its cadence (0 = on-demand
+        # only via volume.scrub / POST /admin/scrub).
+        fsync=flags.get_bool("fsync", False),
+        scrub_mbps=flags.get_float("scrub.mbps", 32.0),
+        scrub_interval=flags.get_float("scrub.interval", 3600.0))
     vs.start()
     glog.infof("volume server serving at %s (dirs %s)",
                vs.server.url(), dirs)
@@ -270,7 +278,11 @@ def run_server(flags: Flags, args: list[str]) -> int:
                       data_center=flags.get("dataCenter",
                                             "DefaultDataCenter"),
                       rack=flags.get("rack", "DefaultRack"),
-                      ssl_context=_security("volume"))
+                      ssl_context=_security("volume"),
+                      fsync=flags.get_bool("fsync", False),
+                      scrub_mbps=flags.get_float("scrub.mbps", 32.0),
+                      scrub_interval=flags.get_float("scrub.interval",
+                                                     3600.0))
     vs.start()
     servers.append(vs)
     glog.infof("master at %s, volume at %s", m.server.url(),
@@ -320,7 +332,8 @@ def _norm_master(addr: str) -> str:
 register(Command("master", "master -port=9333 -mdir=/tmp/meta",
                  "start a master server", run_master))
 register(Command("volume",
-                 "volume -port=8080 -dir=/data -max=8 -mserver=host:9333",
+                 "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
+                 " [-fsync] [-scrub.mbps=32] [-scrub.interval=3600]",
                  "start a volume server", run_volume))
 register(Command("filer", "filer -port=8888 -master=host:9333",
                  "start a filer server", run_filer))

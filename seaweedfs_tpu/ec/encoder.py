@@ -22,6 +22,8 @@ import numpy as np
 
 from . import (DATA_SHARDS, LARGE_BLOCK_SIZE, PARITY_SHARDS,
                SMALL_BLOCK_SIZE, TOTAL_SHARDS, to_ext)
+from .integrity import BlockCrcAccumulator, ShardChecksums, ecc_lock
+from ..fault import registry as _fault
 from ..ops.erasure import ErasureCoder, new_coder
 from ..storage.needle_map import MemDb
 
@@ -39,11 +41,30 @@ def write_sorted_file_from_idx(base_file_name: str,
         out.write(db.to_sorted_bytes())
 
 
+def _shard_write(f, sid: int, buf: bytes, accs) -> None:
+    """One shard-file write: feed the integrity accumulator with the
+    TRUE bytes first, then write — possibly through the volume.corrupt
+    bit-rot injector — so the recorded `.ecc` checksums describe what
+    the encoder intended and any on-disk divergence is detectable."""
+    if accs is not None:
+        accs[sid].feed(buf)
+    if _fault.ARMED and buf:
+        try:
+            _fault.hit("volume.corrupt", shard=sid)
+        except _fault.FaultInjected:
+            b = bytearray(buf)
+            b[0] ^= 0xFF
+            buf = bytes(b)
+    f.write(buf)
+
+
 def write_ec_files(base_file_name: str, coder: ErasureCoder | None = None,
                    large_block_size: int = LARGE_BLOCK_SIZE,
                    small_block_size: int = SMALL_BLOCK_SIZE,
                    chunk_size: int = DEFAULT_CHUNK) -> None:
-    """Generate .ec00-.ec13 from the .dat (WriteEcFiles)."""
+    """Generate .ec00-.ec13 from the .dat (WriteEcFiles), plus the
+    `.ecc` per-block checksum sidecar the background scrub verifies
+    shards against (ec/integrity.py)."""
     coder = coder or new_coder(DATA_SHARDS, PARITY_SHARDS)
     if coder.data_shards != DATA_SHARDS or \
             coder.parity_shards != PARITY_SHARDS:
@@ -52,19 +73,27 @@ def write_ec_files(base_file_name: str, coder: ErasureCoder | None = None,
     dat_size = os.path.getsize(base_file_name + ".dat")
     outputs = [open(base_file_name + to_ext(i), "wb")
                for i in range(TOTAL_SHARDS)]
+    accs = [BlockCrcAccumulator() for _ in range(TOTAL_SHARDS)]
     try:
         with open(base_file_name + ".dat", "rb") as dat:
             _encode_dat_file(dat, dat_size, coder, outputs,
-                             large_block_size, small_block_size, chunk_size)
+                             large_block_size, small_block_size, chunk_size,
+                             accs=accs)
     finally:
         for f in outputs:
             f.close()
+    with ecc_lock(base_file_name):
+        ecc = ShardChecksums(base_file_name)
+        for sid, acc in enumerate(accs):
+            ecc.set_shard(sid, acc.finalize())
+        ecc.save()
 
 
 def _encode_dat_file(dat, dat_size: int, coder: ErasureCoder, outputs,
-                     large: int, small: int, chunk_size: int) -> None:
+                     large: int, small: int, chunk_size: int,
+                     accs=None) -> None:
     chunks = _chunk_reader(dat, dat_size, large, small, chunk_size)
-    _pipelined_encode(chunks, coder, outputs)
+    _pipelined_encode(chunks, coder, outputs, accs=accs)
 
 
 def _chunk_reader(dat, dat_size: int, large: int, small: int,
@@ -116,7 +145,7 @@ def _chunk_reader(dat, dat_size: int, large: int, small: int,
 
 
 def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
-                      depth: int = 2) -> None:
+                      depth: int = 2, accs=None) -> None:
     """Double-buffered encode pipeline (SURVEY §2.3 'double-buffered
     host→HBM DMA + batched kernel launches'):
 
@@ -181,7 +210,8 @@ def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
     def flush_one() -> None:
         parity = np.asarray(inflight.popleft())
         for p in range(PARITY_SHARDS):
-            outputs[DATA_SHARDS + p].write(parity[p].tobytes())
+            _shard_write(outputs[DATA_SHARDS + p], DATA_SHARDS + p,
+                         parity[p].tobytes(), accs)
 
     try:
         while True:
@@ -193,7 +223,7 @@ def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
             # the next chunk.
             inflight.append(coder.encode(data))
             for i in range(DATA_SHARDS):
-                outputs[i].write(data[i].tobytes())
+                _shard_write(outputs[i], i, data[i].tobytes(), accs)
             if len(inflight) >= depth:
                 flush_one()
         while inflight:
@@ -240,6 +270,7 @@ def rebuild_ec_files(base_file_name: str,
 
     ins = {sid: open(path, "rb") for sid, path in present.items()}
     outs = {sid: open(base_file_name + to_ext(sid), "wb") for sid in missing}
+    accs = {sid: BlockCrcAccumulator() for sid in missing}
     try:
         for off in range(0, shard_size, chunk_size):
             take = min(chunk_size, shard_size - off)
@@ -251,10 +282,19 @@ def rebuild_ec_files(base_file_name: str,
                 have[sid] = np.frombuffer(buf, dtype=np.uint8)
             rec = coder.reconstruct(have, wanted=missing)
             for sid in missing:
-                outs[sid].write(np.asarray(rec[sid]).tobytes())
+                _shard_write(outs[sid], sid,
+                             np.asarray(rec[sid]).tobytes(), accs)
     finally:
         for f in ins.values():
             f.close()
         for f in outs.values():
             f.close()
+    # Load-modify-save of the shared sidecar: serialize with the other
+    # writers (shard receive, scrub TOFU) or concurrent savers lose
+    # each other's entries.
+    with ecc_lock(base_file_name):
+        ecc = ShardChecksums.load(base_file_name)
+        for sid in missing:
+            ecc.set_shard(sid, accs[sid].finalize())
+        ecc.save()
     return missing

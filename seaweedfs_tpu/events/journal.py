@@ -73,6 +73,17 @@ TYPES: dict[str, str] = {
     "fault.injected": "an armed fault point triggered",
     "tier.move": "a volume .dat moved between local disk and a "
                  "remote tier",
+    "scrub.start": "a scrub sweep of one volume/EC volume began",
+    "scrub.finish": "a scrub sweep finished, with checked/corrupt/"
+                    "repaired counts",
+    "needle.corrupt": "CRC verification caught a corrupt needle or "
+                      "EC shard block",
+    "needle.repaired": "a corrupt needle/shard block was rewritten "
+                       "from a replica or by EC decode",
+    "volume.quarantine": "a corrupt needle was tombstoned (repair "
+                         "ticket kept) instead of serving bad bytes",
+    "volume.recovered": "crash-safe mount truncated a torn tail or "
+                        "regenerated a stale .idx",
 }
 
 SEVERITIES = ("info", "warn", "error")

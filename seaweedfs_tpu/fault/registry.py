@@ -74,6 +74,11 @@ POINTS: dict[str, str] = {
                       "pull, degraded read)",
     "ec.scatter": "EC shard push to a rebuilt/encoded shard target",
     "master.heartbeat": "volume server heartbeat POST to its master",
+    "volume.corrupt": "bit-rot injector: the guarded write site flips "
+                      "a data bit in the record/shard bytes as they "
+                      "are written to disk (the write still succeeds)",
+    "disk.read": "volume .dat pread — an armed fail surfaces as an "
+                 "OSError, like a failing disk sector",
 }
 
 KINDS = ("fail", "delay", "status", "drop")

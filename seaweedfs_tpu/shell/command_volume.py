@@ -190,15 +190,27 @@ class VolumeUnmount(Command):
 @register
 class VolumeFsck(Command):
     name = "volume.fsck"
-    help = ("volume.fsck [-v] — verify every filer chunk resolves to a "
-            "live needle (command_volume_fsck.go's "
-            "findMissingChunksInVolumeServers direction)")
+    help = ("volume.fsck [-v] [-crc] — verify every filer chunk "
+            "resolves to a live needle (command_volume_fsck.go's "
+            "findMissingChunksInVolumeServers direction); -crc HEADs "
+            "EVERY replica and compares the stored needle CRC "
+            "(X-Needle-Checksum) so divergent copies are caught "
+            "without transferring bodies")
+
+    @staticmethod
+    def _head_checksum(url: str, fid: str) -> str:
+        import urllib.request
+        req = urllib.request.Request(f"http://{url}/{fid}",
+                                     method="HEAD")
+        resp = urllib.request.urlopen(req, timeout=10)
+        resp.read()
+        return resp.headers.get("X-Needle-Checksum", "")
 
     def do(self, args: list[str], env: CommandEnv) -> str:
-        import urllib.request
         flags, _ = self.parse_flags(args)
+        crc_mode = "crc" in flags
         proxy = env.filer()
-        checked, missing = 0, []
+        checked, missing, diverged = 0, [], []
         stack = ["/"]
         while stack:
             d = stack.pop()
@@ -215,17 +227,191 @@ class VolumeFsck(Command):
                         locs = env.volume_locations(vid)
                         if not locs:
                             raise LookupError("no locations")
-                        req = urllib.request.Request(
-                            f"http://{locs[0]}/{fid}", method="HEAD")
-                        urllib.request.urlopen(req, timeout=10).read()
+                        if not crc_mode:
+                            self._head_checksum(locs[0], fid)
+                            continue
+                        crcs = {}
+                        for url in locs:
+                            crcs[url] = self._head_checksum(url, fid)
+                        if len(set(crcs.values())) > 1:
+                            diverged.append(
+                                (e["FullPath"], fid,
+                                 ", ".join(f"{u}={c or '?'}"
+                                           for u, c in
+                                           sorted(crcs.items()))))
                     except Exception as err:  # noqa: BLE001
                         missing.append((e["FullPath"], fid, str(err)))
-        lines = [f"checked {checked} chunks, "
-                 f"{len(missing)} missing"]
+        lines = [f"checked {checked} chunks, {len(missing)} missing"
+                 + (f", {len(diverged)} replica CRC mismatches"
+                    if crc_mode else "")]
         if "v" in flags or missing:
             lines += [f"  MISSING {path} chunk {fid}: {err}"
                       for path, fid, err in missing[:50]]
+        lines += [f"  CRC MISMATCH {path} chunk {fid}: {detail}"
+                  for path, fid, detail in diverged[:50]]
         return "\n".join(lines)
+
+
+@register
+class VolumeScrub(Command):
+    name = "volume.scrub"
+    help = ("volume.scrub [-volumeId <id>] [-node <host:port>] "
+            "[-repair] — CRC-verify every live needle and EC shard "
+            "block on the targeted server(s) now; -repair heals "
+            "corruption from replicas / EC decode "
+            "(volume_checking.go's direction, on demand)")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, _ = self.parse_flags(args)
+        repair = "repair" in flags
+        if repair:
+            env.confirm_is_locked()
+        vid = int(flags["volumeId"]) if "volumeId" in flags else \
+            int(flags["vid"]) if "vid" in flags else None
+        nodes = [flags["node"]] if "node" in flags else \
+            [n["url"] for n in env.data_nodes()]
+        payload: dict = {"repair": repair}
+        if vid is not None:
+            payload["volume"] = vid
+        lines = []
+        for node in nodes:
+            out = env.vs_call(node, "/admin/scrub", payload)
+            for r in out.get("volumes", []):
+                lines.append(
+                    f"{node} {r['kind']} volume {r['id']}: "
+                    f"checked {r['checked']}, corrupt {r['corrupt']}, "
+                    f"repaired {r['repaired']}"
+                    + (f", quarantined {r['quarantined']}"
+                       if r.get("quarantined") else "")
+                    + (f", unrepaired {r['unrepaired']}"
+                       if r.get("unrepaired") else ""))
+        return "\n".join(lines) or "nothing to scrub"
+
+
+@register
+class VolumeCheckDisk(Command):
+    name = "volume.check.disk"
+    help = ("volume.check.disk [-volumeId <id>] [-n] — compare the "
+            "needle sets of every replicated volume's holders (via "
+            "their .idx files) and heal divergence: a needle missing "
+            "or quarantined on one holder is re-fetched from a "
+            "healthy sibling (command_volume_check_disk.go)")
+
+    @staticmethod
+    def _idx_state(node: str, vid: int
+                   ) -> tuple[set[int], set[int], set[int]]:
+        """(live_keys, seen_keys, quarantined_keys) from one holder.
+        `seen` includes tombstoned keys: a key a holder has *deleted*
+        must not be mistaken for one it never received — resurrecting
+        a tombstoned needle would undo an acknowledged delete.
+        `quarantined` (open repair tickets, /admin/scrub/status) tells
+        a scrub-quarantine tombstone apart from a user delete: that
+        holder needs a REPAIR, and its tombstone must never be
+        propagated as a delete — it would erase the healthy copies."""
+        import io
+
+        from ..core import idx as idx_mod
+        from ..core import types as t
+        raw = rpc.call(f"http://{node}/admin/volume_file?"
+                       f"volume={vid}&ext=.idx")
+        last: dict[int, tuple[int, int]] = {}
+        for e in idx_mod.iter_index(io.BytesIO(bytes(raw))):
+            last[e.key] = (e.offset, e.size)
+        live = {k for k, (off, size) in last.items()
+                if off > 0 and t.size_is_valid(size)}
+        quarantined: set[int] = set()
+        try:
+            st = rpc.call(f"http://{node}/admin/scrub/status")
+            row = next((r for r in st.get("volumes", [])
+                        if r["id"] == vid), None)
+            if row:
+                quarantined = {int(k, 16) for k in row["tickets"]}
+        except Exception:  # noqa: BLE001 — older server: no tickets
+            pass
+        return live, set(last), quarantined
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _ = self.parse_flags(args)
+        dry = "n" in flags
+        only = int(flags["volumeId"]) if "volumeId" in flags else None
+        out = []
+        for vid, holders in sorted(_volumes_by_id(env).items()):
+            if only is not None and vid != only:
+                continue
+            if len(holders) < 2:
+                continue
+            states = {}
+            for n, _v in holders:
+                try:
+                    states[n["url"]] = self._idx_state(n["url"], vid)
+                except Exception as e:  # noqa: BLE001 — holder down
+                    out.append(f"volume {vid}: cannot read idx on "
+                               f"{n['url']}: {e}")
+            if len(states) < 2:
+                continue
+            union_live: set[int] = set().union(
+                *(live | quar for live, _seen, quar
+                  in states.values()))
+            for key in sorted(union_live):
+                # A USER tombstone anywhere wins: the delete was
+                # acknowledged to a client, so holders still serving
+                # the needle get the delete, never the reverse
+                # (command_volume_check_disk.go resolves direction by
+                # timestamp; deletes are strictly newer here).  A
+                # QUARANTINE tombstone is the opposite case — that
+                # holder lost its copy to rot and needs a repair.
+                deleters = [u for u, (live, seen, quar)
+                            in states.items()
+                            if key in seen and key not in live
+                            and key not in quar]
+                for url, (live, seen, quar) in sorted(states.items()):
+                    if key in quar and not deleters:
+                        if dry:
+                            out.append(f"volume {vid}: {url} "
+                                       f"quarantined needle {key:x} "
+                                       f"(would repair)")
+                            continue
+                        try:
+                            env.vs_call(url, "/admin/scrub/repair",
+                                        {"volume": vid, "key": key})
+                            out.append(f"volume {vid}: repaired "
+                                       f"quarantined needle {key:x} "
+                                       f"on {url}")
+                        except Exception as e:  # noqa: BLE001
+                            out.append(f"volume {vid}: FAILED to "
+                                       f"repair quarantined needle "
+                                       f"{key:x} on {url}: {e}")
+                    elif deleters and key in live:
+                        fid = f"{vid},{key:x}{0:08x}"
+                        if dry:
+                            out.append(f"volume {vid}: {url} still "
+                                       f"serves deleted needle "
+                                       f"{key:x} (would delete)")
+                            continue
+                        try:
+                            rpc.call(f"http://{url}/{fid}"
+                                     "?type=replicate", "DELETE")
+                            out.append(f"volume {vid}: propagated "
+                                       f"delete of {key:x} to {url}")
+                        except Exception as e:  # noqa: BLE001
+                            out.append(f"volume {vid}: FAILED to "
+                                       f"delete {key:x} on {url}: {e}")
+                    elif not deleters and key not in seen:
+                        if dry:
+                            out.append(f"volume {vid}: {url} missing "
+                                       f"needle {key:x} (would repair)")
+                            continue
+                        try:
+                            env.vs_call(url, "/admin/scrub/repair",
+                                        {"volume": vid, "key": key})
+                            out.append(f"volume {vid}: repaired "
+                                       f"needle {key:x} on {url}")
+                        except Exception as e:  # noqa: BLE001
+                            out.append(f"volume {vid}: FAILED to "
+                                       f"repair needle {key:x} on "
+                                       f"{url}: {e}")
+        return "\n".join(out) or "all replicas agree"
 
 
 @register

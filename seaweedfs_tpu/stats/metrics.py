@@ -305,6 +305,31 @@ def observe_ec_stage(stage: str, seconds: float, nbytes: int = 0) -> None:
         ec_stage_bytes.inc(nbytes, stage=stage)
 
 
+# -- data-integrity instruments ---------------------------------------------
+# Process-global singletons the scrub/self-healing pipeline observes
+# into (storage/scrub.py, cluster/volume_server.py); the volume server
+# registers the same objects on its /metrics scrape.
+
+scrub_checked_total = Counter(
+    "SeaweedFS_scrub_checked_total",
+    "items CRC-verified by the scrubber", ("kind",))  # needle|shard_block
+
+scrub_bytes_total = Counter(
+    "SeaweedFS_scrub_bytes_total",
+    "bytes read and CRC-verified by the scrubber")
+
+scrub_corrupt_total = Counter(
+    "SeaweedFS_scrub_corrupt_total",
+    "corruptions detected by the scrubber", ("kind",))
+
+scrub_sweeps_total = Counter(
+    "SeaweedFS_scrub_sweeps_total", "completed scrub sweeps")
+
+needle_repairs_total = Counter(
+    "SeaweedFS_needle_repairs_total",
+    "self-healing repairs by source", ("source",))  # replica|ec
+
+
 def observe_batch_stage(stages: dict, stage: str, seconds: float,
                         nbytes: int) -> None:
     """observe_ec_stage plus a per-batch accumulator: the batched EC

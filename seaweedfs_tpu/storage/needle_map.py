@@ -84,6 +84,67 @@ class MapMetrics:
     maximum_file_key: int = 0
 
 
+def idx_crash_state(idx_path: str
+                    ) -> tuple[tuple[int, int] | None, set[int]]:
+    """One pass over an .idx for crash recovery (shared by
+    verify_idx_against_dat and storage/scrub.recover_volume_files):
+
+    - truncates a partial trailing entry (kill -9 mid-append), or every
+      later append would land misaligned and garble the journal;
+    - returns ((offset_bytes, size) of the write entry furthest into
+      the .dat — the point up to which the index vouches for data —
+      or None, and the set of keys whose LAST entry is a tombstone,
+      so a tail scan can tell an unjournaled delete marker from one
+      the index already knows about).
+    """
+    if not os.path.exists(idx_path):
+        return None, set()
+    isize = os.path.getsize(idx_path)
+    usable = isize - isize % idx_mod.ENTRY_SIZE
+    if usable != isize:
+        with open(idx_path, "r+b") as f:
+            f.truncate(usable)
+    if usable == 0:
+        return None, set()
+    with open(idx_path, "rb") as f:
+        raw = f.read(usable)
+    arr = np.frombuffer(raw, dtype=_idx_dtype())
+    offs = _units_col(arr) * t.NEEDLE_PADDING_SIZE
+    writes = (offs > 0) & (arr["size"].astype(np.int32) > 0)
+    furthest = None
+    if writes.any():
+        i = int(np.argmax(np.where(writes, offs, 0)))
+        furthest = (int(offs[i]), int(arr["size"][i]))
+    # Keys whose final entry is a delete (keep-LAST semantics).
+    keys = arr["key"].astype(np.uint64)
+    _uniq, idx_rev = np.unique(keys[::-1], return_index=True)
+    last = len(keys) - 1 - idx_rev
+    dead_sel = ~writes[last]
+    dead = {int(k) for k in keys[last][dead_sel]}
+    return furthest, dead
+
+
+def verify_idx_against_dat(idx_path: str, dat_path: str | None) -> None:
+    """Crash-staleness gate run before an .idx is trusted
+    (volume_checking.go's CheckVolumeDataIntegrity direction): a
+    partial trailing entry is truncated away, and an index whose
+    furthest entry points past the .dat's EOF is lying about data that
+    no longer exists — regenerate it from the .dat (scanner-based
+    `weed fix`) instead of silently trusting it."""
+    if not dat_path or not os.path.exists(idx_path) \
+            or not os.path.exists(dat_path):
+        return
+    furthest, _dead = idx_crash_state(idx_path)
+    if furthest is None:
+        return
+    from ..core.needle import get_actual_size
+    from .volume_scanner import generate_idx_from_dat, read_super_block
+    end = furthest[0] + get_actual_size(
+        furthest[1], read_super_block(dat_path).version)
+    if end > os.path.getsize(dat_path):
+        generate_idx_from_dat(dat_path, idx_path)
+
+
 class MemoryNeedleMap:
     """NeedleMapper: dict index + write-through append to the .idx file."""
 
@@ -93,8 +154,13 @@ class MemoryNeedleMap:
         self._idx_file = idx_file
 
     @classmethod
-    def load(cls, idx_path: str) -> "MemoryNeedleMap":
-        """Rebuild the map from an existing .idx (LoadNewNeedleMap)."""
+    def load(cls, idx_path: str,
+             dat_path: str | None = None) -> "MemoryNeedleMap":
+        """Rebuild the map from an existing .idx (LoadNewNeedleMap).
+        With `dat_path`, the idx tail is first verified against the
+        .dat (partial entries truncated, beyond-EOF indexes
+        regenerated by the scanner) instead of trusted blindly."""
+        verify_idx_against_dat(idx_path, dat_path)
         f = open(idx_path, "a+b")
         f.seek(0)
         nm = cls(idx_file=f)
@@ -174,6 +240,13 @@ class MemoryNeedleMap:
         if self._idx_file is not None:
             self._idx_file.flush()
 
+    def sync(self) -> None:
+        """flush + fsync the .idx journal — the durability half of
+        Volume.sync (the reference's commitNeedleMap path)."""
+        if self._idx_file is not None:
+            self._idx_file.flush()
+            os.fsync(self._idx_file.fileno())
+
     def close(self) -> None:
         if self._idx_file is not None:
             self._idx_file.flush()
@@ -208,12 +281,16 @@ class CompactNeedleMap:
         self._lock = threading.RLock()
 
     @classmethod
-    def load(cls, idx_path: str) -> "CompactNeedleMap":
+    def load(cls, idx_path: str,
+             dat_path: str | None = None) -> "CompactNeedleMap":
         """Vectorized .idx replay: keep-last per key, drop dead keys.
 
         Replaces the reference's per-entry walk (needle_map_memory.go)
         with one numpy pass — the load-time analog of batching onto the
-        vector unit."""
+        vector unit.  With `dat_path`, a crash-stale idx (partial tail
+        entry, entries past the .dat EOF) is repaired/regenerated
+        first — see verify_idx_against_dat."""
+        verify_idx_against_dat(idx_path, dat_path)
         f = open(idx_path, "a+b")
         f.seek(0)
         raw = f.read()
@@ -355,6 +432,11 @@ class CompactNeedleMap:
         if self._idx_file is not None:
             self._idx_file.flush()
 
+    def sync(self) -> None:
+        if self._idx_file is not None:
+            self._idx_file.flush()
+            os.fsync(self._idx_file.fileno())
+
     def close(self) -> None:
         if self._idx_file is not None:
             self._idx_file.flush()
@@ -493,6 +575,9 @@ class SortedFileNeedleMap:
     def flush(self) -> None:
         pass
 
+    def sync(self) -> None:
+        pass  # the .sdx is immutable once generated
+
     def close(self) -> None:
         self._f.close()
 
@@ -500,12 +585,14 @@ class SortedFileNeedleMap:
 NEEDLE_MAP_KINDS = ("compact", "memory", "sorted_file")
 
 
-def new_needle_map(kind: str, idx_path: str):
-    """NeedleMapType selection (needle_map.go:12-36)."""
+def new_needle_map(kind: str, idx_path: str,
+                   dat_path: str | None = None):
+    """NeedleMapType selection (needle_map.go:12-36).  `dat_path`
+    enables the crash-staleness gate (verify_idx_against_dat)."""
     if kind == "compact":
-        return CompactNeedleMap.load(idx_path)
+        return CompactNeedleMap.load(idx_path, dat_path)
     if kind == "memory":
-        return MemoryNeedleMap.load(idx_path)
+        return MemoryNeedleMap.load(idx_path, dat_path)
     if kind == "sorted_file":
         return SortedFileNeedleMap.load(idx_path)
     raise ValueError(f"unknown needle map kind {kind!r}")

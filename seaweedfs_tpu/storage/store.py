@@ -39,6 +39,9 @@ class VolumeInfo:
     compact_revision: int
     max_file_key: int = 0
     version: int = 3
+    # Unrepaired corrupt needles (open repair tickets, storage/scrub):
+    # nonzero degrades the volume on /cluster/healthz.
+    corrupt_count: int = 0
 
 
 class DiskLocation:
@@ -177,7 +180,7 @@ class Store:
                     info = self._volume_info(v)
                     v.close()
                     base = v.file_name()
-                    for ext in (".dat", ".idx"):
+                    for ext in (".dat", ".idx", ".qrt"):
                         try:
                             os.remove(base + ext)
                         except FileNotFoundError:
@@ -281,7 +284,8 @@ class Store:
             replica_placement=v.super_block.replica_placement.to_byte(),
             ttl=v.super_block.ttl.to_uint32(),
             compact_revision=v.super_block.compaction_revision,
-            max_file_key=v.max_file_key(), version=v.version)
+            max_file_key=v.max_file_key(), version=v.version,
+            corrupt_count=v.corrupt_count())
 
     def collect_heartbeat(self) -> dict:
         """Full heartbeat payload (CollectHeartbeat, store.go:198)."""

@@ -26,6 +26,7 @@ from ..core.needle import (CURRENT_VERSION, Needle, get_actual_size)
 from ..core.replica_placement import ReplicaPlacement
 from ..core.super_block import SUPER_BLOCK_SIZE, SuperBlock
 from ..core.ttl import TTL
+from ..fault import registry as _fault
 from ..utils.rwlock import RWLock
 from .needle_map import new_needle_map
 
@@ -39,6 +40,12 @@ class VolumeError(Exception):
 
 class NotFoundError(VolumeError):
     pass
+
+
+class CorruptNeedleError(VolumeError):
+    """The record's stored CRC disagrees with its data bytes: bit-rot
+    or a torn write.  Distinct from VolumeError so the read path can
+    route it to self-healing repair instead of a plain 4xx."""
 
 
 @dataclass
@@ -174,6 +181,15 @@ class Volume:
         # a Commit can find the snapshot whichever plane staged it.
         self.vacuum_lock = threading.RLock()
         self.vacuum_staged: int | None = None
+        # Self-healing state: quarantined-needle repair tickets
+        # (key -> quarantine unix time) and the last scrub sweep time
+        # (storage/scrub.py).  len(repair_tickets) is the volume's
+        # corrupt_count in heartbeats and /cluster/healthz.  Tickets
+        # persist in a `.qrt` sidecar: a restart must neither forget
+        # that quarantined data awaits repair (healthz would lie
+        # healthy) nor let its tombstone masquerade as a user delete.
+        self.repair_tickets: dict[int, float] = self._load_tickets()
+        self.last_scrub = 0.0
         base = self.file_name()
         # Tiered volume: the .dat lives on a remote BackendStorage
         # (storage/volume_tier.go); reads proxy through remote_file,
@@ -198,6 +214,13 @@ class Volume:
         if not exists and not create:
             raise VolumeError(f"volume file {base}.dat not found")
         if exists:
+            # Crash-safe mount (storage/scrub.py): validate the
+            # superblock, truncate a torn trailing record, and repair/
+            # regenerate the .idx BEFORE anything trusts either file —
+            # a kill -9 mid-write must never leave this volume
+            # unmountable or lying about what it holds.
+            from .scrub import recover_volume_files
+            recover_volume_files(base + ".dat", base + ".idx", vid=vid)
             self._dat = open(base + ".dat", "r+b")
             self.super_block = SuperBlock.from_bytes(
                 self._dat.read(SUPER_BLOCK_SIZE + 64 * 1024))
@@ -210,6 +233,10 @@ class Volume:
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
         self.needle_map_kind = needle_map_kind
+        # No dat_path here: recover_volume_files above already ran the
+        # strictly-stronger crash pass (verify_idx_against_dat is the
+        # gate for mappers loaded OUTSIDE a Volume) — passing it would
+        # just re-read the whole .idx a second time per mount.
         self.nm = new_needle_map(needle_map_kind, base + ".idx")
         if needle_map_kind == "sorted_file":
             self.readonly = True  # the .sdx map cannot journal updates
@@ -284,7 +311,11 @@ class Volume:
                     for req in written:
                         self.nm.put(req.needle.id, req.offset,
                                     req.needle.size)
-                    self.nm.flush()
+                    # Durable writes are durable in BOTH files: an idx
+                    # entry lost to a crash would orphan the fsynced
+                    # data (recovery re-journals it, but an fsync ack
+                    # should never depend on recovery).
+                    self.nm.sync()
                 except Exception as e:  # noqa: BLE001
                     for req in batch:
                         req.error = req.error or e
@@ -310,6 +341,18 @@ class Volume:
         if n.append_at_ns == 0:
             n.append_at_ns = time.time_ns()
         blob = n.to_bytes(self.version)
+        if _fault.ARMED and n.data:
+            # volume.corrupt: deterministic bit-rot injection — the
+            # write SUCCEEDS but a data bit flips on its way to disk
+            # (the stored checksum was already computed from the true
+            # bytes, so the damage is CRC-detectable like real rot).
+            try:
+                _fault.hit("volume.corrupt", vid=self.vid,
+                           key=f"{n.id:x}")
+            except _fault.FaultInjected:
+                buf = bytearray(blob)
+                buf[t.NEEDLE_HEADER_SIZE + 4] ^= 0xFF  # first data byte
+                blob = bytes(buf)
         self._dat.seek(offset)
         self._dat.write(blob)
         self._append_at = offset + len(blob)
@@ -342,7 +385,10 @@ class Volume:
                 if fsync:
                     os.fsync(self._dat.fileno())
                 self.nm.put(n.id, off, n.size)
-                self.nm.flush()
+                if fsync:
+                    self.nm.sync()
+                else:
+                    self.nm.flush()
                 self.last_modified = time.time()
                 return off, size
         req = _WriteReq(needle=n, done=threading.Event())
@@ -385,6 +431,108 @@ class Volume:
             self.last_modified = time.time()
             return freed
 
+    # -- self-healing (storage/scrub.py drives these) ------------------------
+
+    def _tickets_path(self) -> str:
+        return self.file_name() + ".qrt"
+
+    def _load_tickets(self) -> dict[int, float]:
+        import json
+        try:
+            with open(self._tickets_path()) as f:
+                return {int(k, 16): float(ts)
+                        for k, ts in json.load(f).items()}
+        except (OSError, ValueError):
+            return {}
+
+    def _save_tickets(self) -> None:
+        """Persist the open repair tickets (best effort — a failed save
+        costs re-detection by the next scrub, never data)."""
+        import json
+        path = self._tickets_path()
+        try:
+            if not self.repair_tickets:
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
+                return
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({f"{k:x}": ts
+                           for k, ts in self.repair_tickets.items()}, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def corrupt_count(self) -> int:
+        """Unrepaired corrupt needles (open repair tickets) — reported
+        in heartbeats; any nonzero count degrades /cluster/healthz."""
+        return len(self.repair_tickets)
+
+    def quarantine_needle(self, key: int, node: str = "") -> bool:
+        """Stop serving a corrupt needle's bytes: tombstone it and keep
+        a repair ticket so a later sweep (or a degraded read) can still
+        re-fetch it from a healthy replica.  Returns True if the needle
+        was newly quarantined."""
+        if key in self.repair_tickets:
+            return False
+        if self.nm.get(key) is None:
+            return False
+        try:
+            self.delete_needle(key)
+        except VolumeError:
+            pass  # readonly volume: the ticket still flags it degraded
+        self.repair_tickets[key] = time.time()
+        self._save_tickets()
+        from ..events import emit as emit_event
+        emit_event("volume.quarantine", node=node, severity="warn",
+                   vid=self.vid, key=f"{key:x}")
+        return True
+
+    def repair_needle(self, n: Needle, fsync: bool = True) -> tuple[int, int]:
+        """Rewrite a healthy copy of a needle in place (append + map
+        publish), closing its repair ticket.  Runs even on a readonly
+        volume: repair restores what the volume already promised to
+        hold, it does not admit new data."""
+        with self._file_lock.write(), self._lock:
+            ro, self.readonly = self.readonly, False
+            try:
+                off, size = self._write_record_locked(n)
+                self._dat.flush()
+                if fsync:
+                    os.fsync(self._dat.fileno())
+                self.nm.put(n.id, off, n.size)
+                if fsync:
+                    self.nm.sync()  # both files durable, like write
+                else:
+                    self.nm.flush()
+                self.last_modified = time.time()
+            finally:
+                self.readonly = ro
+        if self.repair_tickets.pop(n.id, None) is not None:
+            self._save_tickets()
+        return off, size
+
+    def read_needle_blob(self, needle_id: int) -> bytes:
+        """Raw CRC-verified record bytes (header..padding) of one live
+        needle — what a sibling replica pulls to heal its copy.  Raises
+        CorruptNeedleError when this copy is rotten too."""
+        entry = self.nm.get(needle_id)
+        if entry is None or not t.size_is_valid(entry[1]):
+            raise NotFoundError(f"needle {needle_id:x} not found")
+        total = get_actual_size(entry[1], self.version)
+        blob = self.pread(total, entry[0])
+        if len(blob) < total:
+            raise CorruptNeedleError(
+                f"needle {needle_id:x}: record truncated")
+        try:
+            Needle.from_bytes(blob, self.version)  # CRC gate
+        except ValueError as e:
+            raise CorruptNeedleError(
+                f"needle {needle_id:x}: {e}") from None
+        return blob
+
     # -- read path ---------------------------------------------------------
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
@@ -401,11 +549,20 @@ class Volume:
             if not t.size_is_valid(size):
                 raise NotFoundError(f"needle {needle_id:x} deleted")
             total = get_actual_size(size, self.version)
+            if _fault.ARMED:
+                # disk.read: an armed fail is an OSError here — the
+                # exact failure mode of a dying sector.
+                _fault.hit("disk.read", vid=self.vid,
+                           key=f"{needle_id:x}")
             if self.remote_file is not None:
                 blob = self.remote_file.pread(total, offset)
             else:
                 blob = os.pread(self._dat.fileno(), total, offset)
-        n = Needle.from_bytes(blob, self.version)
+        try:
+            n = Needle.from_bytes(blob, self.version)
+        except ValueError as e:
+            raise CorruptNeedleError(
+                f"needle {needle_id:x}: {e}") from None
         if cookie is not None and n.cookie != cookie:
             raise VolumeError(
                 f"cookie mismatch for needle {needle_id:x}")
@@ -416,8 +573,10 @@ class Volume:
 
     def pread(self, size: int, offset: int) -> bytes:
         """Raw .dat range read under the read lock (local or remote) —
-        the tail/backup scanners' access path."""
+        the tail/backup scanners' and the scrubber's access path."""
         with self._file_lock.read():
+            if _fault.ARMED:
+                _fault.hit("disk.read", vid=self.vid)
             if self.remote_file is not None:
                 return self.remote_file.pread(size, offset)
             return os.pread(self._dat.fileno(), size, offset)
@@ -495,7 +654,7 @@ class Volume:
                 pos += len(chunk)
                 remaining -= len(chunk)
             if crc_mod.masked_value(crc) != stored:
-                raise VolumeError(
+                raise CorruptNeedleError(
                     f"CRC error on needle {needle_id:x}")
             return NeedleSlice(fd, data_off, data_size,
                                etag=f"{stored:08x}", **meta)
@@ -554,7 +713,10 @@ class Volume:
             if self._dat is not None:
                 self._dat.flush()
                 os.fsync(self._dat.fileno())
-            self.nm.flush()
+            # The .idx is fsynced alongside the .dat: a sync() caller
+            # (EC generate, volume copy, tiering) must get a pair of
+            # files that agree after a crash, not data without index.
+            self.nm.sync()
 
     def close(self) -> None:
         self._closed = True

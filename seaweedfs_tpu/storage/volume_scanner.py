@@ -43,6 +43,33 @@ def scan_volume_file(dat_path: str, check_crc: bool = False,
             offset += total
 
 
+def scan_data_tail(dat_path: str, start_offset: int | None = None,
+                   check_crc: bool = False,
+                   ) -> tuple[list[tuple[int, int, int]], int]:
+    """Tolerant tail scan for crash recovery (storage/scrub.py):
+    returns ([(needle_id, offset, size), ...], data_end) for every
+    COMPLETE, parseable record from `start_offset` on, stopping — but
+    not raising — at the first truncated or malformed record.
+    `data_end` is the byte offset just past the last good record: a
+    .dat longer than that carries a torn tail to truncate."""
+    sb = read_super_block(dat_path)
+    start = start_offset if start_offset is not None else sb.block_size()
+    entries: list[tuple[int, int, int]] = []
+    data_end = start
+    gen = scan_volume_file(dat_path, check_crc=check_crc,
+                           start_offset=start)
+    while True:
+        try:
+            needle, offset, total = next(gen)
+        except StopIteration:
+            break
+        except (ValueError, OSError):
+            break  # malformed record: everything past it is garbage
+        entries.append((needle.id, offset, needle.size))
+        data_end = offset + total
+    return entries, data_end
+
+
 def read_super_block(dat_path: str) -> SuperBlock:
     with open(dat_path, "rb") as f:
         return SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE + 64 * 1024))

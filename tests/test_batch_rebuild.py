@@ -23,7 +23,14 @@ from seaweedfs_tpu.shell import CommandEnv, run_command
 
 @pytest.fixture
 def cluster(tmp_path):
-    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path),
+                          # Volume servers here pulse every 60s:
+                          # the master's dead-node threshold
+                          # (2x its own pulse) must outlast a
+                          # slow-machine encode, or the sweep
+                          # empties the topology mid-test.
+                          pulse_seconds=60)
     master.start()
     servers = []
     for i in range(3):

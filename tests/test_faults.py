@@ -328,6 +328,31 @@ def _drive_master_heartbeat(cl):
     servers[0]._send_heartbeat()  # injected failure -> rotate path
 
 
+def _drive_volume_corrupt(cl):
+    """Bit-rot injection: the write SUCCEEDS, the rot is caught by CRC
+    on the read (which then 500s — single copy, nothing to heal from)."""
+    _master, _servers, _stub, client = cl
+    a = client.assign()
+    fault.arm("volume.corrupt", "fail*1")
+    rpc.call(f"http://{a['url']}/{a['fid']}", "POST", b"rot me " * 8)
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{a['url']}/{a['fid']}")
+    assert ei.value.status == 500
+
+
+def _drive_disk_read(cl):
+    """A one-shot injected sector failure 500s the read; the next read
+    (fault exhausted, bytes were always fine) succeeds."""
+    _master, _servers, _stub, client = cl
+    fid = client.upload_data(b"sector bytes")
+    url = client.lookup(int(fid.split(",")[0]))[0]["url"]
+    fault.arm("disk.read", "fail*1")
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{url}/{fid}")
+    assert ei.value.status == 500
+    assert client.download(fid) == b"sector bytes"
+
+
 DRIVERS = {
     "rpc.connect": _drive_rpc_connect,
     "rpc.send": _drive_rpc_send,
@@ -338,6 +363,8 @@ DRIVERS = {
     "ec.fetch_shard": _drive_ec_fetch_shard,
     "ec.scatter": _drive_ec_scatter,
     "master.heartbeat": _drive_master_heartbeat,
+    "volume.corrupt": _drive_volume_corrupt,
+    "disk.read": _drive_disk_read,
 }
 
 

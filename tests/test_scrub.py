@@ -1,0 +1,603 @@
+"""End-to-end data integrity: crash-safe volume recovery (torn tails,
+stale/missing .idx), the background scrub's detection + quarantine, and
+self-healing repair from replicas and through the TPU EC decode path —
+plus the kill -9 chaos test proving zero acknowledged-write loss."""
+
+import glob
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import fault
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.core import types as t
+from seaweedfs_tpu.core.needle import Needle
+from seaweedfs_tpu.events import JOURNAL
+from seaweedfs_tpu.stats.metrics import needle_repairs_total
+from seaweedfs_tpu.storage.volume import (CorruptNeedleError,
+                                          NotFoundError, Volume)
+
+pytestmark = pytest.mark.scrub
+
+
+# -- crash-safe mount --------------------------------------------------------
+
+def _mk_volume(tmp_path, n_needles=5, vid=7):
+    v = Volume(str(tmp_path), "", vid, use_worker=False)
+    fids = []
+    for i in range(n_needles):
+        n = Needle(cookie=0x1234 + i, id=100 + i,
+                   data=f"needle payload {i} ".encode() * 8)
+        v.write_needle(n)
+        fids.append((n.id, n.cookie, n.data))
+    v.sync()
+    return v, fids
+
+
+def test_torn_tail_is_truncated_on_mount(tmp_path):
+    v, fids = _mk_volume(tmp_path)
+    base = v.file_name()
+    v.close()
+    good_size = os.path.getsize(base + ".dat")
+    # A kill -9 mid-write: half a record header of garbage at the tail.
+    with open(base + ".dat", "ab") as f:
+        f.write(b"\xde\xad\xbe\xef" * 3)
+    v2 = Volume(str(tmp_path), "", 7, create=False, use_worker=False)
+    try:
+        assert os.path.getsize(base + ".dat") == good_size
+        assert v2.dat_size() == good_size
+        for key, cookie, data in fids:
+            assert v2.read_needle(key, cookie).data == data
+        # The volume is fully writable again: appends land aligned.
+        n = Needle(cookie=1, id=999, data=b"post-recovery write")
+        v2.write_needle(n)
+        assert v2.read_needle(999, 1).data == b"post-recovery write"
+    finally:
+        v2.close()
+
+
+def test_lost_idx_tail_entries_are_rejournaled(tmp_path):
+    """Crash between the .dat fsync and the .idx append: the record is
+    on disk but unindexed — recovery must re-journal it, or an
+    acknowledged fsync write would vanish."""
+    from seaweedfs_tpu.core import idx as idx_mod
+    v, fids = _mk_volume(tmp_path)
+    base = v.file_name()
+    v.close()
+    isize = os.path.getsize(base + ".idx")
+    with open(base + ".idx", "r+b") as f:
+        f.truncate(isize - 2 * idx_mod.ENTRY_SIZE)  # lose last 2 entries
+    v2 = Volume(str(tmp_path), "", 7, create=False, use_worker=False)
+    try:
+        for key, cookie, data in fids:
+            assert v2.read_needle(key, cookie).data == data
+        assert v2.file_count() == len(fids)
+    finally:
+        v2.close()
+
+
+def test_missing_idx_regenerated_from_dat(tmp_path):
+    v, fids = _mk_volume(tmp_path)
+    base = v.file_name()
+    v.delete_needle(fids[1][0])  # a tombstone must survive the regen
+    v.close()
+    os.remove(base + ".idx")
+    v2 = Volume(str(tmp_path), "", 7, create=False, use_worker=False)
+    try:
+        assert v2.read_needle(fids[0][0], fids[0][1]).data == fids[0][2]
+        with pytest.raises(NotFoundError):
+            v2.read_needle(fids[1][0])
+    finally:
+        v2.close()
+
+
+def test_stale_idx_beyond_eof_defers_to_scanner(tmp_path):
+    """An .idx whose furthest entry points past the .dat EOF is lying:
+    the scanner-based regen must win, and the torn .dat tail goes."""
+    v, fids = _mk_volume(tmp_path)
+    base = v.file_name()
+    v.close()
+    # Chop the .dat mid-way through the LAST record.
+    size = os.path.getsize(base + ".dat")
+    with open(base + ".dat", "r+b") as f:
+        f.truncate(size - 10)
+    v2 = Volume(str(tmp_path), "", 7, create=False, use_worker=False)
+    try:
+        # Last record is gone (it was torn); the rest must be intact
+        # and the index must agree with the data.
+        for key, cookie, data in fids[:-1]:
+            assert v2.read_needle(key, cookie).data == data
+        with pytest.raises(NotFoundError):
+            v2.read_needle(fids[-1][0])
+        assert v2.dat_size() == os.path.getsize(base + ".dat")
+        assert v2.dat_size() % t.NEEDLE_PADDING_SIZE == 0
+    finally:
+        v2.close()
+
+
+def test_remount_after_delete_is_idempotent(tmp_path):
+    """A volume whose LAST operation was a delete leaves a trailing
+    tombstone marker past the furthest write entry: repeated mounts
+    must not re-journal it (idx growth) or report phantom recovery."""
+    v, fids = _mk_volume(tmp_path)
+    base = v.file_name()
+    v.delete_needle(fids[-1][0])
+    v.close()
+    isize = os.path.getsize(base + ".idx")
+    seq0 = JOURNAL._seq
+    for _ in range(3):
+        v2 = Volume(str(tmp_path), "", 7, create=False,
+                    use_worker=False)
+        v2.close()
+        assert os.path.getsize(base + ".idx") == isize
+    assert not [ev for ev in JOURNAL.snapshot(type_="volume.recovered")
+                if ev["seq"] > seq0]
+
+
+def test_partial_idx_entry_truncated(tmp_path):
+    from seaweedfs_tpu.core import idx as idx_mod
+    v, fids = _mk_volume(tmp_path)
+    base = v.file_name()
+    v.close()
+    with open(base + ".idx", "ab") as f:
+        f.write(b"\x01\x02\x03")  # torn idx append
+    v2 = Volume(str(tmp_path), "", 7, create=False, use_worker=False)
+    try:
+        assert os.path.getsize(base + ".idx") % idx_mod.ENTRY_SIZE == 0
+        for key, cookie, data in fids:
+            assert v2.read_needle(key, cookie).data == data
+    finally:
+        v2.close()
+
+
+def test_volume_sync_fsyncs_idx_too(tmp_path, monkeypatch):
+    v, _fids = _mk_volume(tmp_path)
+    try:
+        synced = []
+        real_fsync = os.fsync
+
+        def spy(fd):
+            synced.append(fd)
+            return real_fsync(fd)
+
+        monkeypatch.setattr(os, "fsync", spy)
+        v.sync()
+        assert v._dat.fileno() in synced
+        assert v.nm._idx_file.fileno() in synced
+    finally:
+        v.close()
+
+
+def test_repair_tickets_survive_restart(tmp_path):
+    """A quarantined needle's repair ticket persists: after a server
+    restart the volume still reports corrupt (healthz must not lie
+    healthy) and repair_needle still closes the ticket."""
+    v, fids = _mk_volume(tmp_path)
+    key, cookie, data = fids[0]
+    assert v.quarantine_needle(key)
+    assert v.corrupt_count() == 1
+    v.close()
+    v2 = Volume(str(tmp_path), "", 7, create=False, use_worker=False)
+    try:
+        assert v2.corrupt_count() == 1
+        assert key in v2.repair_tickets
+        n = Needle(cookie=cookie, id=key, data=data)
+        v2.repair_needle(n)
+        assert v2.corrupt_count() == 0
+        assert v2.read_needle(key, cookie).data == data
+    finally:
+        v2.close()
+    v3 = Volume(str(tmp_path), "", 7, create=False, use_worker=False)
+    try:
+        assert v3.corrupt_count() == 0  # the closed ticket stays closed
+    finally:
+        v3.close()
+
+
+# -- .ecc shard checksums ----------------------------------------------------
+
+def test_ecc_sidecar_matches_files_and_detects_flips(tmp_path):
+    from seaweedfs_tpu.ec import TOTAL_SHARDS, to_ext
+    from seaweedfs_tpu.ec.encoder import (write_ec_files,
+                                          write_sorted_file_from_idx)
+    from seaweedfs_tpu.ec.integrity import ShardChecksums, file_block_crcs
+    v, _fids = _mk_volume(tmp_path, n_needles=20)
+    base = v.file_name()
+    v.close()
+    write_sorted_file_from_idx(base)
+    write_ec_files(base)
+    ecc = ShardChecksums.load(base)
+    for sid in range(TOTAL_SHARDS):
+        assert ecc.get(sid) == file_block_crcs(base + to_ext(sid))
+        assert ecc.verify_file(sid, base + to_ext(sid)) == []
+    # Flip one byte in a parity shard — needle CRCs can't see parity
+    # rot, the sidecar must.
+    with open(base + to_ext(12), "r+b") as f:
+        f.seek(100)
+        byte = f.read(1)
+        f.seek(100)
+        f.write(bytes((byte[0] ^ 0xFF,)))
+    assert ecc.verify_file(12, base + to_ext(12)) == [0]
+
+
+# -- scrub + self-healing in a cluster ---------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=16,
+                          meta_dir=str(tmp_path / "meta"),
+                          pulse_seconds=60)
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)],
+                          max_volume_counts=[50], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    fault.disarm_all()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _grow_and_corrupt_write(master, collection, replication=""):
+    """One volume in `collection`, one clean needle, then one needle
+    whose local copy is bit-rotted at write time via the volume.corrupt
+    fault point.  Returns (vid, primary_url, corrupt_fid, payload)."""
+    rep = f"&replication={replication}" if replication else ""
+    rpc.call(f"{master.url()}/vol/grow?count=1"
+             f"&collection={collection}{rep}", "POST")
+    a1 = rpc.call(f"{master.url()}/dir/assign?"
+                  f"collection={collection}{rep}")
+    rpc.call(f"http://{a1['url']}/{a1['fid']}", "POST", b"clean needle")
+    a2 = rpc.call(f"{master.url()}/dir/assign?"
+                  f"collection={collection}{rep}")
+    payload = b"soon to be rotten " * 32
+    fault.arm("volume.corrupt", "fail*1")
+    try:
+        rpc.call(f"http://{a2['url']}/{a2['fid']}", "POST", payload)
+    finally:
+        fault.disarm_all()
+    return int(a2["fid"].split(",")[0]), a2["url"], a2["fid"], payload
+
+
+def _journal_types_since(seq):
+    return {ev["type"] for ev in JOURNAL.snapshot()
+            if ev["seq"] > seq}
+
+
+def test_self_healing_replicated_volume(cluster):
+    """Acceptance (a): bit-rot on one replica is detected by the scrub,
+    quarantined (healthz degraded), then repaired from the healthy
+    sibling (healthz healthy again), with events + metrics emitted."""
+    master, _servers = cluster
+    seq0 = JOURNAL._seq
+    vid, url, fid, payload = _grow_and_corrupt_write(
+        master, "healrep", replication="001")
+    before = needle_repairs_total.value(source="replica")
+
+    # Detection pass (no repair): quarantine + degraded healthz.
+    out = rpc.call_json(f"http://{url}/admin/scrub", "POST",
+                        {"volume": vid})
+    report = next(r for r in out["volumes"]
+                  if r["id"] == vid and r["kind"] == "volume")
+    assert report["corrupt"] == 1 and report["quarantined"] == 1
+    status, doc = rpc.call_status(f"{master.url()}/cluster/healthz")
+    assert status == 503 and not doc["healthy"]
+    assert any(f"volume {vid}" in p and "corrupt" in p
+               for p in doc["problems"]), doc["problems"]
+    types = _journal_types_since(seq0)
+    assert {"scrub.start", "scrub.finish", "needle.corrupt",
+            "volume.quarantine"} <= types
+
+    # Repair pass: the ticket heals from the sibling replica.
+    out = rpc.call_json(f"http://{url}/admin/scrub", "POST",
+                        {"volume": vid, "repair": True})
+    assert out["repaired"] == 1
+    assert needle_repairs_total.value(source="replica") == before + 1
+    status, doc = rpc.call_status(f"{master.url()}/cluster/healthz")
+    assert status == 200 and doc["healthy"], doc["problems"]
+    assert "needle.repaired" in _journal_types_since(seq0)
+    # The repaired copy serves the original bytes from THIS holder.
+    assert bytes(rpc.call(f"http://{url}/{fid}")) == payload
+
+
+def test_degraded_read_repairs_inline(cluster):
+    """A CRC-failing GET triggers the same repair inline and serves the
+    repaired bytes — degraded read, not an error."""
+    master, _servers = cluster
+    vid, url, fid, payload = _grow_and_corrupt_write(
+        master, "degread", replication="001")
+    before = needle_repairs_total.value(source="replica")
+    assert bytes(rpc.call(f"http://{url}/{fid}")) == payload
+    assert needle_repairs_total.value(source="replica") == before + 1
+    # Healed in place: the next read is a plain local read.
+    assert bytes(rpc.call(f"http://{url}/{fid}")) == payload
+    assert needle_repairs_total.value(source="replica") == before + 1
+
+
+def test_unrepairable_corruption_quarantines(cluster):
+    """No replica to heal from: the read path answers 500 (never the
+    rotten bytes) and the volume reports degraded until repaired."""
+    master, _servers = cluster
+    vid, url, fid, _payload = _grow_and_corrupt_write(
+        master, "noheal")  # replication 000: single copy
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{url}/{fid}")
+    assert ei.value.status == 500
+    status, doc = rpc.call_status(f"{master.url()}/cluster/healthz")
+    assert status == 503
+    assert any(f"volume {vid}" in p for p in doc["problems"])
+    # The clean needle in the same volume still reads fine.
+    st = rpc.call(f"http://{url}/admin/scrub/status")
+    row = next(r for r in st["volumes"] if r["id"] == vid)
+    assert row["corrupt_count"] == 1
+
+
+def test_self_healing_ec_volume(cluster):
+    """Acceptance (b): bit-rot injected into an EC shard at encode time
+    is caught by the shard-checksum scrub and healed through the EC
+    decode path (reconstruct from >=10 sibling shards), transitioning
+    healthz degraded -> healthy."""
+    master, servers = cluster
+    seq0 = JOURNAL._seq
+    col = "healec"
+    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}",
+             "POST")
+    a = rpc.call(f"{master.url()}/dir/assign?collection={col}")
+    payload = b"erasure coded payload " * 64
+    rpc.call(f"http://{a['url']}/{a['fid']}", "POST", payload)
+    vid, url = int(a["fid"].split(",")[0]), a["url"]
+
+    fault.arm("volume.corrupt", "fail*1")
+    try:
+        rpc.call_json(f"http://{url}/admin/ec/generate", "POST",
+                      {"volume": vid})
+    finally:
+        fault.disarm_all()
+    rpc.call_json(f"http://{url}/admin/ec/mount", "POST",
+                  {"volume": vid})
+
+    before = needle_repairs_total.value(source="ec")
+    out = rpc.call_json(f"http://{url}/admin/scrub", "POST",
+                        {"volume": vid})
+    ec_report = next(r for r in out["volumes"] if r["kind"] == "ec")
+    assert ec_report["corrupt"] >= 1 and ec_report["unrepaired"] >= 1
+    status, doc = rpc.call_status(f"{master.url()}/cluster/healthz")
+    assert status == 503
+    assert any(f"ec volume {vid}" in p and "corrupt shard block" in p
+               for p in doc["problems"]), doc["problems"]
+    assert "needle.corrupt" in _journal_types_since(seq0)
+
+    out = rpc.call_json(f"http://{url}/admin/scrub", "POST",
+                        {"volume": vid, "repair": True})
+    ec_report = next(r for r in out["volumes"] if r["kind"] == "ec")
+    assert ec_report["repaired"] >= 1 and ec_report["unrepaired"] == 0
+    assert needle_repairs_total.value(source="ec") > before
+    assert "needle.repaired" in _journal_types_since(seq0)
+    status, doc = rpc.call_status(f"{master.url()}/cluster/healthz")
+    assert status == 200 and doc["healthy"], doc["problems"]
+
+    # Prove the repaired shard bytes are the TRUE bytes: drop the
+    # normal volume and read the needle through the EC path.
+    vs = next(s for s in servers if s.url() == url)
+    vs.store.delete_volume(vid)
+    assert bytes(rpc.call(f"http://{url}/{a['fid']}")) == payload
+    # A follow-up scrub is clean.
+    out = rpc.call_json(f"http://{url}/admin/scrub", "POST",
+                        {"volume": vid})
+    assert out["corrupt"] == 0
+
+
+def test_disk_read_fault_surfaces_then_heals(cluster):
+    """The disk.read fault point: a one-shot read error on a single-
+    copy volume is a 500 (no replica, and transient errors never
+    quarantine); the next read — fault exhausted — succeeds."""
+    master, _servers = cluster
+    col = "diskread"
+    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}",
+             "POST")
+    a = rpc.call(f"{master.url()}/dir/assign?collection={col}")
+    rpc.call(f"http://{a['url']}/{a['fid']}", "POST", b"sector data")
+    fault.arm("disk.read", "fail*1")
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{a['url']}/{a['fid']}")
+    assert ei.value.status == 500
+    assert bytes(rpc.call(f"http://{a['url']}/{a['fid']}")) == \
+        b"sector data"
+
+
+def test_head_returns_needle_checksum(cluster):
+    import urllib.request
+    master, _servers = cluster
+    col = "headcrc"
+    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}",
+             "POST")
+    a = rpc.call(f"{master.url()}/dir/assign?collection={col}")
+    out = rpc.call(f"http://{a['url']}/{a['fid']}", "POST", b"crc me")
+    req = urllib.request.Request(f"http://{a['url']}/{a['fid']}",
+                                 method="HEAD")
+    resp = urllib.request.urlopen(req, timeout=10)
+    resp.read()
+    assert resp.headers["X-Needle-Checksum"] == out["eTag"]
+
+
+def test_volume_scrub_and_check_disk_shell_commands(cluster):
+    """volume.scrub sweeps on demand; volume.check.disk heals a replica
+    whose needle set diverged: a needle one holder NEVER received comes
+    back from the healthy sibling, while a tombstone one holder missed
+    is propagated as a delete — never resurrected."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    master, servers = cluster
+    col = "checkdisk"
+    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}"
+             f"&replication=001", "POST")
+    # Needle A: lands on ONE holder only (?type=replicate suppresses
+    # the fan-out) — the sibling never saw it.
+    a = rpc.call(f"{master.url()}/dir/assign?collection={col}"
+                 f"&replication=001")
+    rpc.call(f"http://{a['url']}/{a['fid']}?type=replicate", "POST",
+             b"diverge me")
+    vid = int(a["fid"].split(",")[0])
+    # Needle B: replicated everywhere, then deleted on ONE holder only
+    # — an acknowledged delete the sibling missed.
+    b = rpc.call(f"{master.url()}/dir/assign?collection={col}"
+                 f"&replication=001")
+    rpc.call(f"http://{b['url']}/{b['fid']}", "POST", b"delete me")
+    rpc.call(f"http://{b['url']}/{b['fid']}?type=replicate", "DELETE")
+    locs = [loc["url"] for loc in
+            rpc.call(f"{master.url()}/dir/lookup?volumeId={vid}"
+                     )["locations"]]
+    sibling = next(u for u in locs if u != a["url"])
+    env = CommandEnv(master.url())
+    try:
+        env.lock()
+        out = run_command(env, "volume.check.disk "
+                               f"-volumeId {vid} -n")
+        assert "would repair" in out and "would delete" in out
+        out = run_command(env, f"volume.check.disk -volumeId {vid}")
+        assert "repaired needle" in out
+        assert "propagated delete" in out
+        # A exists on BOTH holders now; B on NEITHER (delete won).
+        assert bytes(rpc.call(
+            f"http://{sibling}/{a['fid']}")) == b"diverge me"
+        for u in locs:
+            try:
+                rpc.call(f"http://{u}/{b['fid']}")
+                raise AssertionError(f"deleted needle served on {u}")
+            except rpc.RpcError as e:
+                assert e.status == 404
+        out = run_command(env, f"volume.scrub -volumeId {vid}")
+        assert f"volume {vid}" in out and "corrupt 0" in out
+    finally:
+        env.close()
+
+
+def test_check_disk_never_deletes_healthy_copy_of_quarantined(cluster):
+    """A scrub-quarantine tombstone must read as 'this holder needs a
+    repair', NOT as an acknowledged delete — propagating it would
+    erase the only healthy copies."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    master, _servers = cluster
+    vid, url, fid, payload = _grow_and_corrupt_write(
+        master, "quarcheck", replication="001")
+    # Detection-only scrub quarantines the rotted copy on `url`.
+    rpc.call_json(f"http://{url}/admin/scrub", "POST", {"volume": vid})
+    locs = [loc["url"] for loc in
+            rpc.call(f"{master.url()}/dir/lookup?volumeId={vid}"
+                     )["locations"]]
+    sibling = next(u for u in locs if u != url)
+    env = CommandEnv(master.url())
+    try:
+        env.lock()
+        out = run_command(env, f"volume.check.disk -volumeId {vid}")
+        assert "propagated delete" not in out
+        assert "repaired quarantined needle" in out
+    finally:
+        env.close()
+    # The healthy sibling kept its copy, and the quarantined holder
+    # was healed from it.
+    assert bytes(rpc.call(f"http://{sibling}/{fid}")) == payload
+    assert bytes(rpc.call(f"http://{url}/{fid}")) == payload
+    status, _doc = rpc.call_status(f"{master.url()}/cluster/healthz")
+    assert status == 200
+
+
+# -- kill -9 chaos: zero acknowledged-write loss -----------------------------
+
+def test_kill9_remount_loses_no_acked_writes(tmp_path):
+    """Acceptance: SIGKILL a subprocess volume server mid-upload-burst;
+    on remount every ACKNOWLEDGED write is readable and any torn tail
+    is truncated (the volume mounts writable, aligned)."""
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path / "meta"),
+                          pulse_seconds=60)
+    master.start()
+    vport = rpc.free_port()
+    data = tmp_path / "vsdata"
+    data.mkdir()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "volume",
+         f"-port={vport}", f"-dir={data}", "-max=8",
+         f"-mserver=127.0.0.1:{master.server.port}"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    acked: list[tuple[str, bytes]] = []
+    try:
+        deadline = time.time() + 60
+        while not list(master.topo.leaves()):
+            if time.time() > deadline:
+                raise TimeoutError("subprocess vs never registered")
+            time.sleep(0.2)
+        rpc.call(f"{master.url()}/vol/grow?count=2", "POST")
+        client = WeedClient(master.url())
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def writer(k: int) -> None:
+            i = 0
+            while not stop.is_set():
+                payload = f"worker {k} write {i} ".encode() * 8
+                try:
+                    fid = client.upload_data(payload)
+                except Exception:  # noqa: BLE001 — server died mid-PUT
+                    return
+                with lock:
+                    acked.append((fid, payload))
+                i += 1
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(4)]
+        for th in threads:
+            th.start()
+        deadline = time.time() + 30
+        while len(acked) < 80 and time.time() < deadline:
+            time.sleep(0.02)
+        os.kill(proc.pid, signal.SIGKILL)  # mid-burst, no warning
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+        proc.wait(timeout=10)
+        assert len(acked) >= 20, "burst never got going"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        master.stop()
+
+    # Remount the volume files directly: crash-safe mount must yield
+    # consistent, readable volumes.
+    volumes: dict[int, Volume] = {}
+    try:
+        for dat in glob.glob(str(data / "*.dat")):
+            vid = int(os.path.basename(dat)[:-4])
+            v = Volume(str(data), "", vid, create=False,
+                       use_worker=False)
+            volumes[vid] = v
+            # Torn tails truncated: append cursor == file size, aligned.
+            assert v.dat_size() == os.path.getsize(dat)
+            assert v.dat_size() % t.NEEDLE_PADDING_SIZE == 0
+        lost = []
+        for fid, payload in acked:
+            vid, key, cookie = t.parse_file_id(fid)
+            try:
+                n = volumes[vid].read_needle(key, cookie)
+                if n.data != payload:
+                    lost.append((fid, "bytes differ"))
+            except Exception as e:  # noqa: BLE001
+                lost.append((fid, str(e)))
+        assert not lost, \
+            f"{len(lost)}/{len(acked)} acked writes lost: {lost[:5]}"
+    finally:
+        for v in volumes.values():
+            v.close()
