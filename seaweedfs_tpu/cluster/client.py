@@ -379,10 +379,14 @@ class WeedClient:
                                 headers={"Content-Encoding": "gzip"}
                                 if gzipped else None)
             except rpc.RpcError as e:
-                if e.status < 500:
+                if e.status < 500 and e.status != 429:
                     raise  # a definitive answer (auth, bad request)
-                # 5xx (failed replication fan-out, sick store): the
-                # volume is suspect — forget it and re-assign.
+                # 5xx (failed replication fan-out, sick store,
+                # draining replica) or a 429 shed: the volume is
+                # suspect — forget it and re-assign; the master's
+                # steering hands the retry a volume off the draining/
+                # overloaded node.  A shed/drain answer was refused
+                # before execution, so re-sending is always safe.
                 last_err = e
                 self.cache.forget(t.parse_file_id(fid)[0])
                 continue
@@ -417,8 +421,11 @@ class WeedClient:
         with self.cache._lock:
             start = self.cache._rr.get(vid, 0)
             self.cache._rr[vid] = start + 1
-        for i in range(len(locs)):
+        relooked = False
+        i = 0
+        while i < len(locs):
             loc = locs[(start + i) % len(locs)]
+            i += 1
             try:
                 out = rpc.call(f"http://{loc['url']}/{fid}")
                 assert isinstance(out, (bytes, bytearray))
@@ -427,10 +434,39 @@ class WeedClient:
                 last_err = e
                 if e.status == 404 and "volume" in e.message:
                     self.cache.forget(vid)
+                elif e.status in (429, 503) and not relooked:
+                    # Draining/shedding replica: re-run the master
+                    # lookup once instead of burning the rest of a
+                    # stale list against a node that is leaving.
+                    relooked = True
+                    self.cache.forget(vid)
+                    fresh = self._relookup(vid, include_ec=True)
+                    if fresh:
+                        locs, i, start = fresh, 0, 0
             except OSError as e:  # dead server: fail over to next replica
                 last_err = e
                 self.cache.forget(vid)
+                if i >= len(locs) and not relooked:
+                    # Every cached location failed at the connection
+                    # level: during a rolling restart the cached list
+                    # can be stale in BOTH directions (a drained node
+                    # still listed, a restarted one missing).  One
+                    # fresh master lookup before giving up.
+                    relooked = True
+                    fresh = self._relookup(vid, include_ec=True)
+                    if fresh:
+                        locs, i, start = fresh, 0, 0
         raise last_err or rpc.RpcError(404, "not found")
+
+    def _relookup(self, vid: int, include_ec: bool = False) -> list:
+        """Best-effort mid-failover lookup refresh: a master outage
+        (leaderless window, exactly when a failover is likely running)
+        must not abort a replica walk that can still succeed against
+        the remaining cached locations."""
+        try:
+            return self.lookup(vid, include_ec=include_ec)
+        except Exception:  # noqa: BLE001 — keep walking the old list
+            return []
 
     def delete(self, fid: str) -> None:
         """Delete a needle, failing over across replicas exactly like
@@ -453,8 +489,11 @@ class WeedClient:
             if auth:
                 jwt = f"?jwt={auth}"
         last_err: Exception | None = None
-        for loc in locs:
-            url = f"http://{loc['url']}/{fid}{jwt}"
+        relooked = False
+        i = 0
+        while i < len(locs):
+            url = f"http://{locs[i]['url']}/{fid}{jwt}"
+            i += 1
             try:
                 rpc.call(url, "DELETE")
                 return
@@ -462,6 +501,17 @@ class WeedClient:
                 last_err = e
                 if e.status == 404 and "volume" in e.message:
                     self.cache.forget(vid)
+                elif e.status in (429, 503) and not relooked:
+                    # Draining (or shedding) replica: the cached
+                    # location list is going stale — re-run the master
+                    # lookup ONCE and walk the fresh replicas instead
+                    # of burning the rest of the list against a node
+                    # that is leaving.
+                    relooked = True
+                    self.cache.forget(vid)
+                    fresh = self._relookup(vid)
+                    if fresh:
+                        locs, i = fresh, 0
             except OSError as e:  # dead server: next replica
                 last_err = e
                 self.cache.forget(vid)

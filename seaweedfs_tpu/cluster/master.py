@@ -67,7 +67,9 @@ class MasterServer:
                  jwt_expires_seconds: int = 10,
                  ssl_context=None,
                  admin_scripts: str = "",
-                 admin_script_interval: float = 17 * 60):
+                 admin_script_interval: float = 17 * 60,
+                 max_concurrent: int = 0,
+                 idle_timeout: float = 120.0):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
@@ -103,8 +105,13 @@ class MasterServer:
         self.vg = VolumeGrowth()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
-        self.server = rpc.JsonHttpServer(host, port,
-                                         ssl_context=ssl_context)
+        # Overload protection (-max.concurrent): bounded assignment/
+        # lookup concurrency with 429 sheds; /heartbeat, healthz, and
+        # the watch streams are admission-exempt.
+        self.server = rpc.JsonHttpServer(
+            host, port, ssl_context=ssl_context,
+            idle_timeout=idle_timeout,
+            admission=rpc.AdmissionControl(max_concurrent))
         s = self.server
         s.route("POST", "/heartbeat", self._heartbeat)
         s.route("GET", "/dir/assign", self._assign)
@@ -165,6 +172,11 @@ class MasterServer:
         # set (dead-node sweep) emits heartbeat.lost, re-entering emits
         # heartbeat.recovered — the journal's liveness timeline.
         self._hb_known: set[str] = set()
+        # node_key -> seq_epoch of the process that said goodbye:
+        # straggler heartbeats from that generation are ignored so a
+        # drained server can't be resurrected by an in-flight beat
+        # racing its own goodbye (a restarted process has a new epoch).
+        self._goodbye_epochs: dict[str, int] = {}
         # Exclusive admin lock (wdclient/exclusive_locks): one shell at a
         # time may run mutating maintenance commands.
         self._admin_lock = threading.Lock()
@@ -407,9 +419,26 @@ class MasterServer:
         # volume server must not let a stale full snapshot erase a
         # just-grown volume, but nodes must not serialize each other.
         node_key = f"{hb['ip']}:{hb['port']}"
+        if hb.get("goodbye"):
+            # Graceful drain, final beat: unregister NOW — no
+            # heartbeat blackout, no dead-sweep window — and remember
+            # the goodbyed process generation so a straggler beat from
+            # the same (now exiting) process can't re-register it.
+            return self._apply_goodbye(node_key, hb)
         with self._hb_apply_lock:
             node_lock = self._hb_node_locks.setdefault(
                 node_key, threading.Lock())
+            goodbyed = self._goodbye_epochs.get(node_key)
+            if goodbyed is not None:
+                if goodbyed == hb.get("seq_epoch"):
+                    # Straggler from a goodbyed process: acknowledge
+                    # without resurrecting the node (a RESTARTED
+                    # server has a fresh epoch, registers normally).
+                    return {"volume_size_limit":
+                            self.topo.volume_size_limit}
+                # A different generation is alive on this address: the
+                # goodbye record has served its purpose.
+                self._goodbye_epochs.pop(node_key, None)
             if node_key not in self._hb_known:
                 self._hb_known.add(node_key)
                 from ..events import emit as emit_event
@@ -417,6 +446,15 @@ class MasterServer:
                            data_center=hb.get("data_center", ""),
                            rack=hb.get("rack", ""))
         with node_lock:
+            # Re-check under node_lock: a beat that read the guard
+            # before a goodbye landed (and was then preempted) must
+            # not re-register the drained node as a ghost — that would
+            # restore the exact dead-sweep window goodbyes eliminate.
+            goodbyed = self._goodbye_epochs.get(node_key)
+            if goodbyed is not None and \
+                    goodbyed == hb.get("seq_epoch"):
+                return {"volume_size_limit":
+                        self.topo.volume_size_limit}
             dn = self.topo.register_data_node(
                 hb.get("data_center", "DefaultDataCenter"),
                 hb.get("rack", "DefaultRack"),
@@ -431,6 +469,10 @@ class MasterServer:
                 # health rollup reports these EC volumes degraded.
                 dn.ec_corrupt = {int(k): v for k, v in
                                  hb["ec_corrupt"].items()}
+            # Lifecycle/capacity flags: _assign steers away from
+            # draining and reserve-breached nodes.
+            dn.draining = bool(hb.get("draining", False))
+            dn.low_disk = bool(hb.get("low_disk", False))
             seq = hb.get("seq")
             if seq is not None:
                 # The epoch changes when the volume server restarts, so
@@ -481,6 +523,37 @@ class MasterServer:
                 "new_vids": sorted(after - before),
                 "deleted_vids": sorted(before - after)})
         return {"volume_size_limit": self.topo.volume_size_limit}
+
+    def _apply_goodbye(self, node_key: str, hb: dict) -> dict:
+        """Handle a drain goodbye: snapshot the node's holdings,
+        unregister it, broadcast the lost vids to /cluster/watch
+        streams (clients re-lookup immediately), and record the
+        goodbyed epoch so straggler beats can't resurrect it."""
+        from ..events import emit as emit_event
+        with self._hb_apply_lock:
+            node_lock = self._hb_node_locks.setdefault(
+                node_key, threading.Lock())
+            self._goodbye_epochs[node_key] = hb.get("seq_epoch", 0)
+        with node_lock:
+            dn = None
+            for leaf in list(self.topo.leaves()):
+                if leaf.url() == node_key:
+                    dn = leaf
+                    break
+            if dn is None:
+                return {"goodbye": True}
+            held_volumes = sorted(dn.volumes)
+            held_ec = sorted(dn.ec_shards)
+            self.topo.unregister_data_node(dn)
+            self._hb_known.discard(node_key)
+        emit_event("node.drained", node=node_key,
+                   volumes=len(held_volumes), ec_shards=len(held_ec))
+        vids = sorted(set(held_volumes) | set(held_ec))
+        if vids:
+            self._broadcast_locations({
+                "url": dn.url(), "public_url": dn.public_url,
+                "new_vids": [], "deleted_vids": vids})
+        return {"goodbye": True}
 
     def _ui(self, query: dict, body: bytes):
         """Status page (the reference's master UI, server/master_ui):
@@ -571,6 +644,27 @@ class MasterServer:
             except Exception:  # noqa: BLE001 — a dying stream cleans
                 pass           # itself up via on_close
 
+    @staticmethod
+    def _locs_blocked(locs) -> bool:
+        """True when ANY replica of a candidate volume sits on a node
+        that should not take new writes: draining (rolling restart) or
+        below its free-space reserve.  A write to such a volume would
+        fail at fan-out time — steer the assignment away instead."""
+        return any(getattr(dn, "draining", False)
+                   or getattr(dn, "low_disk", False) for dn in locs)
+
+    def _steering_exclude(self):
+        """The pick_for_write exclude predicate, or None in the steady
+        state: filtering every writable volume through the predicate
+        is O(writables x replicas) on the assign hot path, so pay it
+        only while at least one node is actually draining or below its
+        reserve (one O(nodes) scan per assign)."""
+        for dn in list(self.topo.leaves()):
+            if getattr(dn, "draining", False) or \
+                    getattr(dn, "low_disk", False):
+                return self._locs_blocked
+        return None
+
     def _option_from_query(self, query: dict) -> VolumeGrowOption:
         return VolumeGrowOption(
             collection=query.get("collection", ""),
@@ -605,9 +699,10 @@ class MasterServer:
                     emit_event("volume.grow", node=self.url(),
                                count=grown, reason="assign",
                                collection=option.collection)
+        exclude = self._steering_exclude()
         try:
-            fid, count, locs = self.topo.pick_for_write(count, option,
-                                                        layout)
+            fid, count, locs = self.topo.pick_for_write(
+                count, option, layout, exclude=exclude)
         except NotLeader:
             # The RaftSequencer's block alloc can discover lost
             # leadership (exactly the failover window it exists for):
@@ -616,6 +711,37 @@ class MasterServer:
         except TimeoutError as e:
             raise rpc.RpcError(
                 503, f"file-id allocation not committed: {e}") from None
+        except ValueError:
+            # Writable volumes exist, but every one has a replica on a
+            # draining or reserve-breached node (rolling restart, disk
+            # filling up): grow fresh volumes on the healthy nodes and
+            # pick again; if the cluster genuinely has nowhere to put
+            # a write, hand the client a paced retry.
+            with self._grow_lock:
+                try:
+                    grown = self.vg.grow_by_type(self.topo, option,
+                                                 self._allocate_volume)
+                except NotLeader:
+                    return self._proxy_to_leader("/dir/assign", query,
+                                                 body)
+                except Exception:  # noqa: BLE001 — no healthy slots
+                    grown = 0
+            if grown:
+                from ..events import emit as emit_event
+                emit_event("volume.grow", node=self.url(), count=grown,
+                           reason="steering",
+                           collection=option.collection)
+            try:
+                fid, count, locs = self.topo.pick_for_write(
+                    count, option, layout, exclude=exclude)
+            except (ValueError, TimeoutError):
+                raise rpc.RpcError(
+                    503, "no writable volumes outside draining/"
+                         "low-disk nodes; retry",
+                    headers={"Retry-After": "1"}) from None
+            except NotLeader:
+                return self._proxy_to_leader("/dir/assign", query,
+                                             body)
         dn = locs[0]
         out = {"fid": fid, "count": count,
                "url": dn.url(), "publicUrl": dn.public_url,
@@ -804,11 +930,17 @@ class MasterServer:
                    "breaker": breaker.state if breaker else "closed",
                    "volumes": len(dn.volumes),
                    "ec_shards": len(dn.ec_shards),
+                   "draining": getattr(dn, "draining", False),
+                   "low_disk": getattr(dn, "low_disk", False),
                    "disks": getattr(dn, "disk_statuses", [])}
             nodes.append(row)
             if not alive:
                 problems.append(
                     f"node {dn.url()}: heartbeat stale {age:.1f}s")
+            if row["low_disk"]:
+                problems.append(
+                    f"node {dn.url()}: disk reserve breached — "
+                    f"volumes readonly until space recovers")
             if row["breaker"] == "open":
                 problems.append(f"node {dn.url()}: circuit breaker open")
             for d in row["disks"]:

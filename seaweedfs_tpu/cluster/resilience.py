@@ -201,6 +201,9 @@ class RetryPolicy:
 
     - ConnectError / BreakerOpen: no bytes hit the wire — retried
       always ("connect").
+    - .status == 429 (admission shed — refused before the handler
+      ran): retried always ("shed"), honoring the server's
+      Retry-After pacing hint.
     - exceptions with .status in retry_statuses (5xx): the server
       answered — retried only when `idempotent` ("status").
     - other OSError/ConnectionError (reset mid-exchange, timeout):
@@ -215,7 +218,8 @@ class RetryPolicy:
                  base_delay: float = 0.05, max_delay: float = 2.0,
                  per_attempt_timeout: float = 10.0,
                  total_deadline: float | None = None,
-                 retry_statuses: tuple[int, ...] = (500, 502, 503, 504),
+                 retry_statuses: tuple[int, ...] = (429, 500, 502,
+                                                    503, 504),
                  rng: random.Random | None = None):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
@@ -241,6 +245,11 @@ class RetryPolicy:
             return "connect"
         status = getattr(exc, "status", None)
         if status is not None:
+            if status == 429 and 429 in self.retry_statuses:
+                # Admission shed: the server refused BEFORE running
+                # the handler, so retrying never replays a
+                # non-idempotent body — safe like ConnectError.
+                return "shed"
             if status in self.retry_statuses and idempotent:
                 return "status"
             return None
@@ -272,6 +281,15 @@ class RetryPolicy:
                 if on_retry is not None:
                     on_retry(e, attempt)
                 delay = self.backoff(attempt)
+                # A 429/503 answer may carry the server's own pacing
+                # hint (Retry-After from admission sheds and drain
+                # refusals): honor it as a floor under the jittered
+                # backoff, capped at the per-attempt budget so a
+                # hostile/buggy header can't park the client.
+                retry_after = getattr(e, "retry_after", None)
+                if retry_after:
+                    delay = max(delay, min(float(retry_after),
+                                           self.per_attempt_timeout))
                 if deadline is not None:
                     delay = min(delay,
                                 max(0.0, deadline - time.monotonic()))

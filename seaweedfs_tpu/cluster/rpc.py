@@ -30,10 +30,12 @@ import struct
 import threading
 import time
 import urllib.parse
+import weakref
 from typing import Callable
 
 from ..events import journal as _events
 from ..fault import registry as _fault
+from ..stats.metrics import Counter, Gauge
 from ..trace import tracer as _tracer
 from . import resilience as _res
 
@@ -45,9 +47,19 @@ _REASONS = {200: "OK", 201: "Created", 204: "No Content",
             406: "Not Acceptable", 409: "Conflict",
             412: "Precondition Failed", 414: "URI Too Long",
             416: "Range Not Satisfiable", 423: "Locked",
+            429: "Too Many Requests",
             431: "Request Header Fields Too Large",
             500: "Internal Server Error",
-            501: "Not Implemented", 503: "Service Unavailable"}
+            501: "Not Implemented", 503: "Service Unavailable",
+            507: "Insufficient Storage"}
+
+# Internal cluster traffic (replication fan-out, scrub repair fetches,
+# EC rebuild shard gathers/scatters) marks itself with this header so
+# the receiving server's admission control routes it through the
+# lower-priority `internal` lane — a repair storm must never starve
+# user reads (the operational lesson of arXiv:1309.0186).
+PRIORITY_HEADER = "X-Weed-Priority"
+PRIORITY_LOW = {PRIORITY_HEADER: "low"}
 
 
 import re as _re
@@ -88,10 +100,201 @@ def parse_byte_range(rng: str, size: int) -> tuple[int, int] | None:
 
 
 class RpcError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: dict | None = None,
+                 retry_after: float | None = None):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
+        # Extra response headers a handler wants on its error answer
+        # (Retry-After on 429/503 sheds and drain refusals).
+        self.headers = dict(headers or {})
+        # Parsed Retry-After from a server's answer (client side):
+        # RetryPolicy honors it as a backoff floor on 429/503.
+        self.retry_after = retry_after
+
+
+# -- admission control --------------------------------------------------------
+# Per-role overload protection: bounded concurrency in three lanes
+# (read / write / internal) with a bounded wait queue per lane.  A
+# request that finds its lane full AND its queue full (or waits out the
+# queue timeout) is shed with 429 + Retry-After instead of queueing the
+# server into collapse.  Internal traffic (PRIORITY_HEADER: low —
+# replication, scrub repair, EC rebuilds) runs in its own smaller lane
+# so a repair storm cannot starve user traffic.  With max_concurrent=0
+# nothing is ever shed, but in-flight requests are still counted — the
+# graceful-drain path waits on that count.
+
+# Like the breaker/retry/fault instruments, these are process-global:
+# roles sharing one process (`weed server`, test stacks) report merged
+# numbers on every scrape — the established convention for this
+# codebase's RPC-plane instruments (see enable_metrics).
+requests_shed_total = Counter(
+    "SeaweedFS_requests_shed_total",
+    "requests shed (429) by admission control", ("lane",))
+
+_admission_instances: "weakref.WeakSet[AdmissionControl]" = \
+    weakref.WeakSet()
+
+
+def _inflight_values() -> dict:
+    out = {("read",): 0.0, ("write",): 0.0, ("internal",): 0.0}
+    for adm in list(_admission_instances):
+        for lane in adm.lanes.values():
+            out[(lane.name,)] += float(lane.inflight)
+    return out
+
+
+inflight_requests = Gauge(
+    "SeaweedFS_inflight_requests",
+    "admitted requests currently executing", ("lane",),
+    callback=_inflight_values)
+
+
+class _Lane:
+    """One admission lane: a concurrency cap plus a bounded wait queue.
+
+    cap == 0 means unlimited (count in-flight only, never shed).  The
+    queue is bounded in BOTH dimensions: at most `queue_depth` waiters,
+    each waiting at most `queue_timeout` seconds — so under sustained
+    overload latency stays bounded and the excess is shed immediately
+    instead of building an unbounded backlog that outlives the burst.
+    """
+
+    __slots__ = ("name", "cap", "queue_depth", "queue_timeout", "_sem",
+                 "inflight", "waiting", "shed", "_lock",
+                 "_last_shed_emit")
+
+    def __init__(self, name: str, cap: int, queue_depth: int,
+                 queue_timeout: float):
+        self.name = name
+        self.cap = cap
+        self.queue_depth = queue_depth
+        self.queue_timeout = queue_timeout
+        self._sem = threading.BoundedSemaphore(cap) if cap > 0 else None
+        self.inflight = 0
+        self.waiting = 0
+        self.shed = 0
+        self._lock = threading.Lock()
+        self._last_shed_emit = 0.0
+
+    def enter(self) -> bool:
+        """Admit (possibly after a bounded wait) or shed; True = admitted
+        (the caller MUST pair it with exit())."""
+        if self._sem is None:
+            with self._lock:
+                self.inflight += 1
+            return True
+        if self._sem.acquire(blocking=False):
+            with self._lock:
+                self.inflight += 1
+            return True
+        with self._lock:
+            queue_full = self.waiting >= self.queue_depth
+            if not queue_full:
+                self.waiting += 1
+        if queue_full:
+            self._record_shed()
+            return False
+        ok = self._sem.acquire(timeout=self.queue_timeout)
+        with self._lock:
+            self.waiting -= 1
+            if ok:
+                self.inflight += 1
+        if not ok:
+            self._record_shed()
+        return ok
+
+    def exit(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+        if self._sem is not None:
+            self._sem.release()
+
+    def _record_shed(self) -> None:
+        requests_shed_total.inc(lane=self.name)
+        with self._lock:
+            self.shed += 1
+            now = time.monotonic()
+            emit = now - self._last_shed_emit >= 5.0
+            if emit:
+                self._last_shed_emit = now
+            shed_total = self.shed
+        if emit:
+            # Events are state transitions, not per-request traffic:
+            # one journal row per shedding episode (>=5s apart), with
+            # the cumulative count so the timeline still quantifies it.
+            with _tracer.root_span("admission.shed", "rpc"):
+                _events.emit("server.shed", severity="warn",
+                             lane=self.name, shed_total=shed_total,
+                             cap=self.cap,
+                             queue_depth=self.queue_depth)
+
+
+# Paths never queued or shed: operator/introspection surfaces must stay
+# reachable exactly when the server is overloaded or draining (which is
+# when they are needed), heartbeats keep the master's liveness view
+# honest, and long-lived push streams (/cluster/watch) would pin a lane
+# slot forever.
+_ADMISSION_EXEMPT = {"/metrics", "/cluster/healthz", "/heartbeat",
+                     "/admin/drain", "/admin/status", "/cluster/watch"}
+
+
+def _admission_exempt(path: str) -> bool:
+    return path in _ADMISSION_EXEMPT or path.startswith("/debug/")
+
+
+class AdmissionControl:
+    """Admission state for one server role (-max.concurrent).
+
+    read / write lanes each get `max_concurrent` slots; the internal
+    lane (PRIORITY_HEADER: low, and ?type=replicate fan-outs) gets a
+    quarter of that, so background repair/replication pressure is
+    capped below user traffic.  queue_depth defaults to 2x the lane's
+    concurrency."""
+
+    LANES = ("read", "write", "internal")
+
+    def __init__(self, max_concurrent: int = 0,
+                 queue_depth: int | None = None,
+                 queue_timeout: float = 2.0,
+                 internal_concurrent: int | None = None,
+                 retry_after: float = 1.0):
+        self.max_concurrent = max_concurrent
+        if queue_depth is None:
+            queue_depth = 2 * max_concurrent
+        if internal_concurrent is None:
+            internal_concurrent = max(1, max_concurrent // 4) \
+                if max_concurrent else 0
+        self.retry_after = retry_after
+        self.lanes = {
+            "read": _Lane("read", max_concurrent, queue_depth,
+                          queue_timeout),
+            "write": _Lane("write", max_concurrent, queue_depth,
+                           queue_timeout),
+            "internal": _Lane("internal", internal_concurrent,
+                              max(1, queue_depth // 2)
+                              if internal_concurrent else 0,
+                              queue_timeout),
+        }
+        _admission_instances.add(self)
+
+    def lane_for(self, method: str, headers: dict,
+                 query: dict) -> _Lane:
+        if headers.get("x-weed-priority") == "low" or \
+                query.get("type") == "replicate":
+            return self.lanes["internal"]
+        if method in ("GET", "HEAD"):
+            return self.lanes["read"]
+        return self.lanes["write"]
+
+    def inflight_total(self) -> int:
+        return sum(lane.inflight for lane in self.lanes.values())
+
+    def snapshot(self) -> dict:
+        return {name: {"cap": lane.cap, "inflight": lane.inflight,
+                       "waiting": lane.waiting, "shed": lane.shed}
+                for name, lane in self.lanes.items()}
 
 
 def free_port() -> int:
@@ -321,11 +524,21 @@ class JsonHttpServer:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 pass_headers: bool = False, ssl_context=None):
+                 pass_headers: bool = False, ssl_context=None,
+                 idle_timeout: float = 120.0,
+                 admission: AdmissionControl | None = None):
         self.host = host
         self.port = port or free_port()
         self.pass_headers = pass_headers
         self.ssl_context = ssl_context
+        # Per-connection socket timeout: a peer that stalls mid-request
+        # (slow-loris) or goes silent is reaped after this many idle
+        # seconds, freeing its thread + (if admitted) its lane slot.
+        self.idle_timeout = idle_timeout
+        # Overload protection (AdmissionControl).  Always present so
+        # in-flight accounting works even with no concurrency cap —
+        # graceful drain waits on it.
+        self.admission = admission or AdmissionControl(0)
         self.routes: dict[tuple[str, str], Callable] = {}
         self.prefix_routes: list[tuple[str, str, Callable]] = []
         self.metrics = None  # (Registry, Counter, Histogram) when on
@@ -367,6 +580,10 @@ class JsonHttpServer:
         reg.register_once(_res.breaker_state_gauge)
         reg.register_once(_fault.faults_injected_total)
         reg.register_once(_events.events_total)
+        # Overload-protection instruments (admission control): shed
+        # counts by lane and the live in-flight gauge.
+        reg.register_once(requests_shed_total)
+        reg.register_once(inflight_requests)
         if serve_route:
             self.serve_metrics_route(reg)
         return reg
@@ -430,7 +647,7 @@ class JsonHttpServer:
                 # Handshake in the connection thread so a slow/bogus
                 # client can't stall the accept loop.
                 conn = self.ssl_context.wrap_socket(conn, server_side=True)
-                conn.settimeout(120.0)
+                conn.settimeout(self.idle_timeout)
             else:
                 # Kernel-enforced timeouts keep the socket in blocking
                 # mode: Python's settimeout() makes every read a
@@ -442,7 +659,8 @@ class JsonHttpServer:
                 # NOT use this trick: there b"" would trigger the
                 # stale-keep-alive retry and re-send a non-idempotent
                 # RPC on a mere timeout.)
-                tv = struct.pack("ll", 120, 0)
+                tv = struct.pack("ll", int(self.idle_timeout),
+                                 int(self.idle_timeout % 1 * 1e6))
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
             rf = conn.makefile("rb", buffering=1 << 16)
@@ -571,6 +789,39 @@ class JsonHttpServer:
                           None, close=not keep)
             return keep
 
+        # Admission gate: classify into a lane (read / write /
+        # internal) and acquire a slot — or shed with 429 +
+        # Retry-After when the lane AND its bounded wait queue are
+        # full.  The body was already read (or is drained below), so
+        # keep-alive framing survives a shed.  Exempt paths
+        # (introspection, heartbeats, push streams) skip the gate.
+        lane = None
+        if not _admission_exempt(req_path):
+            lane = self.admission.lane_for(method, headers, query)
+            if not lane.enter():
+                if not self._finish_stream_body(body):
+                    keep = False
+                self._respond(
+                    conn, method, 429,
+                    {"error": f"overloaded: {lane.name} lane and its "
+                              f"wait queue are full; retry"},
+                    {"Retry-After":
+                     f"{self.admission.retry_after:g}"},
+                    close=not keep)
+                return keep
+        try:
+            return self._dispatch(conn, method, req_path, headers,
+                                  query, body, fn, args, keep)
+        finally:
+            if lane is not None:
+                lane.exit()
+
+    def _dispatch(self, conn, method: str, req_path: str,
+                  headers: dict, query: dict, body, fn, args,
+                  keep: bool) -> bool:
+        """Run the routed handler and write its response — the back
+        half of _serve_one, split out so the admission gate can wrap
+        it in one try/finally slot release."""
         metrics = self.metrics
         t0 = time.perf_counter() if metrics else 0.0
         # Tracing middleware: one server span per routed request,
@@ -605,7 +856,7 @@ class JsonHttpServer:
             if not self._finish_stream_body(body):
                 keep = False
             self._respond(conn, method, e.status, {"error": e.message},
-                          None, close=not keep)
+                          e.headers or None, close=not keep)
             return keep
         except ConnectionError as e:
             _tracer.end_server_span(tspan, 500)
@@ -1045,7 +1296,19 @@ def _request(url: str, method: str, body, timeout: float,
             # stale-keep-alive path a real one would.
             if _fault.ARMED:
                 _fault.hit("rpc.send", host=f"{host}:{port}", url=url)
-            conn.sock.sendall(req)
+            if _fault.ARMED and "net.slow_client" in _fault.ARMED:
+                # Slow-loris injector: send half the request, fire the
+                # fault (a `delay:S` spec stalls here mid-request), then
+                # send the rest.  A server whose idle timeout is shorter
+                # than the stall reaps the connection, and the second
+                # sendall/read surfaces it as a peer reset.
+                half = max(1, len(req) // 2)
+                conn.sock.sendall(req[:half])
+                _fault.hit("net.slow_client", host=f"{host}:{port}",
+                           url=url)
+                conn.sock.sendall(req[half:])
+            else:
+                conn.sock.sendall(req)
             if _fault.ARMED:
                 _fault.hit("rpc.recv", host=f"{host}:{port}", url=url)
             line = conn.rf.readline(65537)
@@ -1112,7 +1375,16 @@ def _raise_rpc_error(resp: _Resp, data: bytes) -> None:
             "error", f"HTTP Error {resp.status}: {resp.reason}")
     except Exception:  # noqa: BLE001
         message = f"HTTP Error {resp.status}: {resp.reason}"
-    raise RpcError(resp.status, message)
+    # Surface the server's pacing hint (admission sheds, drain
+    # refusals): RetryPolicy uses it as a backoff floor on 429/503.
+    retry_after = None
+    ra = resp.getheader("retry-after")
+    if ra:
+        try:
+            retry_after = float(ra)
+        except ValueError:
+            pass
+    raise RpcError(resp.status, message, retry_after=retry_after)
 
 
 def call(url: str, method: str = "GET", body: bytes | None = None,
@@ -1162,14 +1434,16 @@ def call_status(url: str, method: str = "GET",
     return resp.status, data
 
 
-def call_to_file(url: str, path: str, timeout: float = 600.0) -> int:
+def call_to_file(url: str, path: str, timeout: float = 600.0,
+                 headers: dict | None = None) -> int:
     """Stream a GET response to a file in chunks; returns byte count.
     Bulk transfers (volume/shard copies) must never buffer a 30GB .dat
     in memory (the reference streams CopyFile in chunks too).  Writes
     land in a `.dl.tmp` sibling renamed into place only on a complete
     transfer, so a truncated download never masquerades as a valid
     shard/volume file at the destination path."""
-    resp, conn = _request(url, "GET", None, timeout)
+    resp, conn = _request(url, "GET", None, timeout,
+                          req_headers=headers)
     if resp.status >= 400:
         try:
             data = resp.read()

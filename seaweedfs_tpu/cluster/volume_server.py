@@ -37,8 +37,8 @@ from ..stats.metrics import needle_repairs_total, observe_ec_stage
 from ..storage.scrub import ScrubDaemon
 from ..storage.store import Store
 from ..storage.vacuum import vacuum as vacuum_volume
-from ..storage.volume import (CorruptNeedleError, NotFoundError,
-                              VolumeError)
+from ..storage.volume import (CorruptNeedleError, DiskFullError,
+                              NotFoundError, VolumeError)
 from ..trace import span as trace_span
 from . import rpc
 
@@ -56,7 +56,12 @@ class VolumeServer:
                  read_redirect: bool = True,
                  scrub_mbps: float = 32.0,
                  scrub_interval: float = 3600.0,
-                 fsync: bool = False):
+                 fsync: bool = False,
+                 max_concurrent: int = 0,
+                 queue_depth: int | None = None,
+                 shutdown_grace: float = 30.0,
+                 disk_reserve_mb: float = 0.0,
+                 idle_timeout: float = 120.0):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -78,10 +83,24 @@ class VolumeServer:
         # -read.redirect (volume.go:79, default true): GETs of volumes
         # not hosted here 301 to a current holder instead of 404ing.
         self.read_redirect = read_redirect
-        self.server = rpc.JsonHttpServer(host, port,
-                                         ssl_context=ssl_context)
+        # Overload protection (-max.concurrent): bounded read/write
+        # lanes + the lower-priority internal lane; 0 = no shedding
+        # (in-flight is still tracked for graceful drain).
+        self.server = rpc.JsonHttpServer(
+            host, port, ssl_context=ssl_context,
+            idle_timeout=idle_timeout,
+            admission=rpc.AdmissionControl(max_concurrent,
+                                           queue_depth=queue_depth))
         self.store = Store(directories, max_volume_counts,
-                           ip=host, port=self.server.port)
+                           ip=host, port=self.server.port,
+                           disk_reserve_bytes=int(disk_reserve_mb
+                                                  * 1024 * 1024))
+        # Graceful lifecycle (-shutdown.grace): draining mode refuses
+        # new writes, finishes in-flight work, then says goodbye so the
+        # master unregisters without a dead-sweep window.
+        self.shutdown_grace = shutdown_grace
+        self.draining = False
+        self._drain_lock = threading.Lock()
         self.ec_volumes: dict[int, EcVolume] = {}
         self._ec_recv_lock = threading.Lock()
         self._ec_recv_vlocks: dict[int, threading.Lock] = {}
@@ -147,6 +166,7 @@ class VolumeServer:
         s.route("POST", "/query", self._query)
         s.route("GET", "/admin/volume_tail", self._volume_tail)
         s.route("POST", "/admin/leave", self._admin_leave)
+        s.route("POST", "/admin/drain", self._admin_drain)
         s.route("POST", "/admin/tier_upload", self._tier_upload)
         s.route("POST", "/admin/tier_download", self._tier_download)
         self._setup_metrics()
@@ -246,6 +266,16 @@ class VolumeServer:
                       for l in self.store.locations})
         reg.gauge("SeaweedFS_memory_rss_bytes", "resident set size",
                   callback=lambda: float(memory_status()["rss"]))
+        # Free-space reserve breaches (-disk.reserve): 1 while the
+        # directory's free bytes sit below the reserve (its volumes are
+        # readonly), 0 otherwise.
+        reg.gauge("SeaweedFS_disk_reserve_breached",
+                  "1 while the dir's free space is below -disk.reserve",
+                  ("dir",), callback=lambda: {
+                      (l.directory,):
+                      1.0 if l.directory in self.store.low_disk_dirs
+                      else 0.0
+                      for l in self.store.locations})
         # EC pipeline stage instruments are process-global singletons
         # (every coder/reconstruction path observes into them); exposing
         # them here puts kernel/staging/fan-out time on this server's
@@ -294,6 +324,12 @@ class VolumeServer:
         # A master we haven't registered with yet (leader switch / seed
         # rotation) needs the full picture, not a delta.
         full = full or getattr(self, "_need_full", False)
+        # Free-space reserve enforcement rides the heartbeat cadence:
+        # volumes on a breached location flip readonly here, BEFORE the
+        # snapshot below reports them, so the master learns the
+        # readonly state and the low-disk flag in the same beat.
+        if self.store.check_disk_reserve():
+            full = True  # readonly flips must reach the master now
         # Heartbeats are POSTed from two threads (pulse loop + the
         # post-allocate beat); the sequence number lets the master drop
         # any snapshot that arrives after a newer one, or a stale full
@@ -315,6 +351,11 @@ class VolumeServer:
                 # Detected-but-unrepaired EC shard corruption (scrub):
                 # the master's healthz reports these volumes degraded.
                 "ec_corrupt": self.scrub.ec_corrupt_counts(),
+                # Lifecycle + capacity flags: the master's _assign
+                # steers away from draining/low-disk nodes and healthz
+                # reports them without a per-node scrape.
+                "draining": self.draining,
+                "low_disk": bool(self.store.low_disk_dirs),
             }
             if full:
                 hb["volumes"] = [
@@ -716,7 +757,8 @@ class VolumeServer:
             try:
                 head = rpc.call(
                     f"http://{url}/admin/ec/shard_read?volume={ev.vid}"
-                    f"&shard=0&offset=0&size=64")
+                    f"&shard=0&offset=0&size=64",
+                    headers=rpc.PRIORITY_LOW)
                 ev._version = SuperBlock.from_bytes(bytes(head)).version
                 return
             except Exception:  # noqa: BLE001
@@ -868,7 +910,12 @@ class VolumeServer:
             if len(buf) == size:
                 return buf
         me = self.url()
-        hdrs = {"traceparent": traceparent} if traceparent else None
+        # Shard gathers are internal traffic (low-priority lane at the
+        # holder): a rebuild/degraded-read storm must not starve the
+        # holder's user reads.
+        hdrs = dict(rpc.PRIORITY_LOW)
+        if traceparent:
+            hdrs["traceparent"] = traceparent
         for url in locations.get(sid, []):
             if url == me:
                 continue
@@ -931,7 +978,8 @@ class VolumeServer:
                 continue
             try:
                 blob = rpc.call(f"http://{url}/admin/needle_raw?"
-                                f"volume={vid}&key={key}")
+                                f"volume={vid}&key={key}",
+                                headers=rpc.PRIORITY_LOW)
                 n = Needle.from_bytes(bytes(blob), v.version)
             except Exception:  # noqa: BLE001 — next replica
                 continue
@@ -1087,8 +1135,23 @@ class VolumeServer:
         except JwtError as e:
             raise rpc.RpcError(401, f"jwt: {e}") from None
 
+    def _refuse_if_draining(self, query: dict) -> None:
+        """Draining servers take no NEW writes: 503 + Retry-After
+        rides the client's RetryPolicy/re-assign machinery, and the
+        master is already steering assignments away.  Replica fan-outs
+        (?type=replicate) stay accepted — they are the tail of an
+        operation a sibling already committed, and refusing a
+        tombstone's propagation would leave this node resurrecting the
+        needle after its restart.  Reads keep flowing until the
+        process exits."""
+        if self.draining and query.get("type") != "replicate":
+            raise rpc.RpcError(
+                503, f"volume server {self.url()} is draining",
+                headers={"Retry-After": "1"})
+
     def _post_needle(self, path: str, query: dict, body: bytes) -> dict:
         self._check_write_jwt(path, query)
+        self._refuse_if_draining(query)
         vid, key, cookie = self._parse_fid_path(path)
         if _fault.ARMED:
             _fault.hit("volume.write", vid=vid, server=self.url())
@@ -1123,9 +1186,22 @@ class VolumeServer:
         # only, unless the request opts into durability with
         # ?fsync=true (the flag is forwarded to replicas in _replicate
         # so every copy honors it).
-        _offset, size = self.store.write_needle(
-            vid, n, fsync=self.fsync_writes or
-            query.get("fsync") == "true")
+        try:
+            _offset, size = self.store.write_needle(
+                vid, n, fsync=self.fsync_writes or
+                query.get("fsync") == "true")
+        except DiskFullError as e:
+            # ENOSPC: the volume rolled the partial record back and
+            # flipped readonly.  Flip the rest of the breached
+            # location's volumes too (the reserve check sees free==0)
+            # and heartbeat so the master re-steers immediately; the
+            # client re-assigns on the 500.
+            self.store.check_disk_reserve()
+            try:
+                self._send_heartbeat(full=True)
+            except Exception:  # noqa: BLE001
+                pass
+            raise rpc.RpcError(500, str(e)) from None
         if query.get("type") != "replicate":
             try:
                 self._replicate(path, query, body, "POST", vid=vid,
@@ -1146,6 +1222,7 @@ class VolumeServer:
 
     def _delete_needle(self, path: str, query: dict, body: bytes) -> dict:
         self._check_write_jwt(path, query)
+        self._refuse_if_draining(query)
         vid, key, _cookie = self._parse_fid_path(path)
         v = self.store.find_volume(vid)
         if v is None:
@@ -1200,7 +1277,10 @@ class VolumeServer:
             # and pass it explicitly so each replica's server span
             # parents under it.
             tp = rspan.traceparent()
-            send_hdrs = dict(hdrs or {})
+            # Replication fan-out is internal traffic: the sibling's
+            # admission control routes it through the low-priority
+            # lane so a replication surge can't starve its user reads.
+            send_hdrs = dict(hdrs or {}, **rpc.PRIORITY_LOW)
             if tp:
                 send_hdrs["traceparent"] = tp
 
@@ -1292,6 +1372,64 @@ class VolumeServer:
         process actually stops)."""
         self._stop.set()
         return {"leaving": True}
+
+    # -- graceful lifecycle ---------------------------------------------------
+
+    def _admin_drain(self, query: dict, body: bytes) -> dict:
+        """POST /admin/drain [{grace}]: enter draining mode and block
+        until in-flight requests finish (or grace expires), then say
+        goodbye to the master.  The route is admission-exempt, so the
+        drain request itself never deadlocks the in-flight wait."""
+        req = json.loads(body) if body else {}
+        grace = float(req.get("grace", self.shutdown_grace))
+        return self.drain(grace)
+
+    def drain(self, grace: float | None = None) -> dict:
+        """Graceful shutdown, phase one (SIGTERM / /admin/drain /
+        cluster.drain): refuse new writes with 503 + Retry-After (the
+        client's RetryPolicy fails over / re-assigns), finish in-flight
+        requests up to `grace` seconds, then send a goodbye heartbeat
+        so the master unregisters this node IMMEDIATELY — no heartbeat
+        blackout, no dead-sweep window.  Reads keep being served until
+        the process actually exits (stop())."""
+        grace = self.shutdown_grace if grace is None else grace
+        with self._drain_lock:
+            if self.draining:
+                return {"draining": True, "already": True}
+            self.draining = True
+        emit_event("node.draining", node=self.url(), severity="warn",
+                   grace=grace)
+        try:
+            # Publish the draining flag right away: the master stops
+            # assigning writes here while we wait out the in-flight.
+            self._send_heartbeat(full=True)
+        except Exception:  # noqa: BLE001 — master down: drain anyway
+            pass
+        adm = self.server.admission
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if adm.inflight_total() == 0:
+                break
+            time.sleep(0.02)
+        # Stop the pulse loop BEFORE the goodbye so a periodic beat
+        # can't race it and re-register this node post-goodbye (the
+        # master also ignores stale beats from a goodbyed epoch).
+        self._stop.set()
+        self._send_goodbye()
+        return {"draining": True,
+                "inflight": adm.inflight_total()}
+
+    def _send_goodbye(self) -> None:
+        """Final heartbeat: the master unregisters this node now
+        instead of waiting for the dead-node sweep to notice the
+        heartbeat blackout."""
+        hb = {"ip": self.server.host, "port": self.server.port,
+              "goodbye": True, "seq_epoch": self._hb_epoch}
+        try:
+            rpc.call(f"{self.master_url}/heartbeat", "POST",
+                     json.dumps(hb).encode(), timeout=5.0)
+        except Exception:  # noqa: BLE001 — master down: its dead-node
+            pass           # sweep remains the fallback
 
     def _admin_readonly(self, query: dict, body: bytes) -> dict:
         req = json.loads(body)
@@ -1493,7 +1631,8 @@ class VolumeServer:
         for sid in shard_ids:
             rpc.call_to_file(f"http://{source}/admin/ec/shard_file?"
                              f"volume={vid}&shard={sid}",
-                             base + to_ext(sid))
+                             base + to_ext(sid),
+                             headers=rpc.PRIORITY_LOW)
         with ecc_lock(base):
             ecc = ShardChecksums.load(base)
             for sid in shard_ids:
@@ -1742,7 +1881,8 @@ class VolumeServer:
         base = os.path.join(loc.directory, name)
         for ext in (".idx", ".dat"):
             rpc.call_to_file(f"http://{source}/admin/volume_file?"
-                             f"volume={vid}&ext={ext}", base + ext)
+                             f"volume={vid}&ext={ext}", base + ext,
+                             headers=rpc.PRIORITY_LOW)
         v = self.store.mount_volume(vid)
         self._send_heartbeat()
         return {"volume": vid, "size": v.dat_size()}

@@ -45,7 +45,7 @@ def _security(component: str):
     return ctx
 
 
-def _wait_forever(servers: list) -> int:
+def _wait_forever(servers: list, grace: float | None = None) -> int:
     stop = threading.Event()
 
     def handler(signum, frame):
@@ -56,6 +56,19 @@ def _wait_forever(servers: list) -> int:
     try:
         stop.wait()
     finally:
+        # Graceful lifecycle: SIGTERM/SIGINT first DRAINS every role
+        # that supports it — refuse new writes (503 + Retry-After so
+        # clients fail over), finish in-flight requests up to
+        # -shutdown.grace, goodbye the master so it unregisters with
+        # no dead-sweep window — and only then tears listeners down.
+        for s in servers:
+            drain = getattr(s, "drain", None)
+            if drain is None:
+                continue
+            try:
+                drain(grace) if grace is not None else drain()
+            except Exception as e:  # noqa: BLE001 — still stop below
+                glog.warningf("drain failed: %s", e)
         for s in reversed(servers):
             s.stop()
     return 0
@@ -139,7 +152,9 @@ def run_master(flags: Flags, args: list[str]) -> int:
         ssl_context=_security("master"),
         admin_scripts=mcfg.get_string("master.maintenance.scripts"),
         admin_script_interval=60 * mcfg.get_int(
-            "master.maintenance.sleep_minutes", 17))
+            "master.maintenance.sleep_minutes", 17),
+        max_concurrent=flags.get_int("max.concurrent", 0),
+        idle_timeout=flags.get_float("idle.timeout", 120.0))
     m.start()
     glog.infof("master serving at %s", m.server.url())
     g = _start_master_grpc(m, flags, flags.get("ip", "127.0.0.1"))
@@ -171,12 +186,23 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         # only via volume.scrub / POST /admin/scrub).
         fsync=flags.get_bool("fsync", False),
         scrub_mbps=flags.get_float("scrub.mbps", 32.0),
-        scrub_interval=flags.get_float("scrub.interval", 3600.0))
+        scrub_interval=flags.get_float("scrub.interval", 3600.0),
+        # Overload & lifecycle knobs: -max.concurrent bounds per-lane
+        # request concurrency (0 = no shedding), -disk.reserve (MB)
+        # flips volumes readonly before ENOSPC, -shutdown.grace bounds
+        # the drain wait on SIGTERM, -idle.timeout reaps stalled
+        # (slow-loris) connections.
+        max_concurrent=flags.get_int("max.concurrent", 0),
+        queue_depth=flags.get_int("max.queue", 0) or None,
+        shutdown_grace=flags.get_float("shutdown.grace", 30.0),
+        disk_reserve_mb=flags.get_float("disk.reserve", 0.0),
+        idle_timeout=flags.get_float("idle.timeout", 120.0))
     vs.start()
     glog.infof("volume server serving at %s (dirs %s)",
                vs.server.url(), dirs)
     g = _start_volume_grpc(vs, flags, flags.get("ip", "127.0.0.1"))
-    return _wait_forever([vs] + ([g] if g else []))
+    return _wait_forever([vs] + ([g] if g else []),
+                         grace=flags.get_float("shutdown.grace", 30.0))
 
 
 def run_msg_broker(flags: Flags, args: list[str]) -> int:
@@ -282,7 +308,12 @@ def run_server(flags: Flags, args: list[str]) -> int:
                       fsync=flags.get_bool("fsync", False),
                       scrub_mbps=flags.get_float("scrub.mbps", 32.0),
                       scrub_interval=flags.get_float("scrub.interval",
-                                                     3600.0))
+                                                     3600.0),
+                      max_concurrent=flags.get_int("max.concurrent", 0),
+                      shutdown_grace=flags.get_float("shutdown.grace",
+                                                     30.0),
+                      disk_reserve_mb=flags.get_float("disk.reserve",
+                                                      0.0))
     vs.start()
     servers.append(vs)
     glog.infof("master at %s, volume at %s", m.server.url(),
@@ -290,6 +321,7 @@ def run_server(flags: Flags, args: list[str]) -> int:
     g = _start_master_grpc(m, flags, ip)
     if g:
         servers.append(g)
+    grace = flags.get_float("shutdown.grace", 30.0)
     vg = _start_volume_grpc(vs, flags, ip, allow_port_flag=False)
     if vg:
         servers.append(vg)
@@ -322,7 +354,7 @@ def run_server(flags: Flags, args: list[str]) -> int:
             dav.start()
             servers.append(dav)
             glog.infof("webdav at %s", dav.server.url())
-    return _wait_forever(servers)
+    return _wait_forever(servers, grace=grace)
 
 
 def _norm_master(addr: str) -> str:
@@ -333,7 +365,9 @@ register(Command("master", "master -port=9333 -mdir=/tmp/meta",
                  "start a master server", run_master))
 register(Command("volume",
                  "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
-                 " [-fsync] [-scrub.mbps=32] [-scrub.interval=3600]",
+                 " [-fsync] [-scrub.mbps=32] [-scrub.interval=3600]"
+                 " [-max.concurrent=0] [-disk.reserve=0(MB)]"
+                 " [-shutdown.grace=30]",
                  "start a volume server", run_volume))
 register(Command("filer", "filer -port=8888 -master=host:9333",
                  "start a filer server", run_filer))

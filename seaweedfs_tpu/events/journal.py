@@ -84,6 +84,20 @@ TYPES: dict[str, str] = {
                          "ticket kept) instead of serving bad bytes",
     "volume.recovered": "crash-safe mount truncated a torn tail or "
                         "regenerated a stale .idx",
+    "node.draining": "a server entered draining mode: new writes are "
+                     "refused (503 + Retry-After) while in-flight "
+                     "requests finish",
+    "node.drained": "a draining server said goodbye and the master "
+                    "unregistered it immediately (no dead-sweep "
+                    "window)",
+    "disk.low": "free space fell below the configured reserve "
+                "(-disk.reserve); local volumes flipped readonly "
+                "before ENOSPC could strike",
+    "disk.full": "a write hit ENOSPC; the partial record was rolled "
+                 "back cleanly and the volume flipped readonly",
+    "server.shed": "admission control shed requests (429) under "
+                   "overload — one record per shedding episode with "
+                   "the cumulative count",
 }
 
 SEVERITIES = ("info", "warn", "error")

@@ -79,6 +79,13 @@ POINTS: dict[str, str] = {
                       "are written to disk (the write still succeeds)",
     "disk.read": "volume .dat pread — an armed fail surfaces as an "
                  "OSError, like a failing disk sector",
+    "disk.full": "volume .dat append — an armed fail surfaces as "
+                 "ENOSPC after HALF the record landed (a real torn "
+                 "write), exercising the clean rollback path",
+    "net.slow_client": "client request send — an armed delay:S stalls "
+                       "mid-request after half the bytes, like a "
+                       "slow-loris client; the server's idle timeout "
+                       "should reap the connection",
 }
 
 KINDS = ("fail", "delay", "status", "drop")

@@ -106,10 +106,10 @@ def _fetch_volume(tmpdir: str, vid: int, locs: list[str]) -> str:
                 _fault.hit("ec.fetch_shard", holder=url, vid=vid)
             rpc.call_to_file(
                 f"http://{url}/admin/volume_file?volume={vid}&ext=.idx",
-                base + ".idx")
+                base + ".idx", headers=rpc.PRIORITY_LOW)
             rpc.call_to_file(
                 f"http://{url}/admin/volume_file?volume={vid}&ext=.dat",
-                base + ".dat")
+                base + ".dat", headers=rpc.PRIORITY_LOW)
             return base
         except Exception as e:  # noqa: BLE001 — next replica
             errors.append(f"{url}: {type(e).__name__}: {e}")
@@ -238,7 +238,8 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
                 ecx = f.read()
             for url in plan:
                 rpc.call(f"http://{url}/admin/ec/receive_file?"
-                         f"volume={vid}&ext=.ecx", "POST", ecx, 600.0)
+                         f"volume={vid}&ext=.ecx", "POST", ecx, 600.0,
+                         headers=rpc.PRIORITY_LOW)
                 env.vs_call(url, "/admin/ec/mount", {"volume": vid})
             for url in locs:
                 env.vs_call(url, "/admin/delete_volume", {"volume": vid})
@@ -258,7 +259,8 @@ def _scatter_shard(url: str, vid: int, sid: int,
     if _fault.ARMED:
         _fault.hit("ec.scatter", target=url, vid=vid, shard=sid)
     rpc.call(f"http://{url}/admin/ec/receive_shard?"
-             f"volume={vid}&shard={sid}", "POST", payload, 600.0)
+             f"volume={vid}&shard={sid}", "POST", payload, 600.0,
+             headers=rpc.PRIORITY_LOW)
 
 
 class _ShardWriter:

@@ -130,15 +130,19 @@ def _fetch_shard(holders: list[str], vid: int, sid: int,
                 data = rpc.call(
                     f"http://{url}/admin/ec/shard_file?volume={vid}"
                     f"&shard={sid}",
-                    timeout=min(attempt_timeout, remaining))
+                    timeout=min(attempt_timeout, remaining),
+                    headers=rpc.PRIORITY_LOW)
                 if not isinstance(data, (bytes, bytearray)):
                     raise rpc.RpcError(
                         410, f"shard {vid}.{sid}: non-binary reply")
                 return bytes(data)
             except rpc.RpcError as e:
                 # A definitive HTTP answer (4xx: the holder does not
-                # have the shard) will not change on a retry.
-                if 400 <= e.status < 500 or e.status == 410:
+                # have the shard) will not change on a retry — but a
+                # 429 admission shed is the holder saying "later", not
+                # "never": keep it in the failover rotation.
+                if (400 <= e.status < 500 or e.status == 410) \
+                        and e.status != 429:
                     permanent.add(url)
                 errors.append(f"{url} (try {attempt + 1}): {e}")
             except Exception as e:  # noqa: BLE001 — transient: next
@@ -325,7 +329,7 @@ def _push_shard(vid: int, sid: int, payload: bytes, target: str,
             rpc.call(
                 f"http://{target}/admin/ec/receive_shard?volume={vid}"
                 f"&shard={sid}&ecx_source={src}",
-                "POST", payload, 600.0)
+                "POST", payload, 600.0, headers=rpc.PRIORITY_LOW)
             return
         except rpc.RpcError as e:
             # The target responded: the failure may be its ecx pull

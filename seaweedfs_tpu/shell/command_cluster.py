@@ -81,6 +81,39 @@ class EventsLs(Command):
 
 
 @register
+class ClusterDrain(Command):
+    name = "cluster.drain"
+    help = ("cluster.drain -node host:port [-grace N] — gracefully "
+            "drain one volume server: it refuses new writes (503 + "
+            "Retry-After), finishes in-flight requests up to the "
+            "grace, then goodbyes the master (unregistered "
+            "immediately, no dead-sweep window).  The rolling-upgrade "
+            "step: drain, restart the process, verify with "
+            "cluster.check, move to the next node")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, _rest = self.parse_flags(args)
+        node = flags.get("node", "")
+        if not node:
+            raise ShellError("cluster.drain -node host:port is "
+                             "required")
+        grace = float(flags.get("grace", "30"))
+        base = node if "://" in node else f"http://{node}"
+        try:
+            out = rpc.call_json(f"{base}/admin/drain", "POST",
+                                {"grace": grace},
+                                timeout=grace + 10.0)
+        except Exception as e:  # noqa: BLE001
+            raise ShellError(
+                f"cannot drain {node}: {e}") from None
+        if out.get("already"):
+            return f"{node} was already draining"
+        return (f"{node} drained: new writes refused, "
+                f"{out.get('inflight', 0)} request(s) still in flight "
+                f"at goodbye; safe to stop/upgrade the process")
+
+
+@register
 class ClusterCheck(Command):
     name = "cluster.check"
     help = ("cluster.check — health rollup from the master's "

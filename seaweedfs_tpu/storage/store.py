@@ -117,7 +117,8 @@ class Store:
     def __init__(self, directories: list[str],
                  max_volume_counts: list[int] | None = None,
                  ip: str = "localhost", port: int = 8080,
-                 public_url: str = ""):
+                 public_url: str = "",
+                 disk_reserve_bytes: int = 0):
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
@@ -130,6 +131,74 @@ class Store:
         # Delta events for the heartbeat stream (master sync).
         self.new_volumes: list[VolumeInfo] = []
         self.deleted_volumes: list[VolumeInfo] = []
+        # Free-space reserve (-disk.reserve): volumes on a location
+        # whose free bytes fall below this flip readonly BEFORE ENOSPC
+        # can tear a write.  low_disk_dirs feeds heartbeats (the master
+        # steers assignment away) and the reserve-breached gauge.
+        self.disk_reserve_bytes = int(disk_reserve_bytes)
+        self.low_disk_dirs: set[str] = set()
+        self._reserve_flipped: set[int] = set()
+
+    def check_disk_reserve(self) -> list[int]:
+        """Enforce the free-space reserve: flip volumes on a breached
+        location readonly (recording them), and flip OUR flips back
+        once free space recovers past twice the reserve — the
+        hysteresis keeps a disk hovering at the reserve from flapping
+        volumes between modes.  Called from the heartbeat path (every
+        pulse) and after any ENOSPC.  Returns vids whose mode changed
+        in EITHER direction — the caller must full-heartbeat on any
+        change, or the master would keep recovered volumes out of its
+        writable pool forever."""
+        if self.disk_reserve_bytes <= 0:
+            # Reserve disabled (possibly at runtime): drop any state a
+            # previously-configured reserve left behind, or the node
+            # would stay low-disk/readonly forever.
+            reset: list[int] = []
+            if self.low_disk_dirs or self._reserve_flipped:
+                with self._lock:
+                    self.low_disk_dirs.clear()
+                    for loc in self.locations:
+                        for v in list(loc.volumes.values()):
+                            if v.vid in self._reserve_flipped and \
+                                    v.readonly:
+                                v.set_readonly(False)
+                                reset.append(v.vid)
+                    self._reserve_flipped.clear()
+            return reset
+        from ..stats.sysstats import disk_status
+        flipped: list[int] = []
+        with self._lock:
+            for loc in self.locations:
+                try:
+                    free = disk_status(loc.directory)["free"]
+                except OSError:
+                    continue
+                if free < self.disk_reserve_bytes:
+                    newly_low = loc.directory not in self.low_disk_dirs
+                    self.low_disk_dirs.add(loc.directory)
+                    for v in list(loc.volumes.values()):
+                        if not v.readonly:
+                            v.set_readonly(True)
+                            self._reserve_flipped.add(v.vid)
+                            flipped.append(v.vid)
+                    if newly_low or flipped:
+                        from ..events import emit as emit_event
+                        emit_event("disk.low",
+                                   node=f"{self.ip}:{self.port}",
+                                   severity="warn", dir=loc.directory,
+                                   free_bytes=free,
+                                   reserve_bytes=self.disk_reserve_bytes,
+                                   flipped=len(flipped))
+                elif loc.directory in self.low_disk_dirs and \
+                        free >= 2 * self.disk_reserve_bytes:
+                    self.low_disk_dirs.discard(loc.directory)
+                    for v in list(loc.volumes.values()):
+                        if v.vid in self._reserve_flipped and v.readonly:
+                            v.set_readonly(False)
+                            self._reserve_flipped.discard(v.vid)
+                            flipped.append(v.vid)  # recovered: the
+                            # master must re-learn writability too
+        return flipped
 
     # -- volume management --------------------------------------------------
 

@@ -48,6 +48,14 @@ class CorruptNeedleError(VolumeError):
     route it to self-healing repair instead of a plain 4xx."""
 
 
+class DiskFullError(VolumeError):
+    """An append hit ENOSPC.  The partially-written record was rolled
+    back (truncated — no torn tail for crash recovery to find) and the
+    volume flipped readonly; the volume server re-checks its disk
+    reserve and heartbeats the state so the master steers assignment
+    away."""
+
+
 @dataclass
 class _WriteReq:
     needle: Needle
@@ -354,7 +362,45 @@ class Volume:
                 buf[t.NEEDLE_HEADER_SIZE + 4] ^= 0xFF  # first data byte
                 blob = bytes(buf)
         self._dat.seek(offset)
-        self._dat.write(blob)
+        try:
+            if _fault.ARMED and "disk.full" in _fault.ARMED:
+                # Injected ENOSPC mid-record: half the blob lands (a
+                # real torn write) before the fault fires, so the
+                # rollback below has something real to clean up.
+                half = max(1, len(blob) // 2)
+                self._dat.write(blob[:half])
+                self._dat.flush()
+                _fault.hit("disk.full", vid=self.vid, key=f"{n.id:x}")
+                self._dat.write(blob[half:])
+            else:
+                self._dat.write(blob)
+        except OSError as e:
+            # Roll the partial record back NOW (truncate to the
+            # pre-append offset): the .dat keeps no torn tail, so the
+            # volume stays mountable as-is instead of leaning on crash
+            # recovery at the next mount.  If the truncate itself fails
+            # the torn-tail machinery (scrub.recover_volume_files)
+            # still catches it on remount.
+            try:
+                self._dat.truncate(offset)
+                self._dat.flush()
+            except OSError:
+                pass
+            self._append_at = offset
+            import errno as _errno
+            if isinstance(e, _fault.FaultInjected) or \
+                    e.errno == _errno.ENOSPC:
+                # Out of space: stop admitting writes to this volume
+                # before the next append can tear again.
+                self.readonly = True
+                from ..events import emit as emit_event
+                emit_event("disk.full", severity="error", vid=self.vid,
+                           rolled_back_bytes=len(blob),
+                           key=f"{n.id:x}")
+                raise DiskFullError(
+                    f"volume {self.vid}: disk full (ENOSPC); partial "
+                    f"record rolled back, volume now readonly") from e
+            raise
         self._append_at = offset + len(blob)
         return offset, n.size
 

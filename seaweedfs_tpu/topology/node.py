@@ -163,6 +163,12 @@ class DataNode(Node):
         self.volumes: dict[int, object] = {}  # vid -> VolumeInfo
         self.ec_shards: dict[int, int] = {}   # vid -> ShardBits
         self.last_seen = 0.0
+        # Lifecycle/capacity flags fed by heartbeats: a draining node
+        # is leaving gracefully (rolling restart), a low_disk node has
+        # breached its free-space reserve — neither takes new volumes
+        # or write assignments (volume_growth / master._assign).
+        self.draining = False
+        self.low_disk = False
 
     def url(self) -> str:
         return f"{self.ip}:{self.port}"

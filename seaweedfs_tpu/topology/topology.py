@@ -295,11 +295,13 @@ class Topology(Node):
         return self.layout_for(option).active_volume_count(option) > 0
 
     def pick_for_write(self, count: int, option: VolumeGrowOption,
-                       layout: "VolumeLayout | None" = None
-                       ) -> tuple[str, int, list[DataNode]]:
-        """Returns (fid, count, locations) — the Assign core."""
+                       layout: "VolumeLayout | None" = None,
+                       exclude=None) -> tuple[str, int, list[DataNode]]:
+        """Returns (fid, count, locations) — the Assign core.
+        `exclude(locations)` vetoes volumes (draining/low-disk
+        steering, cluster/master.py)."""
         vl = layout if layout is not None else self.layout_for(option)
-        vid, locs = vl.pick_for_write(option)
+        vid, locs = vl.pick_for_write(option, exclude=exclude)
         if not locs:
             raise ValueError(f"volume {vid} has no locations")
         file_key = self.next_file_key(count)

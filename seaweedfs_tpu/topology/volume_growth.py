@@ -27,6 +27,17 @@ class VolumeGrowth:
     def __init__(self, rng: random.Random | None = None):
         self.rng = rng or random.Random()
 
+    @staticmethod
+    def _node_eligible(n) -> bool:
+        """A data node that may take a new volume replica: has a free
+        slot and is neither draining (rolling restart) nor below its
+        free-space reserve.  The rack/DC free-node COUNTS must apply
+        the same veto as the server-level pick, or growth can commit
+        to a rack whose only free nodes are all draining and fail."""
+        return (n.free_space() >= 1
+                and not getattr(n, "draining", False)
+                and not getattr(n, "low_disk", False))
+
     def find_empty_slots_for_one_volume(
             self, topo: Topology,
             option: VolumeGrowOption) -> list[DataNode]:
@@ -45,7 +56,7 @@ class VolumeGrowth:
             possible_racks = 0
             for rack in node.children.values():
                 free_nodes = sum(1 for n in rack.children.values()
-                                 if n.free_space() >= 1)
+                                 if self._node_eligible(n))
                 if free_nodes >= rp.same_rack_count + 1:
                     possible_racks += 1
             if possible_racks < rp.diff_rack_count + 1:
@@ -66,21 +77,31 @@ class VolumeGrowth:
             if len(node.children) < rp.same_rack_count + 1:
                 return (f"only {len(node.children)} data nodes")
             free_nodes = sum(1 for n in node.children.values()
-                             if n.free_space() >= 1)
+                             if self._node_eligible(n))
             if free_nodes < rp.same_rack_count + 1:
-                return f"only {free_nodes} data nodes with a slot"
+                return f"only {free_nodes} eligible data nodes"
             return None
 
         main_rack, other_racks = main_dc.pick_nodes_by_weight(
             rp.diff_rack_count + 1, rack_filter, self.rng)
 
+        def replica_filter(node) -> str | None:
+            """Shared node veto: full, draining (rolling restart), or
+            below its free-space reserve — none may take a new
+            volume."""
+            if node.free_space() < 1:
+                return "no free slot"
+            if getattr(node, "draining", False):
+                return "draining"
+            if getattr(node, "low_disk", False):
+                return "below disk reserve"
+            return None
+
         def server_filter(node) -> str | None:
             if option.data_node and isinstance(node, DataNode) and \
                     node.id != option.data_node:
                 return f"not preferred data node {option.data_node}"
-            if node.free_space() < 1:
-                return "no free slot"
-            return None
+            return replica_filter(node)
 
         main_server, other_servers = main_rack.pick_nodes_by_weight(
             rp.same_rack_count + 1, server_filter, self.rng)
@@ -88,13 +109,14 @@ class VolumeGrowth:
         servers: list[DataNode] = [main_server]  # type: ignore[list-item]
         servers.extend(other_servers)  # same rack
         for rack in other_racks:
-            r, _ = rack.pick_nodes_by_weight(
-                1, lambda n: None if n.free_space() >= 1 else "full",
-                self.rng)
+            r, _ = rack.pick_nodes_by_weight(1, replica_filter,
+                                             self.rng)
             servers.append(r)
         for dc in other_dcs:
-            # One server anywhere in the other DC with a free slot.
-            candidates = [n for n in dc.leaves() if n.free_space() >= 1]
+            # One server anywhere in the other DC with a free slot
+            # (same eligibility veto as the rack-level picks).
+            candidates = [n for n in dc.leaves()
+                          if self._node_eligible(n)]
             if not candidates:
                 raise ValueError(f"no free server in data center {dc.id}")
             servers.append(self.rng.choice(candidates))

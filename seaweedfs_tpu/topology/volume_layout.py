@@ -99,20 +99,36 @@ class VolumeLayout:
     # -- queries -------------------------------------------------------------
 
     def pick_for_write(self, option=None,
-                       rng: random.Random | None = None
-                       ) -> tuple[int, list[DataNode]]:
-        """Random writable vid (+locations); optional DC/rack/node filter."""
+                       rng: random.Random | None = None,
+                       exclude=None) -> tuple[int, list[DataNode]]:
+        """Random writable vid (+locations); optional DC/rack/node
+        filter.  `exclude(locations) -> bool` vetoes candidate volumes
+        (the master passes its draining/low-disk steering predicate:
+        a replicated write to a vetoed volume would fail at fan-out)."""
         rng = rng or random
         with self._lock:
             if not self.writables:
                 raise ValueError("no more writable volumes!")
             if option is None or not option.data_center:
-                vid = self.writables[rng.randrange(len(self.writables))]
+                if exclude is None:
+                    vid = self.writables[
+                        rng.randrange(len(self.writables))]
+                    return vid, list(self.vid2location.get(vid, []))
+                candidates = [
+                    v for v in self.writables
+                    if not exclude(self.vid2location.get(v, []))]
+                if not candidates:
+                    raise ValueError(
+                        "no writable volumes outside excluded nodes")
+                vid = candidates[rng.randrange(len(candidates))]
                 return vid, list(self.vid2location.get(vid, []))
             # Reservoir-sample a writable replica in the preferred place.
             counter = 0
             chosen = None
             for v in self.writables:
+                if exclude is not None and \
+                        exclude(self.vid2location.get(v, [])):
+                    continue
                 for dn in self.vid2location.get(v, []):
                     dc = dn.get_data_center()
                     if dc is None or dc.id != option.data_center:

@@ -461,6 +461,94 @@ def _drive_volume_recovered(cl):
                   {"volume": vid})
 
 
+def _drive_node_drain(cl):
+    """Graceful lifecycle through the real path: a throwaway volume
+    server drains over HTTP (node.draining emitted by the server) and
+    goodbyes the master (node.drained emitted by its /heartbeat
+    handler, which unregisters the node immediately)."""
+    master, _s, _st, _c, tmp = cl
+    _COLLECTION_N[0] += 1
+    d = tmp / f"drainvs{_COLLECTION_N[0]}"
+    d.mkdir()
+    vs = VolumeServer(master.url(), [str(d)], max_volume_counts=[5],
+                      pulse_seconds=60)
+    vs.start()
+    try:
+        out = rpc.call_json(f"http://{vs.url()}/admin/drain", "POST",
+                            {"grace": 2.0}, timeout=15.0)
+        assert out["draining"], out
+        assert all(dn.url() != vs.url()
+                   for dn in master.topo.leaves()), \
+            "goodbye did not unregister the drained node"
+    finally:
+        vs.stop()
+
+
+def _drive_disk_low(cl):
+    """Reserve breach: free space below an absurd reserve flips the
+    server's volumes readonly and journals disk.low; restoring the
+    reserve undoes the flips (only OURS) so later drivers see the
+    fixture unchanged."""
+    _m, servers, _st, _c, _t = cl
+    vs = servers[0]
+    try:
+        vs.store.disk_reserve_bytes = 1 << 60
+        with root_span("drive.disk_low", "test"):
+            vs.store.check_disk_reserve()
+        assert vs.store.low_disk_dirs
+    finally:
+        vs.store.disk_reserve_bytes = 0
+        vs.store.check_disk_reserve()  # reset: flips ours back
+    assert not vs.store.low_disk_dirs
+
+
+def _drive_disk_full(cl):
+    """Injected ENOSPC during a needle append: the handler 500s, the
+    volume journals disk.full and flips readonly; the volume is then
+    deleted so it cannot degrade later healthz checks."""
+    vid, url, fid = _new_volume(cl, "fullcol")
+    fault.arm("disk.full", "fail*1")
+    try:
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.call(f"http://{url}/{fid}", "POST", b"x" * 256)
+        assert ei.value.status == 500
+    finally:
+        fault.disarm_all()
+    rpc.call_json(f"http://{url}/admin/delete_volume", "POST",
+                  {"volume": vid})
+
+
+def _drive_server_shed(cl):
+    """Overload shed through the real admission gate: a 1-slot,
+    0-queue server sheds the second of two concurrent requests with
+    429 and journals one server.shed episode."""
+    server = rpc.JsonHttpServer(
+        admission=rpc.AdmissionControl(1, queue_depth=0,
+                                       queue_timeout=0.1))
+    server.route("GET", "/slow",
+                 lambda q, b: (time.sleep(0.4), {"ok": True})[1])
+    server.start()
+    statuses = []
+
+    def call_slow():
+        try:
+            rpc.call(f"http://127.0.0.1:{server.port}/slow",
+                     timeout=5.0)
+            statuses.append(200)
+        except rpc.RpcError as e:
+            statuses.append(e.status)
+    try:
+        threads = [threading.Thread(target=call_slow)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert 429 in statuses and 200 in statuses, statuses
+    finally:
+        server.stop()
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -486,6 +574,11 @@ DRIVERS = {
     "needle.repaired": _drive_needle_repaired,
     "volume.quarantine": _drive_volume_quarantine,
     "volume.recovered": _drive_volume_recovered,
+    "node.draining": _drive_node_drain,
+    "node.drained": _drive_node_drain,
+    "disk.low": _drive_disk_low,
+    "disk.full": _drive_disk_full,
+    "server.shed": _drive_server_shed,
 }
 
 
@@ -495,8 +588,9 @@ def test_driver_catalog_matches_registry():
     assert set(DRIVERS) == set(TYPES)
     # Deliberate churn: growing the catalog must touch this number so
     # the diff shows the new types were consciously added (18 from the
-    # journal's introduction + 6 data-integrity types).
-    assert len(TYPES) == 24
+    # journal's introduction + 6 data-integrity types + 5 overload/
+    # lifecycle types).
+    assert len(TYPES) == 29
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))

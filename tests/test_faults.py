@@ -353,6 +353,28 @@ def _drive_disk_read(cl):
     assert client.download(fid) == b"sector bytes"
 
 
+def _drive_disk_full(cl):
+    """Injected ENOSPC mid-append: the write 500s, the partial record
+    is rolled back (no torn tail) and the volume flips readonly."""
+    _master, _servers, _stub, client = cl
+    a = client.assign()
+    fault.arm("disk.full", "fail*1")
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{a['url']}/{a['fid']}", "POST",
+                 b"no space left " * 8)
+    assert ei.value.status == 500
+    assert "disk full" in ei.value.message
+
+
+def _drive_net_slow_client(cl):
+    """A one-shot stall mid-request-send: with the fixture server's
+    default (long) idle timeout the request still completes — the
+    reaping behavior is proven in tests/test_overload.py."""
+    _master, _servers, stub, _client = cl
+    fault.arm("net.slow_client", "delay:0.05*1")
+    rpc.call(f"http://127.0.0.1:{stub.port}/admin/ec/shard_file")
+
+
 DRIVERS = {
     "rpc.connect": _drive_rpc_connect,
     "rpc.send": _drive_rpc_send,
@@ -365,6 +387,8 @@ DRIVERS = {
     "master.heartbeat": _drive_master_heartbeat,
     "volume.corrupt": _drive_volume_corrupt,
     "disk.read": _drive_disk_read,
+    "disk.full": _drive_disk_full,
+    "net.slow_client": _drive_net_slow_client,
 }
 
 

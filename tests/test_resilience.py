@@ -247,3 +247,92 @@ def test_resilience_metrics_on_scrape():
     text = reg.expose()
     assert text.count(
         "# TYPE SeaweedFS_rpc_retries_total counter") == 1
+
+
+# -- overload protection (429 / Retry-After) ---------------------------------
+
+class _StatusErr(Exception):
+    def __init__(self, status, retry_after=None):
+        super().__init__(f"status {status}")
+        self.status = status
+        self.retry_after = retry_after
+
+
+def test_429_shed_retried_even_non_idempotent(monkeypatch):
+    """An admission shed is refused BEFORE the handler runs, so a 429
+    is always safe to retry — even for a non-idempotent body (unlike
+    5xx answers, where the server may have executed the request)."""
+    monkeypatch.setattr(resilience.time, "sleep", lambda s: None)
+    calls = []
+
+    def fn(attempt, timeout):
+        calls.append(attempt)
+        if len(calls) < 3:
+            raise _StatusErr(429)
+        return "ok"
+
+    p = RetryPolicy(max_attempts=3, base_delay=0.001)
+    assert p.run(fn, idempotent=False) == "ok"
+    assert calls == [0, 1, 2]
+
+
+def test_retry_after_is_backoff_floor_capped_at_attempt_budget(
+        monkeypatch):
+    """The server's Retry-After pacing hint floors the jittered
+    backoff, but a hostile/buggy value is capped at the per-attempt
+    timeout so it can never park the client."""
+    slept = []
+    monkeypatch.setattr(resilience.time, "sleep", slept.append)
+
+    def fail_with(ra):
+        calls = []
+
+        def fn(attempt, timeout):
+            calls.append(attempt)
+            raise _StatusErr(429, retry_after=ra)
+        with pytest.raises(_StatusErr):
+            RetryPolicy(max_attempts=2, base_delay=0.0001,
+                        max_delay=0.001,
+                        per_attempt_timeout=0.5).run(fn)
+
+    fail_with(0.3)
+    assert slept and slept[-1] >= 0.3  # floor honored
+    slept.clear()
+    fail_with(999.0)
+    assert slept and slept[-1] <= 0.5  # capped at per-attempt budget
+
+
+def test_rpc_call_parses_retry_after_header():
+    server = rpc.JsonHttpServer()
+    server.route("GET", "/shedme", lambda q, b: (
+        429, {"error": "overloaded"}, {"Retry-After": "2.5"}))
+    server.start()
+    try:
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.call(f"http://127.0.0.1:{server.port}/shedme")
+        assert ei.value.status == 429
+        assert ei.value.retry_after == 2.5
+    finally:
+        server.stop()
+
+
+def test_breaker_treats_429_like_503():
+    """Deliberate shedding from a LIVE process must never open the
+    breaker: a 429 (like a 503) records success, while real 5xx
+    answers keep counting toward opening it."""
+    server = rpc.JsonHttpServer()
+    server.route("GET", "/shed", lambda q, b: (429, {"error": "busy"}))
+    server.route("GET", "/sick", lambda q, b: (500, {"error": "ill"}))
+    server.start()
+    hostport = f"127.0.0.1:{server.port}"
+    try:
+        for _ in range(resilience.BREAKER_THRESHOLD + 2):
+            with pytest.raises(rpc.RpcError):
+                rpc.call(f"http://{hostport}/shed")
+        assert resilience.breaker_for(hostport).state == "closed"
+        for _ in range(resilience.BREAKER_THRESHOLD):
+            with pytest.raises(rpc.RpcError):
+                rpc.call(f"http://{hostport}/sick")
+        assert resilience.breaker_for(hostport).state == "open"
+    finally:
+        server.stop()
