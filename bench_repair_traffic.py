@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Repair-traffic benchmark: shard-bytes-read and wall time per
+single-shard rebuild, `rs` vs `lrc` — prints ONE JSON line to stdout.
+
+Metric: the new BENCH series beside kernel MB/s.  At production scale
+rebuild bandwidth, not encode throughput, is the dominant EC cost
+(arxiv 1309.0186), and this measures exactly that: a volume is encoded
+with each codec, one data shard is deleted, and `rebuild_ec_files`
+regenerates it while SeaweedFS_ec_repair_read_bytes_total counts every
+survivor byte read.  RS(10,4) reads 10 shards; LRC(10,2,2) reads the
+lost shard's 5-member locality group — the read_savings field is the
+measured ratio.
+
+Environment knobs: BENCH_REPAIR_MB (volume size, default 256),
+SEAWEEDFS_TPU_CODER (backend; default auto — pallas on TPU).
+
+All diagnostics go to stderr; stdout carries exactly one JSON line.
+Run on a real chip: python bench_repair_traffic.py [-o BENCH_repair_rNN.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+VOLUME_MB = int(os.environ.get("BENCH_REPAIR_MB", "256"))
+LOST_SHARD = 3  # a data shard inside LRC local group A
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def bench_codec(name: str, tmp: str, payload: np.ndarray) -> dict:
+    from seaweedfs_tpu.codecs import get_codec
+    from seaweedfs_tpu.ec import to_ext
+    from seaweedfs_tpu.ec.encoder import rebuild_ec_files, write_ec_files
+    from seaweedfs_tpu.ops.erasure import new_coder
+    from seaweedfs_tpu.stats.metrics import ec_repair_read_bytes_total
+
+    codec = get_codec(name)
+    base = os.path.join(tmp, f"vol_{name}")
+    with open(base + ".dat", "wb") as f:
+        f.write(payload.tobytes())
+
+    coder = new_coder(codec=name)
+    t0 = time.perf_counter()
+    write_ec_files(base, coder=coder)
+    encode_s = time.perf_counter() - t0
+
+    shard_path = base + to_ext(LOST_SHARD)
+    shard_bytes = os.path.getsize(shard_path)
+    os.remove(shard_path)
+
+    plan = codec.repair_plan(
+        tuple(s for s in range(codec.total_shards) if s != LOST_SHARD),
+        [LOST_SHARD])[0]
+    before = ec_repair_read_bytes_total.value(codec=name)
+    t0 = time.perf_counter()
+    rebuilt = rebuild_ec_files(base, coder=coder)
+    rebuild_s = time.perf_counter() - t0
+    read_bytes = ec_repair_read_bytes_total.value(codec=name) - before
+    assert rebuilt == [LOST_SHARD]
+    assert read_bytes == len(plan.reads) * shard_bytes, \
+        "metric disagrees with the planner — harness bug"
+
+    out = {
+        "codec": name,
+        "volume_mb": VOLUME_MB,
+        "shard_bytes": shard_bytes,
+        "planned_reads": len(plan.reads),
+        "local_repair": plan.local,
+        "repair_read_bytes": int(read_bytes),
+        "rebuild_seconds": round(rebuild_s, 4),
+        "rebuild_mbps": round(shard_bytes / rebuild_s / 1e6, 1),
+        "encode_seconds": round(encode_s, 4),
+    }
+    log(f"{name}: rebuilt shard {LOST_SHARD} reading "
+        f"{len(plan.reads)} shards ({read_bytes / 1e6:.1f} MB) "
+        f"in {rebuild_s:.3f}s")
+    return out
+
+
+def main() -> int:
+    out_path = None
+    args = sys.argv[1:]
+    if "-o" in args:
+        out_path = args[args.index("-o") + 1]
+    try:
+        import jax
+        log(f"device: {jax.devices()[0]}")
+    except Exception as e:  # noqa: BLE001 — CPU-only runs are fine
+        log(f"jax device probe failed ({e}); CPU coder path")
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, VOLUME_MB * 1024 * 1024,
+                           dtype=np.uint8)
+    results = {"metric": "repair_traffic", "volume_mb": VOLUME_MB}
+    with tempfile.TemporaryDirectory(prefix="bench_repair_") as tmp:
+        for name in ("rs", "lrc"):
+            results[name] = bench_codec(name, tmp, payload)
+    results["read_savings"] = round(
+        1.0 - results["lrc"]["repair_read_bytes"]
+        / results["rs"]["repair_read_bytes"], 4)
+    line = json.dumps(results)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+        log(f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,10 +1,14 @@
-"""Per-scheme kernel throughput: RS(10,4) / RS(16,4) / RS(8,3), int8+bf16.
+"""Per-scheme kernel throughput: RS(10,4) / RS(16,4) / RS(8,3) /
+LRC(10,2,2), int8+bf16.
 
 Produces the measurement table in BASELINE.md's "Kernel roofline
 analysis" (execution-fenced via bench.py's shared harness).  The column
 rate it prints is the model quantity: throughput = k bytes/column x
 column rate, column rate <= 6.0e9/s on v5e whatever fraction of the
-128x128 MXU weight tile the (8r, 8k) bit-matrix fills.
+128x128 MXU weight tile the (8r, 8k) bit-matrix fills.  The LRC row
+runs the SAME kernel with the lrc codec's generator — encode cost is
+identical by construction (same (8*4, 8*10) matrix shape as RS(10,4));
+what LRC buys is 2x cheaper repair (bench_repair_traffic.py).
 
 Run on a real chip: python bench_schemes.py
 """
@@ -16,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bench import _make_timed, roofline_limit_mbps
-from seaweedfs_tpu.ops import rs_bitmatrix
+from seaweedfs_tpu.codecs import get_codec, rs_codec
 from seaweedfs_tpu.ops.coder_jax import plane_major
 from seaweedfs_tpu.ops.coder_numpy import NumpyCoder
 from seaweedfs_tpu.ops.coder_pallas import apply_bitmatrix_pallas
@@ -35,33 +39,39 @@ def main():
     timed = _make_timed()
     key = jax.random.PRNGKey(0)
     results = {}
-    for k, r in ((10, 4), (16, 4), (8, 3)):
-        total = k + r
+    schemes = [
+        ("RS(10,4)", "rs10_4", rs_codec(10, 4)),
+        ("RS(16,4)", "rs16_4", rs_codec(16, 4)),
+        ("RS( 8,3)", "rs8_3", rs_codec(8, 3)),
+        ("LRC(10,2,2)", "lrc10_2_2", get_codec("lrc")),
+    ]
+    for label, keybase, cd in schemes:
+        k, r = cd.data_shards, cd.parity_shards
         pm = jnp.asarray(plane_major(
-            rs_bitmatrix.parity_bitmatrix(k, total), r, k), jnp.float32)
+            cd.parity_bitmatrix(), r, k), jnp.float32)
         data = jax.random.randint(key, (k, N), 0, 256,
                                   dtype=jnp.int32).astype(jnp.uint8)
         jax.block_until_ready(data)
-        want = NumpyCoder(k, r).encode(np.asarray(data[:, :BLOCK]))
+        want = NumpyCoder(codec=cd).encode(np.asarray(data[:, :BLOCK]))
         limit = roofline_limit_mbps(r, k)
         for mm in ("int8", "bf16"):
             # correctness gate per scheme AND dtype: an untested
             # lowering must never publish a number.
             got = np.asarray(apply_bitmatrix_pallas(
                 pm, data[:, :BLOCK], r, k, block_n=BLOCK, mm=mm))
-            assert np.array_equal(got, want), f"RS({k},{r}) {mm} wrong"
+            assert np.array_equal(got, want), f"{label} {mm} wrong"
             dt = timed(apply_bitmatrix_pallas, pm, data, r, k,
                        block_n=BLOCK, mm=mm)
             mbps = data.nbytes / dt / 1e6
             if dev.platform == "tpu" and mbps > 1.05 * limit:
-                log(f"RS({k:2d},{r}) {mm}: REJECT {mbps:.0f} MB/s — "
+                log(f"{label} {mm}: REJECT {mbps:.0f} MB/s — "
                     f"exceeds the physical roofline {limit:.0f} MB/s "
                     f"(harness bug, not a result)")
                 continue
             cols = (N / dt) / 1e9
-            log(f"RS({k:2d},{r}) {mm}: {mbps:8.0f} MB/s "
+            log(f"{label:>11s} {mm}: {mbps:8.0f} MB/s "
                 f"({cols:.2f}e9 cols/s, {k}B/col)")
-            results[f"rs{k}_{r}_{mm}"] = round(mbps, 1)
+            results[f"{keybase}_{mm}"] = round(mbps, 1)
         del data
     print(json.dumps(results))
 

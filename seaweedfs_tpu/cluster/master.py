@@ -498,7 +498,8 @@ class MasterServer:
                     dn)
             if "ec_shards" in hb:
                 self.topo.sync_data_node_ec_shards(
-                    [(e["id"], e.get("collection", ""), e["shard_bits"])
+                    [(e["id"], e.get("collection", ""), e["shard_bits"],
+                      e.get("codec", "rs"))
                      for e in hb["ec_shards"]], dn)
             # Incremental EC deltas (master_grpc_server.go handles the
             # same Heartbeat fields): merge into the node's shard bits.
@@ -799,7 +800,7 @@ class MasterServer:
             return out
         ec = self.topo.lookup_ec_shards(vid)
         if ec is not None:
-            return {"volumeId": vid, "ecShards": {
+            return {"volumeId": vid, "ecCodec": ec.codec, "ecShards": {
                 str(sid): [{"url": dn.url(), "publicUrl": dn.public_url}
                            for dn in dns]
                 for sid, dns in ec.locations.items() if dns}}
@@ -909,7 +910,7 @@ class MasterServer:
         health (missing shards, readonly, garbage ratio).  Returns
         (healthy, detail) — the /cluster/healthz and cluster.check
         core."""
-        from ..ec import DATA_SHARDS, TOTAL_SHARDS
+        from ..codecs import get_codec
         from . import resilience as _res
         now = time.time()
         fresh = 2 * self.topo.pulse_seconds
@@ -918,8 +919,9 @@ class MasterServer:
         volumes = []
         with self.topo._lock:
             leaves = list(self.topo.leaves())
-            ec_map = {vid: {sid: [dn.url() for dn in dns]
-                            for sid, dns in loc.locations.items() if dns}
+            ec_map = {vid: ({sid: [dn.url() for dn in dns]
+                             for sid, dns in loc.locations.items() if dns},
+                            loc.codec)
                       for vid, loc in self.topo.ec_shard_map.items()}
         for dn in leaves:
             age = now - dn.last_seen
@@ -972,14 +974,27 @@ class MasterServer:
         if not leaves:
             problems.append("no live data nodes")
         ec_volumes = []
-        for vid, locs in sorted(ec_map.items()):
-            missing = [s for s in range(TOTAL_SHARDS) if s not in locs]
+        for vid, (locs, codec_name) in sorted(ec_map.items()):
+            # Shard counts (and decodability) are per-codec in a
+            # mixed-codec cluster, not the RS(10,4) constants.
+            try:
+                codec = get_codec(codec_name)
+            except ValueError:  # unknown codec id in a stale heartbeat
+                codec = get_codec("rs")
+            total = codec.total_shards
+            missing = [s for s in range(total) if s not in locs]
+            try:
+                codec.repair_plan(tuple(locs), missing)
+                recoverable = True
+            except ValueError:
+                recoverable = False
             ec_volumes.append({"id": vid, "present": len(locs),
-                               "missing": missing})
-            if len(locs) < DATA_SHARDS:
+                               "codec": codec_name, "missing": missing})
+            if not recoverable:
                 problems.append(
                     f"ec volume {vid}: UNRECOVERABLE — only "
-                    f"{len(locs)} of {TOTAL_SHARDS} shards survive")
+                    f"{len(locs)} of {total} shards survive "
+                    f"({codec_name})")
             elif missing:
                 problems.append(
                     f"ec volume {vid}: degraded — missing shards "
@@ -1089,7 +1104,8 @@ class MasterServer:
                             "volumes": [vinfo_to_dict(v)
                                         for v in list(dn.volumes.values())],
                             "ec_shards": [
-                                {"id": vid, "shard_bits": bits}
+                                {"id": vid, "shard_bits": bits,
+                                 "codec": self.topo.ec_codec(vid)}
                                 for vid, bits in dn.ec_shards.items()],
                         })
                     racks.append({"id": rack.id, "nodes": nodes})

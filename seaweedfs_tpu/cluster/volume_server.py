@@ -33,7 +33,9 @@ from ..ec.shard_bits import ShardBits
 from ..ec.volume import EcVolume, NeedleNotFound
 from ..events import emit as emit_event
 from ..fault import registry as _fault
-from ..stats.metrics import needle_repairs_total, observe_ec_stage
+from ..codecs import get_codec
+from ..stats.metrics import (ec_repair_read_bytes_total,
+                             needle_repairs_total, observe_ec_stage)
 from ..storage.scrub import ScrubDaemon
 from ..storage.store import Store
 from ..storage.vacuum import vacuum as vacuum_volume
@@ -61,7 +63,8 @@ class VolumeServer:
                  queue_depth: int | None = None,
                  shutdown_grace: float = 30.0,
                  disk_reserve_mb: float = 0.0,
-                 idle_timeout: float = 120.0):
+                 idle_timeout: float = 120.0,
+                 ec_codec: str = "rs"):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -101,6 +104,10 @@ class VolumeServer:
         self.shutdown_grace = shutdown_grace
         self.draining = False
         self._drain_lock = threading.Lock()
+        # -ec.codec: default erasure codec for /admin/ec/generate
+        # ("rs" wire-compatible default; "lrc" for 5-read repair).
+        # Validated now so a typo fails at startup, not mid-encode.
+        self.ec_codec = get_codec(ec_codec).name
         self.ec_volumes: dict[int, EcVolume] = {}
         self._ec_recv_lock = threading.Lock()
         self._ec_recv_vlocks: dict[int, threading.Lock] = {}
@@ -291,7 +298,7 @@ class VolumeServer:
                                      scrub_sweeps_total)
         for m in (scrub_checked_total, scrub_bytes_total,
                   scrub_corrupt_total, scrub_sweeps_total,
-                  needle_repairs_total):
+                  needle_repairs_total, ec_repair_read_bytes_total):
             reg.register_once(m)
 
     # -- heartbeats ---------------------------------------------------------
@@ -314,8 +321,12 @@ class VolumeServer:
             bits = ShardBits(0)
             for sid in ev.shards:
                 bits = bits.add_shard_id(sid)
+            # The codec id rides every heartbeat so the master (and
+            # through it the rebuild planner) knows each EC volume's
+            # shard scheme without touching a .vif.
             out.append({"id": vid, "collection": "",
-                        "shard_bits": int(bits)})
+                        "shard_bits": int(bits),
+                        "codec": ev.codec.name})
         return out
 
     def _send_heartbeat(self, full: bool = False,
@@ -831,43 +842,81 @@ class VolumeServer:
 
     def _reconstruct_shard_interval(self, ev: EcVolume, sid: int,
                                     off: int, size: int) -> bytes:
-        """One shard interval through the decode path: gather the SAME
-        byte range from >=10 sibling shards (local files first, then
-        remote holders) and solve wanted=[sid] on the device coder.
-        Fan the reads out in parallel — latency is the slowest single
-        fetch, not the sum of 13 round-trips (store_ec.go:322-376
-        launches one goroutine per shard;
-        recoverOneRemoteEcShardInterval).  Shared by the degraded read
-        ladder and the scrub's corrupt-block repair."""
+        """One shard interval through the decode path, codec-aware:
+        gather the SAME byte range from the codec's planned MINIMAL
+        survivor set — the local group for an in-group LRC loss (5
+        reads), the first data_shards survivors for RS — widening to
+        more siblings only when a planned read fails, and stopping the
+        widened fan-out as soon as the erasure pattern solves (the old
+        "any >=10" ladder, generalized to pick the cheapest survivor
+        set).  Reads fan out in parallel (store_ec.go:322-376 launches
+        one goroutine per shard); every gathered byte lands in
+        SeaweedFS_ec_repair_read_bytes_total{codec=}.  Shared by the
+        degraded read ladder and the scrub's corrupt-block repair."""
         locations = self._ec_shard_locations(ev.vid)
+        codec = ev.codec
         with trace_span("ec.reconstruct", vid=ev.vid, shard=sid,
-                        size=size) as rspan:
+                        size=size, codec=codec.name) as rspan:
             # Pool threads have no thread-local trace context — hand
             # them this span's context explicitly.
             tp = rspan.traceparent() or None
             pool = self._ec_pool()
             t_gather = time.perf_counter()
+            candidates = [s for s in range(codec.total_shards)
+                          if s != sid]
+            have: dict[int, bytes] = {}
+
+            def solvable() -> bool:
+                try:
+                    codec.decode_matrix(tuple(have), (sid,))
+                    return True
+                except ValueError:
+                    return False
+
+            try:
+                plan = codec.repair_plan(tuple(candidates), [sid])[0]
+            except ValueError:
+                raise rpc.RpcError(
+                    500, f"shard {sid} of ec volume {ev.vid} is "
+                         f"unrecoverable under codec {codec.name}"
+                ) from None
             futs = {
                 pool.submit(
                     self._fetch_shard_interval, ev, locations, other,
                     off, size, tp):
-                other
-                for other in range(TOTAL_SHARDS) if other != sid
+                other for other in plan.reads
             }
-            have: dict[int, bytes] = {}
             for f in concurrent.futures.as_completed(futs):
                 buf = f.result()
                 if buf is not None:
                     have[futs[f]] = buf
-                    if len(have) >= 10:
-                        break
-            for f in futs:
-                f.cancel()
+            plan_ok = len(have) == len(plan.reads)
+            if not plan_ok and not solvable():
+                # A planned read failed: widen to every remaining
+                # sibling, stopping as soon as the pattern solves.
+                futs = {
+                    pool.submit(
+                        self._fetch_shard_interval, ev, locations,
+                        other, off, size, tp):
+                    other for other in candidates
+                    if other not in plan.reads
+                }
+                for f in concurrent.futures.as_completed(futs):
+                    buf = f.result()
+                    if buf is not None:
+                        have[futs[f]] = buf
+                        if solvable():
+                            break
+                for f in futs:
+                    f.cancel()
             # Network fan-out cost, separate from the GF solve below.
+            gathered_bytes = sum(len(b) for b in have.values())
             observe_ec_stage("shard_gather",
                              time.perf_counter() - t_gather,
-                             sum(len(b) for b in have.values()))
-            if len(have) < 10:
+                             gathered_bytes)
+            ec_repair_read_bytes_total.inc(gathered_bytes,
+                                           codec=codec.name)
+            if not solvable():
                 # The location map let us down — drop it so the next
                 # read refreshes immediately instead of waiting out the
                 # TTL.
@@ -875,6 +924,12 @@ class VolumeServer:
                 raise rpc.RpcError(
                     500, f"cannot reconstruct shard {sid}: only "
                          f"{len(have)} shard intervals reachable")
+            if plan_ok and plan.local:
+                # Degraded read / repair served entirely from the
+                # shard's locality group — the LRC payoff.
+                emit_event("ec.repair.local", node=self.url(),
+                           vid=ev.vid, shard=sid, codec=codec.name,
+                           reads=len(have), bytes=gathered_bytes)
             import jax
             import numpy as np
             arrs = {k: np.frombuffer(v, dtype=np.uint8)
@@ -1490,10 +1545,28 @@ class VolumeServer:
                         return hit[:m.start()]
         return os.path.join(self.store.locations[0].directory, str(vid))
 
+    def _ec_total_shards(self, vid: int, base: str | None = None) -> int:
+        """Shard-file count of an EC volume, codec-derived (mounted
+        EcVolume first, then the on-disk .vif) — a mixed-codec cluster
+        must not assume RS(10,4)'s 14 everywhere."""
+        ev = self.ec_volumes.get(vid)
+        if ev is not None:
+            return ev.codec.total_shards
+        from ..ec.volume_info import ec_codec_name
+        try:
+            return get_codec(
+                ec_codec_name(base or self._volume_base(vid))).total_shards
+        except ValueError:
+            return TOTAL_SHARDS
+
     def _ec_generate(self, query: dict, body: bytes) -> dict:
-        """VolumeEcShardsGenerate: .dat -> 14 shards + .ecx (+.vif later)."""
+        """VolumeEcShardsGenerate: .dat -> shard files + .ecx + .vif.
+        The codec comes from the request ("codec": "lrc"), else the
+        server's -ec.codec default; it is persisted in the .vif so
+        every later mount/rebuild picks the matching matrices."""
         req = json.loads(body)
         vid = req["volume"]
+        codec = get_codec(req.get("codec") or self.ec_codec)
         v = self.store.find_volume(vid)
         if v is None:
             raise rpc.RpcError(404, f"volume {vid} not here")
@@ -1504,11 +1577,11 @@ class VolumeServer:
         base = v.file_name()
         dat_bytes = v.dat_size()
         emit_event("ec.encode.start", node=self.url(), vid=vid,
-                   dat_bytes=dat_bytes)
+                   dat_bytes=dat_bytes, codec=codec.name)
         t0 = time.perf_counter()
         try:
             write_sorted_file_from_idx(base)
-            write_ec_files(base)
+            write_ec_files(base, codec=codec.name)
         except Exception as e:
             emit_event("ec.encode.finish", node=self.url(),
                        severity="error", vid=vid,
@@ -1516,11 +1589,13 @@ class VolumeServer:
                        error=f"{type(e).__name__}: {e}")
             raise
         from ..ec.volume_info import save_volume_info
-        save_volume_info(base, v.version)
+        save_volume_info(base, v.version, codec=codec.name)
         emit_event("ec.encode.finish", node=self.url(), vid=vid,
                    seconds=round(time.perf_counter() - t0, 6),
-                   dat_bytes=dat_bytes, shards=TOTAL_SHARDS)
-        return {"shards": list(range(TOTAL_SHARDS))}
+                   dat_bytes=dat_bytes, shards=codec.total_shards,
+                   codec=codec.name)
+        return {"shards": list(range(codec.total_shards)),
+                "codec": codec.name}
 
     def _ec_mount(self, query: dict, body: bytes) -> dict:
         req = json.loads(body)
@@ -1584,7 +1659,7 @@ class VolumeServer:
         # restart re-registers a phantom zero-shard EC volume from the
         # stale .ecx (VolumeEcShardsDelete does the same cleanup).
         if not any(os.path.exists(base + to_ext(s))
-                   for s in range(TOTAL_SHARDS)):
+                   for s in range(self._ec_total_shards(vid, base))):
             ev = self.ec_volumes.pop(vid, None)
             if ev is not None:
                 ev.close()
@@ -1662,9 +1737,9 @@ class VolumeServer:
         shard is servable once mounted."""
         vid = int(query["volume"])
         sid = int(query["shard"])
-        if not 0 <= sid < TOTAL_SHARDS:
-            raise rpc.RpcError(400, f"bad shard id {sid}")
         base = self._volume_base(vid)
+        if not 0 <= sid < self._ec_total_shards(vid, base):
+            raise rpc.RpcError(400, f"bad shard id {sid}")
         os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
         # Temp names must not collide with _volume_base's discovery
         # globs (`<vid>.ec*`) or concurrent receives would mis-derive

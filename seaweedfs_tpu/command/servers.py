@@ -196,7 +196,11 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         queue_depth=flags.get_int("max.queue", 0) or None,
         shutdown_grace=flags.get_float("shutdown.grace", 30.0),
         disk_reserve_mb=flags.get_float("disk.reserve", 0.0),
-        idle_timeout=flags.get_float("idle.timeout", 120.0))
+        idle_timeout=flags.get_float("idle.timeout", 120.0),
+        # -ec.codec: default erasure codec for /admin/ec/generate —
+        # "rs" (reference-compatible RS(10,4)) or "lrc" (LRC(10,2,2),
+        # 5-read single-shard repair).
+        ec_codec=flags.get("ec.codec", "rs"))
     vs.start()
     glog.infof("volume server serving at %s (dirs %s)",
                vs.server.url(), dirs)
@@ -313,7 +317,8 @@ def run_server(flags: Flags, args: list[str]) -> int:
                       shutdown_grace=flags.get_float("shutdown.grace",
                                                      30.0),
                       disk_reserve_mb=flags.get_float("disk.reserve",
-                                                      0.0))
+                                                      0.0),
+                      ec_codec=flags.get("ec.codec", "rs"))
     vs.start()
     servers.append(vs)
     glog.infof("master at %s, volume at %s", m.server.url(),
@@ -367,7 +372,7 @@ register(Command("volume",
                  "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
                  " [-fsync] [-scrub.mbps=32] [-scrub.interval=3600]"
                  " [-max.concurrent=0] [-disk.reserve=0(MB)]"
-                 " [-shutdown.grace=30]",
+                 " [-shutdown.grace=30] [-ec.codec=rs|lrc]",
                  "start a volume server", run_volume))
 register(Command("filer", "filer -port=8888 -master=host:9333",
                  "start a filer server", run_filer))

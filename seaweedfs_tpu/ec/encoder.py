@@ -20,11 +20,13 @@ import os
 
 import numpy as np
 
-from . import (DATA_SHARDS, LARGE_BLOCK_SIZE, PARITY_SHARDS,
-               SMALL_BLOCK_SIZE, TOTAL_SHARDS, to_ext)
+from . import DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, to_ext
 from .integrity import BlockCrcAccumulator, ShardChecksums, ecc_lock
+from .volume_info import ec_codec_name, update_volume_info
+from ..codecs import get_codec
 from ..fault import registry as _fault
 from ..ops.erasure import ErasureCoder, new_coder
+from ..stats.metrics import ec_repair_read_bytes_total
 from ..storage.needle_map import MemDb
 
 # Per-shard contiguous bytes handed to one coder call. Must divide
@@ -61,19 +63,31 @@ def _shard_write(f, sid: int, buf: bytes, accs) -> None:
 def write_ec_files(base_file_name: str, coder: ErasureCoder | None = None,
                    large_block_size: int = LARGE_BLOCK_SIZE,
                    small_block_size: int = SMALL_BLOCK_SIZE,
-                   chunk_size: int = DEFAULT_CHUNK) -> None:
-    """Generate .ec00-.ec13 from the .dat (WriteEcFiles), plus the
+                   chunk_size: int = DEFAULT_CHUNK,
+                   codec=None) -> None:
+    """Generate the shard files from the .dat (WriteEcFiles), plus the
     `.ecc` per-block checksum sidecar the background scrub verifies
-    shards against (ec/integrity.py)."""
-    coder = coder or new_coder(DATA_SHARDS, PARITY_SHARDS)
-    if coder.data_shards != DATA_SHARDS or \
-            coder.parity_shards != PARITY_SHARDS:
-        raise ValueError("coder scheme must be RS(10,4) for weed-compatible "
-                         "shard files")
+    shards against (ec/integrity.py).  `codec` selects the erasure
+    codec ("rs" default, "lrc", ...); shard-file count, parity rows
+    and the recorded `.vif` codec id all derive from it."""
+    if coder is None:
+        coder = new_coder(codec=codec)
+    cd = getattr(coder, "codec", None) or get_codec("rs")
+    if codec is not None and get_codec(codec).name != cd.name:
+        raise ValueError(
+            f"coder carries codec {cd.name!r} but {get_codec(codec).name!r} "
+            "was requested")
+    if cd.data_shards != DATA_SHARDS:
+        # The shard-file block layout (locate.py) row-stripes over
+        # exactly DATA_SHARDS columns; codecs may vary parity shape
+        # freely but not the data stripe width.
+        raise ValueError(
+            f"codec {cd.name!r}: data shards must be {DATA_SHARDS} for "
+            "the weed shard layout")
     dat_size = os.path.getsize(base_file_name + ".dat")
     outputs = [open(base_file_name + to_ext(i), "wb")
-               for i in range(TOTAL_SHARDS)]
-    accs = [BlockCrcAccumulator() for _ in range(TOTAL_SHARDS)]
+               for i in range(cd.total_shards)]
+    accs = [BlockCrcAccumulator() for _ in range(cd.total_shards)]
     try:
         with open(base_file_name + ".dat", "rb") as dat:
             _encode_dat_file(dat, dat_size, coder, outputs,
@@ -82,6 +96,10 @@ def write_ec_files(base_file_name: str, coder: ErasureCoder | None = None,
     finally:
         for f in outputs:
             f.close()
+    # The codec id travels in the .vif like the needle version: any
+    # server that later mounts these shards must pick the matching
+    # decode matrices.
+    update_volume_info(base_file_name, codec=cd.name)
     with ecc_lock(base_file_name):
         ecc = ShardChecksums(base_file_name)
         for sid, acc in enumerate(accs):
@@ -207,10 +225,13 @@ def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
     t.start()
     inflight: "collections.deque" = collections.deque()
 
+    data_shards = coder.data_shards
+    parity_shards = coder.parity_shards
+
     def flush_one() -> None:
         parity = np.asarray(inflight.popleft())
-        for p in range(PARITY_SHARDS):
-            _shard_write(outputs[DATA_SHARDS + p], DATA_SHARDS + p,
+        for p in range(parity_shards):
+            _shard_write(outputs[data_shards + p], data_shards + p,
                          parity[p].tobytes(), accs)
 
     try:
@@ -222,7 +243,7 @@ def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
             # the kernel runs while we write the data shards and read
             # the next chunk.
             inflight.append(coder.encode(data))
-            for i in range(DATA_SHARDS):
+            for i in range(data_shards):
                 _shard_write(outputs[i], i, data[i].tobytes(), accs)
             if len(inflight) >= depth:
                 flush_one()
@@ -245,13 +266,18 @@ def rebuild_ec_files(base_file_name: str,
                      chunk_size: int = DEFAULT_CHUNK) -> list[int]:
     """Recreate missing .ec?? files from survivors (RebuildEcFiles).
 
-    Returns the list of generated shard ids.  Layout-agnostic: operates on
-    flat shard-file columns.
+    Returns the list of generated shard ids.  Layout-agnostic: operates
+    on flat shard-file columns.  Codec-aware: the codec comes from the
+    `.vif` sidecar, the shard count from the codec, and only the
+    codec's planned minimal read set is read from disk — an LRC
+    in-group rebuild reads 5 shard files, not every survivor.
     """
-    coder = coder or new_coder(DATA_SHARDS, PARITY_SHARDS)
+    if coder is None:
+        coder = new_coder(codec=ec_codec_name(base_file_name))
+    cd = getattr(coder, "codec", None) or get_codec("rs")
     present: dict[int, str] = {}
     missing: list[int] = []
-    for sid in range(TOTAL_SHARDS):
+    for sid in range(cd.total_shards):
         path = base_file_name + to_ext(sid)
         if os.path.exists(path):
             present[sid] = path
@@ -259,16 +285,20 @@ def rebuild_ec_files(base_file_name: str,
             missing.append(sid)
     if not missing:
         return []
-    if len(present) < coder.data_shards:
+    try:
+        plan = cd.repair_plan(tuple(present), missing)
+    except ValueError as e:
         raise ValueError(
-            f"too few shards to rebuild: {len(present)} < {coder.data_shards}")
+            f"too few shards to rebuild: {len(present)} survive "
+            f"({cd.name}): {e}") from None
+    needed = sorted({sid for p in plan for sid in p.reads})
 
     shard_size = os.path.getsize(next(iter(present.values())))
     for sid, path in present.items():
         if os.path.getsize(path) != shard_size:
             raise ValueError(f"ec shard size mismatch on {path}")
 
-    ins = {sid: open(path, "rb") for sid, path in present.items()}
+    ins = {sid: open(present[sid], "rb") for sid in needed}
     outs = {sid: open(base_file_name + to_ext(sid), "wb") for sid in missing}
     accs = {sid: BlockCrcAccumulator() for sid in missing}
     try:
@@ -280,6 +310,8 @@ def rebuild_ec_files(base_file_name: str,
                 if len(buf) != take:
                     raise ValueError(f"short read on shard {sid}")
                 have[sid] = np.frombuffer(buf, dtype=np.uint8)
+            ec_repair_read_bytes_total.inc(take * len(have),
+                                           codec=cd.name)
             rec = coder.reconstruct(have, wanted=missing)
             for sid in missing:
                 _shard_write(outs[sid], sid,

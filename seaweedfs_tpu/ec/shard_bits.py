@@ -18,8 +18,11 @@ class ShardBits(int):
     def has_shard_id(self, sid: int) -> bool:
         return bool(self & (1 << sid))
 
-    def shard_ids(self) -> list[int]:
-        return [sid for sid in range(TOTAL_SHARDS) if self.has_shard_id(sid)]
+    def shard_ids(self, total_shards: int = TOTAL_SHARDS) -> list[int]:
+        """Held shard ids; `total_shards` bounds the scan for codecs
+        whose shard count differs from RS(10,4)'s 14."""
+        return [sid for sid in range(max(total_shards, self.bit_length()))
+                if self.has_shard_id(sid)]
 
     def shard_id_count(self) -> int:
         return bin(self).count("1")

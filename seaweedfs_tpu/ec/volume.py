@@ -18,12 +18,14 @@ import threading
 
 import numpy as np
 
-from . import (DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
-               TOTAL_SHARDS, to_ext)
+from . import DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, to_ext
+from ..codecs import get_codec
 from ..core import types as t
 from ..core.needle import Needle, get_actual_size
 from ..ops.erasure import ErasureCoder, new_coder
+from ..stats.metrics import ec_repair_read_bytes_total
 from .locate import Interval, locate_data
+from .volume_info import ec_codec_name
 
 
 class NeedleNotFound(Exception):
@@ -55,13 +57,21 @@ class EcVolume:
                  coder: ErasureCoder | None = None,
                  version: int | None = None,
                  large_block_size: int = LARGE_BLOCK_SIZE,
-                 small_block_size: int = SMALL_BLOCK_SIZE):
+                 small_block_size: int = SMALL_BLOCK_SIZE,
+                 codec=None):
         self.base_file_name = base_file_name
         self.vid = vid
         self.large_block_size = large_block_size
         self.small_block_size = small_block_size
-        self.coder = coder or new_coder(DATA_SHARDS,
-                                        TOTAL_SHARDS - DATA_SHARDS)
+        # The codec rides the .vif sidecar (like the needle version):
+        # an explicit coder wins, then an explicit codec name, then
+        # whatever the shards were generated with.
+        if coder is not None:
+            self.coder = coder
+            self.codec = getattr(coder, "codec", None) or get_codec("rs")
+        else:
+            self.codec = get_codec(codec or ec_codec_name(base_file_name))
+            self.coder = new_coder(codec=self.codec)
         self.shards: dict[int, EcVolumeShard] = {}
         self._ecx = open(base_file_name + ".ecx", "r+b")
         self.ecx_size = os.path.getsize(base_file_name + ".ecx")
@@ -100,7 +110,7 @@ class EcVolume:
 
     def load_local_shards(self) -> list[int]:
         found = []
-        for sid in range(TOTAL_SHARDS):
+        for sid in range(self.codec.total_shards):
             if sid in self.shards:
                 continue
             if os.path.exists(self.base_file_name + to_ext(sid)):
@@ -162,25 +172,36 @@ class EcVolume:
 
     def _reconstruct_interval(self, missing_sid: int, offset: int,
                               size: int) -> bytes:
-        """Degraded read: rebuild one shard interval from >=10 survivors.
+        """Degraded read: rebuild one shard interval from survivors.
 
         Reference: store_ec.go:322 recoverOneRemoteEcShardInterval — there
         the survivors are fetched over gRPC; locally we use whatever shard
-        files exist.  The GF solve itself is one coder.reconstruct call.
+        files exist.  The read set follows the codec's repair plan —
+        local group first (5 reads for LRC), global fallback — and a
+        shard that comes up short is excluded and the plan re-solved,
+        so one truncated file degrades the read cost, never the read.
         """
-        have: dict[int, np.ndarray] = {}
-        for sid, shard in self.shards.items():
-            if sid == missing_sid:
-                continue
-            buf = shard.read_at(offset, size)
-            if len(buf) == size:
+        excluded: set[int] = set()
+        while True:
+            usable = tuple(s for s in self.shards
+                           if s != missing_sid and s not in excluded)
+            try:
+                plan = self.codec.repair_plan(usable, [missing_sid])
+            except ValueError:
+                raise ShardsUnavailable(
+                    f"cannot reconstruct shard {missing_sid}: only "
+                    f"{len(usable)} survivors") from None
+            have: dict[int, np.ndarray] = {}
+            for sid in plan[0].reads:
+                buf = self.shards[sid].read_at(offset, size)
+                if len(buf) != size:
+                    excluded.add(sid)
+                    break
                 have[sid] = np.frombuffer(buf, dtype=np.uint8)
-            if len(have) >= self.coder.data_shards:
+            if len(have) == len(plan[0].reads):
                 break
-        if len(have) < self.coder.data_shards:
-            raise ShardsUnavailable(
-                f"cannot reconstruct shard {missing_sid}: only "
-                f"{len(have)} survivors")
+        ec_repair_read_bytes_total.inc(size * len(have),
+                                       codec=self.codec.name)
         rec = self.coder.reconstruct(have, wanted=[missing_sid])
         return np.asarray(rec[missing_sid]).tobytes()
 

@@ -59,12 +59,14 @@ TYPES: dict[str, str] = {
     "heartbeat.recovered": "a data node (re)registered with the master",
     "leader.elect": "a raft node won an election",
     "leader.stepdown": "a raft leader was deposed",
-    "ec.encode.start": "EC encode began (volume -> 14 shards)",
+    "ec.encode.start": "EC encode began (volume -> codec shard files)",
     "ec.encode.finish": "EC encode finished, with per-stage "
                         "byte/second attrs",
     "ec.rebuild.start": "EC rebuild of missing shards began",
     "ec.rebuild.finish": "EC rebuild finished, with per-stage "
                          "byte/second attrs",
+    "ec.repair.local": "a shard repaired/reconstructed entirely from "
+                       "its locality group (LRC 5-read path)",
     "breaker.open": "a per-host circuit breaker opened",
     "breaker.half_open": "an open breaker let a probe request through",
     "breaker.close": "a breaker closed after a successful probe",

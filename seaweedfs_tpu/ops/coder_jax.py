@@ -23,8 +23,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import rs_bitmatrix
-
 
 def plane_major(bmat: np.ndarray, rows: int, cols: int) -> np.ndarray:
     """Permute an interleaved (8r x 8k) bit matrix into plane-major order.
@@ -65,15 +63,18 @@ class JaxCoder:
     """Drop-in analog of NumpyCoder running under jit (XLA path)."""
 
     def __init__(self, data_shards: int = 10, parity_shards: int = 4,
-                 matrix_kind: str = "vandermonde"):
-        self.data_shards = data_shards
-        self.parity_shards = parity_shards
-        self.total_shards = data_shards + parity_shards
-        self.matrix_kind = matrix_kind
-        pb = rs_bitmatrix.parity_bitmatrix(
-            data_shards, self.total_shards, matrix_kind)
+                 matrix_kind: str = "vandermonde", codec=None):
+        from ..codecs import get_codec, rs_codec
+        self.codec = rs_codec(data_shards, parity_shards, matrix_kind) \
+            if codec is None else get_codec(codec)
+        self.data_shards = self.codec.data_shards
+        self.parity_shards = self.codec.parity_shards
+        self.total_shards = self.codec.total_shards
+        self.matrix_kind = self.codec.matrix_kind
+        pb = self.codec.parity_bitmatrix()
         self._parity_pm = jnp.asarray(
-            plane_major(pb, parity_shards, data_shards), jnp.bfloat16)
+            plane_major(pb, self.parity_shards, self.data_shards),
+            jnp.bfloat16)
 
     # -- primitives --------------------------------------------------------
 
@@ -92,10 +93,8 @@ class JaxCoder:
     @functools.lru_cache(maxsize=256)
     def _decode_mat_pm(self, present: tuple[int, ...],
                        wanted: tuple[int, ...]) -> tuple[jax.Array, tuple[int, ...]]:
-        bmat, used = rs_bitmatrix.decode_bitmatrix(
-            self.data_shards, self.total_shards, present, wanted,
-            self.matrix_kind)
-        pm = plane_major(np.asarray(bmat), len(wanted), self.data_shards)
+        bmat, used = self.codec.decode_bitmatrix(present, wanted)
+        pm = plane_major(np.asarray(bmat), len(wanted), len(used))
         return jnp.asarray(pm, jnp.bfloat16), used
 
     def reconstruct(self, shards: dict[int, jax.Array],

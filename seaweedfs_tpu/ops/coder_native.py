@@ -12,24 +12,25 @@ import ctypes
 
 import numpy as np
 
-from . import gf256
 from ..utils import native as native_mod
 
 
 class NativeCoder:
     def __init__(self, data_shards: int = 10, parity_shards: int = 4,
-                 matrix_kind: str = "vandermonde"):
+                 matrix_kind: str = "vandermonde", codec=None):
+        from ..codecs import get_codec, rs_codec
         lib = native_mod.load()
         if lib is None:
             raise RuntimeError(
                 "native library not built — run `make -C native`")
         self._mix = native_mod.gf_encode_fn(lib)
-        self.data_shards = data_shards
-        self.parity_shards = parity_shards
-        self.total_shards = data_shards + parity_shards
-        self.matrix_kind = matrix_kind
-        self.parity_mat = gf256.parity_matrix(
-            data_shards, self.total_shards, matrix_kind)
+        self.codec = rs_codec(data_shards, parity_shards, matrix_kind) \
+            if codec is None else get_codec(codec)
+        self.data_shards = self.codec.data_shards
+        self.parity_shards = self.codec.parity_shards
+        self.total_shards = self.codec.total_shards
+        self.matrix_kind = self.codec.matrix_kind
+        self.parity_mat = self.codec.parity_matrix()
 
     def _apply(self, mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
         rows, cols = mat.shape
@@ -67,9 +68,7 @@ class NativeCoder:
                 f"shard ids {bad} out of range [0, {self.total_shards})")
         if not wanted:
             return {}
-        mat, used = gf256.decode_matrix(
-            self.data_shards, self.total_shards, present, wanted=wanted,
-            kind=self.matrix_kind)
+        mat, used = self.codec.decode_matrix(tuple(present), tuple(wanted))
         stacked = np.stack([np.asarray(shards[s], np.uint8) for s in used])
         rec = self._apply(mat, stacked)
         return {w: rec[i] for i, w in enumerate(wanted)}

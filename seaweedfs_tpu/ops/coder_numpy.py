@@ -15,16 +15,19 @@ from . import gf256
 
 
 class NumpyCoder:
-    """Systematic RS(data_shards, parity_shards) over GF(2^8)."""
+    """Systematic erasure coder over GF(2^8) for any registered codec
+    (default: RS(data_shards, parity_shards))."""
 
     def __init__(self, data_shards: int = 10, parity_shards: int = 4,
-                 matrix_kind: str = "vandermonde"):
-        self.data_shards = data_shards
-        self.parity_shards = parity_shards
-        self.total_shards = data_shards + parity_shards
-        self.matrix_kind = matrix_kind
-        self.parity_mat = gf256.parity_matrix(
-            data_shards, self.total_shards, matrix_kind)
+                 matrix_kind: str = "vandermonde", codec=None):
+        from ..codecs import get_codec, rs_codec
+        self.codec = rs_codec(data_shards, parity_shards, matrix_kind) \
+            if codec is None else get_codec(codec)
+        self.data_shards = self.codec.data_shards
+        self.parity_shards = self.codec.parity_shards
+        self.total_shards = self.codec.total_shards
+        self.matrix_kind = self.codec.matrix_kind
+        self.parity_mat = self.codec.parity_matrix()
 
     # -- core GF matmul on byte planes ------------------------------------
 
@@ -83,6 +86,15 @@ class NumpyCoder:
                 f"shard ids {bad} out of range [0, {self.total_shards})")
         if not wanted:
             return {}
+        if not self.codec.is_rs:
+            # Generic codecs (LRC): one minimal-read GF solve covers
+            # any mix of data/local-parity/global-parity shards.
+            mat, used = self.codec.decode_matrix(
+                tuple(present), tuple(wanted))
+            stacked = np.stack([np.asarray(shards[s], np.uint8)
+                                for s in used])
+            rec = self._apply(mat, stacked)
+            return {w: rec[i] for i, w in enumerate(wanted)}
         missing_parity = [w for w in wanted if w >= self.data_shards]
         # One decode solve covers wanted data shards plus any data shards
         # needed to re-encode wanted parity.

@@ -126,26 +126,28 @@ class PallasCoder:
     def __init__(self, data_shards: int = 10, parity_shards: int = 4,
                  matrix_kind: str = "vandermonde",
                  interpret: bool | None = None,
-                 block_n: int | None = None, mm: str | None = None):
+                 block_n: int | None = None, mm: str | None = None,
+                 codec=None):
         import os
 
-        from . import rs_bitmatrix
+        from ..codecs import get_codec, rs_codec
         from .coder_jax import plane_major
 
         self.block_n = block_n or int(
             os.environ.get("SEAWEEDFS_TPU_BLOCK_N", BLOCK_N))
         self.mm = mm or os.environ.get("SEAWEEDFS_TPU_MM", "bf16")
-        self.data_shards = data_shards
-        self.parity_shards = parity_shards
-        self.total_shards = data_shards + parity_shards
-        self.matrix_kind = matrix_kind
+        self.codec = rs_codec(data_shards, parity_shards, matrix_kind) \
+            if codec is None else get_codec(codec)
+        self.data_shards = self.codec.data_shards
+        self.parity_shards = self.codec.parity_shards
+        self.total_shards = self.codec.total_shards
+        self.matrix_kind = self.codec.matrix_kind
         self.interpret = (not _on_tpu()) if interpret is None else interpret
         self._plane_major = plane_major
-        self._rs_bitmatrix = rs_bitmatrix
-        pb = rs_bitmatrix.parity_bitmatrix(
-            data_shards, self.total_shards, matrix_kind)
+        pb = self.codec.parity_bitmatrix()
         self._parity_pm = jnp.asarray(
-            plane_major(pb, parity_shards, data_shards), jnp.bfloat16)
+            plane_major(pb, self.parity_shards, self.data_shards),
+            jnp.bfloat16)
 
     def _apply(self, mat_pm: jax.Array, shards: jax.Array,
                out_rows: int) -> jax.Array:
@@ -153,8 +155,10 @@ class PallasCoder:
         padded = pad_to_block(n, self.block_n)
         if padded != n:
             shards = jnp.pad(shards, ((0, 0), (0, padded - n)))
+        # in_rows follows the stacked survivors, not the scheme: a
+        # minimal-read LRC decode feeds 5 rows, not data_shards.
         out = apply_bitmatrix_pallas(mat_pm, shards, out_rows,
-                                     self.data_shards,
+                                     int(shards.shape[0]),
                                      interpret=self.interpret,
                                      block_n=self.block_n, mm=self.mm)
         return out[:, :n]
@@ -179,10 +183,8 @@ class PallasCoder:
 
     @functools.lru_cache(maxsize=256)
     def _decode_mat_pm(self, present: tuple[int, ...], wanted: tuple[int, ...]):
-        bmat, used = self._rs_bitmatrix.decode_bitmatrix(
-            self.data_shards, self.total_shards, present, wanted,
-            self.matrix_kind)
-        pm = self._plane_major(np.asarray(bmat), len(wanted), self.data_shards)
+        bmat, used = self.codec.decode_bitmatrix(present, wanted)
+        pm = self._plane_major(np.asarray(bmat), len(wanted), len(used))
         return jnp.asarray(pm, jnp.bfloat16), used
 
     def reconstruct(self, shards: dict[int, jax.Array],

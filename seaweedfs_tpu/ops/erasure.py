@@ -64,18 +64,22 @@ def default_backend() -> str:
 
 def new_coder(data_shards: int = 10, parity_shards: int = 4,
               matrix_kind: str = "vandermonde",
-              backend: str | None = None) -> ErasureCoder:
+              backend: str | None = None, codec=None) -> ErasureCoder:
+    """Build a coder.  `codec` (a registered codec name or Codec
+    object, e.g. "lrc") overrides the RS shard-count arguments — the
+    codec IS the scheme; the backend is just where the matmul runs."""
     backend = backend or default_backend()
     if backend == "numpy":
         from .coder_numpy import NumpyCoder
-        return NumpyCoder(data_shards, parity_shards, matrix_kind)
+        return NumpyCoder(data_shards, parity_shards, matrix_kind, codec)
     if backend == "native":
         from .coder_native import NativeCoder
-        return NativeCoder(data_shards, parity_shards, matrix_kind)
+        return NativeCoder(data_shards, parity_shards, matrix_kind, codec)
     if backend == "jax":
         from .coder_jax import JaxCoder
-        return JaxCoder(data_shards, parity_shards, matrix_kind)
+        return JaxCoder(data_shards, parity_shards, matrix_kind, codec)
     if backend == "pallas":
         from .coder_pallas import PallasCoder
-        return PallasCoder(data_shards, parity_shards, matrix_kind)
+        return PallasCoder(data_shards, parity_shards, matrix_kind,
+                           codec=codec)
     raise ValueError(f"unknown erasure backend {backend!r}")

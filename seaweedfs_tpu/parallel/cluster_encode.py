@@ -33,10 +33,11 @@ from ..events import emit as emit_event
 from ..fault import registry as _fault
 from ..stats.metrics import observe_batch_stage, stage_attrs
 from ..trace import root_span
-from ..ec import (DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
-                  TOTAL_SHARDS, to_ext)
+from ..codecs import get_codec
+from ..ec import DATA_SHARDS, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, to_ext
 from ..ec.encoder import (DEFAULT_CHUNK, _chunk_reader,
                           write_sorted_file_from_idx)
+from ..ec.volume_info import update_volume_info
 from .cluster_rebuild import _pad_to, make_mesh
 from .sharded_codec import batched_encode
 
@@ -48,13 +49,16 @@ _COL_ALIGN = 2048
 
 def batch_encode(env, vids, mesh=None, max_batch_bytes=1 << 28,
                  workers: int = 8, chunk_size: int = DEFAULT_CHUNK,
-                 progress=None) -> list[str]:
+                 progress=None, codec=None) -> list[str]:
     """EC-encode `vids` across the cluster in mesh-batched steps.
-    Returns one human-readable line per volume.
+    Returns one human-readable line per volume.  `codec` selects the
+    erasure codec ("rs" default / "lrc"): the generator matrix, shard
+    count, and the .vif codec id pushed to every holder derive from it.
 
     env: duck-typed cluster view (shell CommandEnv): volume_locations,
     data_nodes, vs_call.
     """
+    codec = get_codec(codec)
     if mesh is None:
         mesh = make_mesh()
     targets: list[tuple[int, list[str]]] = []
@@ -81,7 +85,7 @@ def batch_encode(env, vids, mesh=None, max_batch_bytes=1 << 28,
                 total += _dat_size(env, *targets[i])
                 i += 1
             messages += _encode_batch_group(env, mesh, pool, batch,
-                                            chunk_size, progress)
+                                            chunk_size, progress, codec)
     finally:
         pool.shutdown(wait=False)
     return messages
@@ -119,33 +123,37 @@ def _fetch_volume(tmpdir: str, vid: int, locs: list[str]) -> str:
 
 
 def _encode_batch_group(env, mesh, pool, batch, chunk_size,
-                        progress) -> list[str]:
+                        progress, codec) -> list[str]:
     """Fetch, mesh-encode, scatter one sub-batch of volumes — journaled
     as ec.encode.start/finish with per-stage byte/second attrs, under a
     root span so the timeline row links to a /debug/traces trace."""
     vids = [vid for vid, _locs in batch]
-    with root_span("ec.batch_encode", "ec", volumes=len(vids)):
-        emit_event("ec.encode.start", volumes=vids, batch=True)
+    with root_span("ec.batch_encode", "ec", volumes=len(vids),
+                   codec=codec.name):
+        emit_event("ec.encode.start", volumes=vids, batch=True,
+                   codec=codec.name)
         t0 = time.perf_counter()
         stages: dict[str, list[float]] = {}  # stage -> [seconds, bytes]
         try:
             out = _encode_batch_group_inner(env, mesh, pool, batch,
-                                            chunk_size, progress, stages)
+                                            chunk_size, progress,
+                                            stages, codec)
         except Exception as e:
             emit_event("ec.encode.finish", severity="error",
-                       volumes=vids, batch=True,
+                       volumes=vids, batch=True, codec=codec.name,
                        seconds=round(time.perf_counter() - t0, 6),
                        error=f"{type(e).__name__}: {e}",
                        **stage_attrs(stages))
             raise
         emit_event("ec.encode.finish", volumes=vids, batch=True,
+                   codec=codec.name,
                    seconds=round(time.perf_counter() - t0, 6),
                    **stage_attrs(stages))
         return out
 
 
 def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
-                              progress, stages) -> list[str]:
+                              progress, stages, codec) -> list[str]:
     """Fetch, mesh-encode, scatter one sub-batch of volumes."""
     from ..shell.command_ec import balanced_distribution, collect_ec_nodes
     vol_axis = mesh.shape["vol"]
@@ -168,9 +176,10 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
         # 2. Mesh-encode: lockstep stripe chunks across volumes.  Each
         # volume's chunk sequence is the exact local-encoder chunking
         # (byte-identical shards); chunks are stacked on "vol" and
-        # column-padded with zeros (RS parity is columnwise, so padded
-        # columns are discarded zeros, never corruption).
-        writers = [_ShardWriter(b) for b in bases]
+        # column-padded with zeros (parity is columnwise for every
+        # codec, so padded columns are discarded zeros, never
+        # corruption).
+        writers = [_ShardWriter(b, codec.total_shards) for b in bases]
         dats = [open(b + ".dat", "rb") for b in bases]
         try:
             iters = [
@@ -201,7 +210,8 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
                 # this is execution-fenced device+staging time for the
                 # batched GF(2) matmul.
                 t_dev = time.perf_counter()
-                parity = np.asarray(batched_encode(stacked, mesh))
+                parity = np.asarray(batched_encode(stacked, mesh,
+                                                   codec=codec))
                 observe_batch_stage(stages, "batch_encode_device",
                                time.perf_counter() - t_dev,
                                stacked.nbytes)
@@ -212,14 +222,21 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
             for d in dats:
                 d.close()
 
-        # 3. .ecx from the fetched .idx (WriteSortedFileFromIdx).
+        # 3. .ecx from the fetched .idx (WriteSortedFileFromIdx), and
+        # a .vif carrying the needle version + codec id — every shard
+        # holder must know which generator matrix made its shards.
         for base in bases:
             write_sorted_file_from_idx(base)
+            with open(base + ".dat", "rb") as f:
+                version = f.read(1)[0]
+            update_volume_info(base, version=version, codec=codec.name)
 
-        # 4. Scatter: balanced placement, push shards + .ecx, mount,
-        # then delete the original replicas (command_ec_encode.go flow).
+        # 4. Scatter: balanced placement, push shards + .ecx/.vif,
+        # mount, then delete the original replicas
+        # (command_ec_encode.go flow).
         for (vid, locs), base in zip(batch, bases):
-            plan = balanced_distribution(collect_ec_nodes(env))
+            plan = balanced_distribution(collect_ec_nodes(env),
+                                         n_shards=codec.total_shards)
             futs = []
             t_scatter = time.perf_counter()
             scattered = 0
@@ -236,9 +253,14 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
                            time.perf_counter() - t_scatter, scattered)
             with open(base + ".ecx", "rb") as f:
                 ecx = f.read()
+            with open(base + ".vif", "rb") as f:
+                vif = f.read()
             for url in plan:
                 rpc.call(f"http://{url}/admin/ec/receive_file?"
                          f"volume={vid}&ext=.ecx", "POST", ecx, 600.0,
+                         headers=rpc.PRIORITY_LOW)
+                rpc.call(f"http://{url}/admin/ec/receive_file?"
+                         f"volume={vid}&ext=.vif", "POST", vif, 600.0,
                          headers=rpc.PRIORITY_LOW)
                 env.vs_call(url, "/admin/ec/mount", {"volume": vid})
             for url in locs:
@@ -264,12 +286,13 @@ def _scatter_shard(url: str, vid: int, sid: int,
 
 
 class _ShardWriter:
-    """Appends stripe chunks to the 14 local shard files of one volume
-    in arrival order — the same order `write_ec_files` writes them."""
+    """Appends stripe chunks to the codec's local shard files of one
+    volume in arrival order — the same order `write_ec_files` writes
+    them."""
 
-    def __init__(self, base: str):
+    def __init__(self, base: str, total_shards: int):
         self.files = [open(base + to_ext(i), "wb")
-                      for i in range(TOTAL_SHARDS)]
+                      for i in range(total_shards)]
 
     def write(self, data: np.ndarray, parity: np.ndarray) -> None:
         for i in range(DATA_SHARDS):

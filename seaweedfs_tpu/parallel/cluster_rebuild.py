@@ -26,11 +26,12 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..cluster import rpc
-from ..ec import DATA_SHARDS, TOTAL_SHARDS
+from ..codecs import Codec, get_codec
 from ..ec.shard_bits import ShardBits
 from ..events import emit as emit_event
 from ..fault import registry as _fault
-from ..stats.metrics import observe_batch_stage, stage_attrs
+from ..stats.metrics import (ec_repair_read_bytes_total,
+                             observe_batch_stage, stage_attrs)
 from ..trace import root_span
 from ..utils import env_float as _env_float
 from .sharded_codec import batched_reconstruct
@@ -66,34 +67,93 @@ def make_mesh(devices=None):
 
 @dataclass
 class RebuildPlan:
-    """Volumes grouped by survivor signature: every volume in a group
-    lost the same shards, so one decode matrix (and one compiled step)
-    covers the whole group."""
+    """Volumes grouped by (codec, survivor signature): every volume in
+    a group shares a codec and lost the same shards, so one decode
+    matrix (and one compiled step) covers the whole group."""
 
-    groups: dict[tuple[tuple[int, ...], tuple[int, ...]],
+    groups: dict[tuple[str, tuple[int, ...], tuple[int, ...]],
                  list[tuple[int, dict[int, list[str]]]]] = \
         field(default_factory=dict)
     skipped: list[tuple[int, str]] = field(default_factory=list)
 
 
 def plan_rebuilds(env, vids=None) -> RebuildPlan:
-    """Group rebuildable EC volumes by (present, missing) signature."""
-    if vids is None:
-        vids = sorted({e["id"] for n in env.data_nodes()
-                       for e in n["ec_shards"]})
+    """Group rebuildable EC volumes by (codec, present, missing).
+    Shard counts and decodability derive from each volume's codec —
+    a mixed-codec cluster must never plan an LRC volume with RS
+    literals (or vice versa).  Codec ids come from the /vol/list
+    payload already in hand (heartbeats put "codec" on every ec_shards
+    entry), with env.ec_codec(vid) as the per-volume fallback; a
+    volume whose codec cannot be DETERMINED is skipped, never guessed
+    — decoding LRC shards with RS matrices would scatter silently
+    corrupt bytes cluster-wide."""
     plan = RebuildPlan()
+    codecs: dict[int, str] = {}
+    try:
+        nodes = env.data_nodes()
+    except Exception:  # noqa: BLE001 — fall back to per-vid lookups
+        nodes = []
+    for n in nodes:
+        for e in n.get("ec_shards", []):
+            if e.get("codec"):
+                codecs[e["id"]] = e["codec"]
+    if vids is None:
+        vids = sorted({e["id"] for n in nodes for e in n["ec_shards"]})
     for vid in vids:
+        name = codecs.get(vid)
+        if name is None:
+            getter = getattr(env, "ec_codec", None)
+            if getter is None:  # duck-typed env predating codecs: rs
+                name = "rs"
+            else:
+                try:
+                    name = getter(vid) or "rs"
+                except Exception as e:  # noqa: BLE001 — master hiccup
+                    plan.skipped.append(
+                        (vid, f"cannot determine codec: "
+                              f"{type(e).__name__}: {e}"))
+                    continue
+        try:
+            codec = get_codec(name)
+        except ValueError:
+            plan.skipped.append((vid, f"unknown codec {name!r}"))
+            continue
         locs = env.ec_shard_locations(vid)
         present = tuple(sorted(locs))
-        missing = tuple(s for s in range(TOTAL_SHARDS) if s not in locs)
+        missing = tuple(s for s in range(codec.total_shards)
+                        if s not in locs)
         if not missing:
             continue
-        if len(present) < DATA_SHARDS:
+        try:
+            codec.repair_plan(present, list(missing))
+        except ValueError:
             plan.skipped.append(
-                (vid, f"only {len(present)} shards survive"))
+                (vid, f"only {len(present)} shards survive "
+                      f"({codec.name}: unrecoverable pattern)"))
             continue
-        plan.groups.setdefault((present, missing), []).append((vid, locs))
+        plan.groups.setdefault((codec.name, present, missing),
+                               []).append((vid, locs))
     return plan
+
+
+def plan_repair_reads(codec: Codec, present, missing) -> dict:
+    """Repair-bandwidth plan for one volume: per-missing-shard minimal
+    read sets (local group first, global fallback) plus the
+    planned-vs-RS accounting the rebuild reports — RS(k) reads
+    data_shards survivors once to rebuild everything, so the saving is
+    union-of-planned-reads vs data_shards."""
+    plans = codec.repair_plan(tuple(present), list(missing))
+    union: set[int] = set()
+    for p in plans:
+        union.update(p.reads)
+    return {
+        "codec": codec.name,
+        "reads": {p.sid: list(p.reads) for p in plans},
+        "union_reads": sorted(union),
+        "planned_read_shards": len(union),
+        "rs_read_shards": codec.data_shards,
+        "local_repairs": sum(1 for p in plans if p.local),
+    }
 
 
 def _fetch_shard(holders: list[str], vid: int, sid: int,
@@ -200,49 +260,64 @@ def batch_rebuild(env, vids=None, mesh=None, max_batch_bytes=1 << 28,
     picker = _TargetPicker(env)
     pool = concurrent.futures.ThreadPoolExecutor(max_workers=workers)
     try:
-        for (present, missing), entries in sorted(plan.groups.items()):
+        for (codec_name, present, missing), entries in \
+                sorted(plan.groups.items()):
             messages += _rebuild_group(
-                env, mesh, pool, picker, present, missing, entries,
-                max_batch_bytes, matrix_kind, progress)
+                env, mesh, pool, picker, get_codec(codec_name),
+                present, missing, entries, max_batch_bytes,
+                matrix_kind, progress)
     finally:
         pool.shutdown(wait=False)
     return messages
 
 
-def _rebuild_group(env, mesh, pool, picker, present, missing, entries,
-                   max_batch_bytes, matrix_kind, progress) -> list[str]:
-    """One survivor-signature group — journaled as
-    ec.rebuild.start/finish with per-stage byte/second attrs, under a
-    root span so the timeline row links to a /debug/traces trace."""
+def _rebuild_group(env, mesh, pool, picker, codec, present, missing,
+                   entries, max_batch_bytes, matrix_kind,
+                   progress) -> list[str]:
+    """One (codec, survivor-signature) group — journaled as
+    ec.rebuild.start/finish with per-stage byte/second attrs plus the
+    planner's planned-vs-RS read accounting, under a root span so the
+    timeline row links to a /debug/traces trace."""
     vids = [vid for vid, _locs in entries]
+    report = plan_repair_reads(codec, present, missing)
     with root_span("ec.batch_rebuild", "ec", volumes=len(vids),
-                   missing=list(missing)):
+                   missing=list(missing), codec=codec.name):
         emit_event("ec.rebuild.start", volumes=vids, batch=True,
-                   missing=list(missing))
+                   missing=list(missing), codec=codec.name,
+                   planned_read_shards=report["planned_read_shards"],
+                   rs_read_shards=report["rs_read_shards"])
         t0 = time.perf_counter()
         stages: dict[str, list[float]] = {}  # stage -> [seconds, bytes]
         try:
-            out = _rebuild_group_inner(env, mesh, pool, picker, present,
-                                       missing, entries, max_batch_bytes,
-                                       matrix_kind, progress, stages)
+            out = _rebuild_group_inner(env, mesh, pool, picker, codec,
+                                       present, missing, entries,
+                                       max_batch_bytes, matrix_kind,
+                                       progress, stages, report)
         except Exception as e:
             emit_event("ec.rebuild.finish", severity="error",
                        volumes=vids, batch=True, missing=list(missing),
+                       codec=codec.name,
                        seconds=round(time.perf_counter() - t0, 6),
                        error=f"{type(e).__name__}: {e}",
                        **stage_attrs(stages))
             raise
         emit_event("ec.rebuild.finish", volumes=vids, batch=True,
-                   missing=list(missing),
+                   missing=list(missing), codec=codec.name,
+                   planned_read_shards=report["planned_read_shards"],
+                   rs_read_shards=report["rs_read_shards"],
                    seconds=round(time.perf_counter() - t0, 6),
                    **stage_attrs(stages))
         return out
 
 
-def _rebuild_group_inner(env, mesh, pool, picker, present, missing,
-                         entries, max_batch_bytes, matrix_kind,
-                         progress, stages) -> list[str]:
-    used = present[:DATA_SHARDS]
+def _rebuild_group_inner(env, mesh, pool, picker, codec, present,
+                         missing, entries, max_batch_bytes, matrix_kind,
+                         progress, stages, report) -> list[str]:
+    # The codec's planned read set, not "first data_shards survivors":
+    # an in-group LRC loss gathers 5 shards per volume instead of 10.
+    _mat, used = codec.decode_matrix(present, missing)
+    all_local = bool(report["local_repairs"]) and \
+        report["local_repairs"] == len(missing)
     vol_axis = mesh.shape["vol"]
     col_axis = mesh.shape["col"]
     align = _pad_to(_COL_ALIGN, col_axis * 8)
@@ -254,7 +329,7 @@ def _rebuild_group_inner(env, mesh, pool, picker, present, missing,
         vid0, locs0 = entries[i]
         rows0 = _fetch_rows(pool, vid0, locs0, used)
         shard_bytes = len(rows0[0])
-        per_vol = shard_bytes * (DATA_SHARDS + len(missing))
+        per_vol = shard_bytes * (len(used) + len(missing))
         chunk_v = max(1, min(len(entries) - i,
                              int(max_batch_bytes // max(per_vol, 1))))
         chunk = entries[i:i + chunk_v]
@@ -263,14 +338,14 @@ def _rebuild_group_inner(env, mesh, pool, picker, present, missing,
         futs = [[pool.submit(_fetch_shard, locs[sid], vid, sid)
                  for sid in used] for vid, locs in chunk[1:]]
         fetched = [rows0] + [[f.result() for f in row] for row in futs]
+        gathered = sum(len(row) for rows in fetched for row in rows)
         observe_batch_stage(stages, "batch_gather",
-                       time.perf_counter() - t_gather,
-                       sum(len(row) for rows in fetched
-                           for row in rows))
+                       time.perf_counter() - t_gather, gathered)
+        ec_repair_read_bytes_total.inc(gathered, codec=codec.name)
         sizes = [len(rows[0]) for rows in fetched]
         n_pad = _pad_to(max(sizes), align)
         v_pad = _pad_to(len(chunk), vol_axis)
-        stacked = np.zeros((v_pad, DATA_SHARDS, n_pad), np.uint8)
+        stacked = np.zeros((v_pad, len(used), n_pad), np.uint8)
         for v, rows in enumerate(fetched):
             for r, row in enumerate(rows):
                 if len(row) != sizes[v]:
@@ -284,20 +359,29 @@ def _rebuild_group_inner(env, mesh, pool, picker, present, missing,
         t_dev = time.perf_counter()
         rebuilt = np.asarray(batched_reconstruct(
             stacked, present, missing, mesh,
-            matrix_kind=matrix_kind))
+            matrix_kind=matrix_kind, codec=codec))
         observe_batch_stage(stages, "batch_rebuild_device",
                        time.perf_counter() - t_dev, stacked.nbytes)
         t_scatter = time.perf_counter()
         scattered = 0
+        saved = f" ({codec.name}: read {len(used)} shards vs " \
+                f"{codec.data_shards} for RS)" \
+            if len(used) < codec.data_shards else ""
         for v, (vid, locs) in enumerate(chunk):
             shards = [rebuilt[v, m, :sizes[v]].tobytes()
                       for m in range(len(missing))]
             scattered += sum(len(s) for s in shards)
             placed = _scatter_volume(
                 env, pool, picker, vid, locs, missing, shards)
+            if all_local:
+                emit_event("ec.repair.local", vid=vid,
+                           codec=codec.name, shard=list(missing),
+                           reads=len(used),
+                           bytes=sizes[v] * len(used))
             out.append(f"volume {vid}: rebuilt shards "
                        f"{list(missing)} -> " +
-                       ", ".join(f"{s}@{u}" for s, u in placed))
+                       ", ".join(f"{s}@{u}" for s, u in placed)
+                       + saved)
             if progress:
                 progress(out[-1])
         observe_batch_stage(stages, "batch_scatter",

@@ -39,6 +39,16 @@ else:  # pragma: no cover — exercised on the 0.4.x image
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
+def _codec_of(data_shards: int, parity_shards: int, matrix_kind: str,
+              codec):
+    """Resolve the scheme: an explicit codec wins, else ad-hoc RS from
+    the shard-count arguments (the pre-codec call signature)."""
+    from ..codecs import get_codec, rs_codec
+    if codec is None:
+        return rs_codec(data_shards, parity_shards, matrix_kind)
+    return get_codec(codec)
+
+
 def _parity_pm(data_shards: int, parity_shards: int,
                kind: str = "vandermonde") -> np.ndarray:
     pb = rs_bitmatrix.parity_bitmatrix(
@@ -53,19 +63,23 @@ def _encode_batch(bmat_pm, data, parity_shards: int):
 
 def batched_encode(data, mesh: Mesh | None = None,
                    data_shards: int = 10, parity_shards: int = 4,
-                   matrix_kind: str = "vandermonde"):
+                   matrix_kind: str = "vandermonde", codec=None):
     """(V, data_shards, N) uint8 -> (V, parity_shards, N) parity.
 
     With a mesh, inputs are placed (vol, None, col)-sharded so each chip
     encodes its own volume/column block — no cross-chip traffic.
+    `codec` swaps the generator matrix (e.g. "lrc"); the kernel and
+    sharding story are identical.
     """
-    bmat = jnp.asarray(_parity_pm(data_shards, parity_shards, matrix_kind),
-                       jnp.bfloat16)
+    cd = _codec_of(data_shards, parity_shards, matrix_kind, codec)
+    bmat = jnp.asarray(
+        plane_major(cd.parity_bitmatrix(), cd.parity_shards,
+                    cd.data_shards), jnp.bfloat16)
     data = jnp.asarray(data, jnp.uint8)
     if mesh is not None:
         data = jax.device_put(
             data, NamedSharding(mesh, P("vol", None, "col")))
-    return _encode_batch(bmat, data, parity_shards)
+    return _encode_batch(bmat, data, cd.parity_shards)
 
 
 @functools.partial(jax.jit, static_argnames=("wanted_count",))
@@ -78,22 +92,23 @@ def batched_reconstruct(stacked, present: tuple[int, ...],
                         wanted: tuple[int, ...],
                         mesh: Mesh | None = None,
                         data_shards: int = 10, parity_shards: int = 4,
-                        matrix_kind: str = "vandermonde"):
+                        matrix_kind: str = "vandermonde", codec=None):
     """Rebuild `wanted` shards for V volumes that all lost the same shards.
 
-    stacked: (V, data_shards, N) — the first `data_shards` surviving shards
-    (sorted by id) for each volume, i.e. `decode_matrix`'s `used` rows.
-    Returns (V, len(wanted), N).
+    stacked: (V, len(used), N) — the codec's `used` survivor rows
+    (codec.decode_matrix(present, wanted)[1], stacked in that order)
+    for each volume; for RS that is the first data_shards survivors
+    sorted by id, for LRC the planned minimal read set (5 rows for an
+    in-group loss).  Returns (V, len(wanted), N).
     """
-    total = data_shards + parity_shards
-    bmat, used = rs_bitmatrix.decode_bitmatrix(
-        data_shards, total, tuple(present), tuple(wanted), matrix_kind)
-    pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted), data_shards),
+    cd = _codec_of(data_shards, parity_shards, matrix_kind, codec)
+    bmat, used = cd.decode_bitmatrix(tuple(present), tuple(wanted))
+    pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted), len(used)),
                      jnp.bfloat16)
     stacked = jnp.asarray(stacked, jnp.uint8)
-    if stacked.shape[1] != data_shards:
+    if stacked.shape[1] != len(used):
         raise ValueError(
-            f"stacked must carry the {data_shards} used survivor rows "
+            f"stacked must carry the {len(used)} used survivor rows "
             f"({[int(u) for u in used]}), got {stacked.shape[1]}")
     if mesh is not None:
         stacked = jax.device_put(

@@ -107,34 +107,39 @@ def balanced_distribution(nodes: list[dict],
 class EcEncode(Command):
     name = "ec.encode"
     help = ("ec.encode -volumeId <id>[,<id>...] | -collection <name> "
-            "[-fullPercent 95] [-batch] [-maxBatchMB 256] — "
-            "erasure-code volumes and spread the shards across the "
-            "cluster.  Default: per-volume generate on the holder "
-            "(VolumeEcShardsGenerate).  -batch: pull quiet volumes, "
-            "encode MANY at once in mesh-batched compiled steps "
-            "(volumes data-parallel over chips), scatter shards + .ecx "
-            "back (SURVEY §2.3 'shard scatter after encode')")
+            "[-fullPercent 95] [-codec rs|lrc] [-batch] "
+            "[-maxBatchMB 256] — erasure-code volumes and spread the "
+            "shards across the cluster.  Default: per-volume generate "
+            "on the holder (VolumeEcShardsGenerate).  -codec lrc: "
+            "LRC(10,2,2) — single-shard repair reads 5 shards instead "
+            "of 10.  -batch: pull quiet volumes, encode MANY at once "
+            "in mesh-batched compiled steps (volumes data-parallel "
+            "over chips), scatter shards + .ecx back (SURVEY §2.3 "
+            "'shard scatter after encode')")
 
     def do(self, args: list[str], env: CommandEnv) -> str:
         env.confirm_is_locked()
         flags, _ = self.parse_flags(args)
+        from ..codecs import get_codec
+        codec = get_codec(flags.get("codec") or None).name
         vids = self._collect_vids(flags, env)
         if not vids:
             return "no volumes to encode"
         if flags.get("batch") == "true":
-            return self.encode_batch(env, vids, flags)
+            return self.encode_batch(env, vids, flags, codec)
         out = []
         for vid in vids:
-            out.append(self.encode_one(env, vid))
+            out.append(self.encode_one(env, vid, codec))
         return "\n".join(out)
 
     def encode_batch(self, env: CommandEnv, vids: list[int],
-                     flags: dict) -> str:
+                     flags: dict, codec: str = "rs") -> str:
         from ..parallel import cluster_encode
         mesh = cluster_encode.make_mesh()
         max_mb = int(flags.get("maxBatchMB", 256))
         messages = cluster_encode.batch_encode(
-            env, vids, mesh=mesh, max_batch_bytes=max_mb << 20)
+            env, vids, mesh=mesh, max_batch_bytes=max_mb << 20,
+            codec=codec)
         return "\n".join(messages) or "no volumes to encode"
 
     def _collect_vids(self, flags: dict, env: CommandEnv) -> list[int]:
@@ -155,7 +160,10 @@ class EcEncode(Command):
                             vids.add(v["id"])
         return sorted(vids)
 
-    def encode_one(self, env: CommandEnv, vid: int) -> str:
+    def encode_one(self, env: CommandEnv, vid: int,
+                   codec: str = "rs") -> str:
+        from ..codecs import get_codec
+        total = get_codec(codec).total_shards
         locations = env.volume_locations(vid)
         if not locations:
             raise ShellError(f"volume {vid} not found")
@@ -163,11 +171,13 @@ class EcEncode(Command):
         for url in locations:
             env.vs_call(url, "/admin/readonly",
                         {"volume": vid, "readonly": True})
-        # 2. generate 14 shards + .ecx + .vif on one holder.
+        # 2. generate the codec's shards + .ecx + .vif on one holder.
         source = locations[0]
-        env.vs_call(source, "/admin/ec/generate", {"volume": vid})
+        env.vs_call(source, "/admin/ec/generate",
+                    {"volume": vid, "codec": codec})
         # 3. spread: balanced distribution over free slots.
-        plan = balanced_distribution(collect_ec_nodes(env))
+        plan = balanced_distribution(collect_ec_nodes(env),
+                                     n_shards=total)
         # Copy everywhere before trimming anything: the source must keep
         # its full set until every target has pulled its shards.
         for url, shards in plan.items():
@@ -175,12 +185,12 @@ class EcEncode(Command):
                 copy_shards(env, vid, url, source, shards, copy_ecx=True)
         for url, shards in plan.items():
             mount_shards(env, vid, url)
-            drop = [s for s in range(TOTAL_SHARDS) if s not in shards]
+            drop = [s for s in range(total) if s not in shards]
             if url == source:
                 delete_shards(env, vid, url, drop)
             # Non-source targets only ever copied their own shards.
         if source not in plan:  # source got no shards: clear its full set
-            delete_shards(env, vid, source, list(range(TOTAL_SHARDS)))
+            delete_shards(env, vid, source, list(range(total)))
         # 4. delete the original volume from every replica.
         for url in locations:
             env.vs_call(url, "/admin/delete_volume", {"volume": vid})
@@ -235,15 +245,20 @@ class EcRebuild(Command):
         return sorted(vids)
 
     def rebuild_one(self, env: CommandEnv, vid: int) -> str | None:
+        from ..codecs import get_codec
+        codec = get_codec(env.ec_codec(vid))
         holders = node_shard_map(env, vid)
         present = sorted({s for shards in holders.values() for s in shards})
-        missing = [s for s in range(TOTAL_SHARDS) if s not in present]
+        missing = [s for s in range(codec.total_shards)
+                   if s not in present]
         if not missing:
             return None
-        if len(present) < 10:
+        try:
+            codec.repair_plan(tuple(present), missing)
+        except ValueError:
             raise ShellError(
                 f"volume {vid}: only {len(present)} shards survive; "
-                "cannot rebuild")
+                "cannot rebuild") from None
         # Rebuilder: the holder with most shards (prepareDataToRecover
         # copies the rest to it).
         rebuilder = max(holders, key=lambda u: len(holders[u]))

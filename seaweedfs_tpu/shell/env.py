@@ -73,6 +73,12 @@ class CommandEnv:
         return {int(s): [d["url"] for d in dns]
                 for s, dns in resp.get("ecShards", {}).items()}
 
+    def ec_codec(self, vid: int) -> str:
+        """The erasure codec an EC volume was encoded with, as learned
+        by the master from shard-holder heartbeats."""
+        resp = rpc.call(f"{self.master_url}/dir/lookup?volumeId={vid}")
+        return resp.get("ecCodec", "rs")
+
     def debug_servers(self, flags: dict) -> list[str]:
         """Base URLs for per-process debug surfaces (/debug/traces,
         /debug/faults, /debug/events): master first, then every

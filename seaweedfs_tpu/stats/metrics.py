@@ -329,6 +329,15 @@ needle_repairs_total = Counter(
     "SeaweedFS_needle_repairs_total",
     "self-healing repairs by source", ("source",))  # replica|ec
 
+# Repair bandwidth, the dominant EC operating cost at scale (arxiv
+# 1309.0186): every shard byte read to rebuild/reconstruct EC data,
+# labeled by codec so the LRC-vs-RS saving is a PromQL ratio.  Fed by
+# the local rebuild (ec/encoder.py), the volume server's degraded-read
+# / repair ladder, and the cluster batch-rebuild planner.
+ec_repair_read_bytes_total = Counter(
+    "SeaweedFS_ec_repair_read_bytes_total",
+    "shard bytes read to repair or reconstruct EC data", ("codec",))
+
 
 def observe_batch_stage(stages: dict, stage: str, seconds: float,
                         nbytes: int) -> None:

@@ -35,6 +35,11 @@ class VolumeGrowOption:
 class EcShardLocations:
     collection: str = ""
     locations: dict[int, list[DataNode]] = field(default_factory=dict)
+    # Erasure codec the volume's shards were generated with ("rs",
+    # "lrc", ...) — learned from shard-holder heartbeats so rebuild
+    # planning and health math use the right shard counts per volume
+    # in a mixed-codec cluster.
+    codec: str = "rs"
 
     def add(self, shard_id: int, dn: DataNode) -> None:
         lst = self.locations.setdefault(shard_id, [])
@@ -146,6 +151,10 @@ class Topology(Node):
     def lookup_ec_shards(self, vid: int) -> EcShardLocations | None:
         return self.ec_shard_map.get(vid)
 
+    def ec_codec(self, vid: int) -> str:
+        locs = self.ec_shard_map.get(vid)
+        return locs.codec if locs is not None else "rs"
+
     # -- heartbeat sync ------------------------------------------------------
 
     def _layout_for(self, v) -> VolumeLayout:
@@ -207,22 +216,25 @@ class Topology(Node):
 
     # -- EC shards -----------------------------------------------------------
 
-    def sync_data_node_ec_shards(self, shard_infos: list[tuple[int, str, int]],
+    def sync_data_node_ec_shards(self, shard_infos: list[tuple],
                                  dn: DataNode) -> None:
-        """Full EC state: list of (vid, collection, shard_bits)."""
+        """Full EC state: list of (vid, collection, shard_bits[, codec])."""
         incoming: dict[int, int] = {}
-        for vid, collection, bits in shard_infos:
+        for vid, collection, bits, *rest in shard_infos:
             incoming[vid] = bits
-            self.register_ec_shards(vid, collection, bits, dn)
+            self.register_ec_shards(vid, collection, bits, dn,
+                                    codec=rest[0] if rest else None)
         for vid in list(dn.ec_shards):
             if vid not in incoming:
                 self.unregister_ec_shards(vid, dn)
 
     def register_ec_shards(self, vid: int, collection: str, bits: int,
-                           dn: DataNode) -> None:
+                           dn: DataNode, codec: str | None = None) -> None:
         with self._lock:
             locs = self.ec_shard_map.setdefault(
                 vid, EcShardLocations(collection))
+            if codec:
+                locs.codec = codec
             old_bits = ShardBits(dn.ec_shards.get(vid, 0))
             new_bits = ShardBits(bits)
             for sid in new_bits.shard_ids():

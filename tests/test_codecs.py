@@ -1,0 +1,639 @@
+"""Pluggable erasure codecs: registry, LRC(10,2,2) algebra, the
+repair-bandwidth planner, minimal-read rebuilds, codec-agnostic
+scrub/.ecc integrity, and the cluster acceptance flow (encode with
+-codec lrc, survive losses, rebuild with <= 6 shard reads asserted via
+SeaweedFS_ec_repair_read_bytes_total and the planner report).
+
+Property tests: EVERY registered codec round-trips against the
+NumpyCoder reference under randomized erasure patterns up to its
+declared tolerance, and raises cleanly one past what the code can
+express.
+"""
+
+import itertools
+import os
+import random
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.codecs import (Codec, codec_names, get_codec,
+                                  rs_codec, solve_decode)
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.erasure import new_coder
+
+pytestmark = pytest.mark.codecs
+
+RNG = np.random.default_rng(7)
+
+
+def _all_shards(codec: Codec, data: np.ndarray) -> np.ndarray:
+    return np.concatenate(
+        [data, gf256.mat_mul(codec.parity_matrix(), data)], axis=0)
+
+
+def _roundtrip(codec: Codec, shards: np.ndarray, missing) -> None:
+    present = tuple(s for s in range(codec.total_shards)
+                    if s not in missing)
+    mat, used = codec.decode_matrix(present, tuple(missing))
+    rec = gf256.mat_mul(mat, shards[list(used)])
+    assert np.array_equal(rec, shards[list(missing)]), missing
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_has_rs_and_lrc():
+    assert {"rs", "lrc"} <= set(codec_names())
+    rs = get_codec("rs")
+    assert (rs.data_shards, rs.parity_shards, rs.tolerance) == (10, 4, 4)
+    lrc = get_codec("lrc")
+    assert (lrc.data_shards, lrc.parity_shards) == (10, 4)
+    assert lrc.total_shards == rs.total_shards == 14
+    assert len(lrc.locality) == 2
+    with pytest.raises(ValueError, match="unknown erasure codec"):
+        get_codec("nope")
+    # None / empty resolve to the wire-compatible default.
+    assert get_codec(None).name == "rs"
+
+
+def test_rs_codec_matches_gf256_reference():
+    """The registered rs codec IS the klauspost construction: same
+    parity matrix, same decode matrices, same first-k survivor
+    selection — the wire-compat invariant."""
+    rs = get_codec("rs")
+    assert np.array_equal(rs.parity_matrix(),
+                          gf256.parity_matrix(10, 14))
+    present = tuple(s for s in range(14) if s not in (0, 13))
+    mat, used = rs.decode_matrix(present, (0, 13))
+    ref_mat, ref_used = gf256.decode_matrix(10, 14, list(present),
+                                            wanted=[0, 13])
+    assert list(used) == ref_used
+    assert np.array_equal(mat, ref_mat)
+
+
+def test_lrc_local_groups_and_repair_costs():
+    lrc = get_codec("lrc")
+    assert lrc.local_group(0).members == (0, 1, 2, 3, 4, 10)
+    assert lrc.local_group(7).members == (5, 6, 7, 8, 9, 11)
+    assert lrc.local_group(12) is None
+    for sid in range(12):
+        assert lrc.min_repair_reads(sid) == 5
+    for sid in (12, 13):
+        assert lrc.min_repair_reads(sid) == 10
+    assert all(get_codec("rs").min_repair_reads(s) == 10
+               for s in range(14))
+
+
+def test_lrc_repair_plan_prefers_local_group():
+    lrc = get_codec("lrc")
+    plan = lrc.repair_plan(tuple(range(1, 14)), [0])
+    assert plan[0].local and set(plan[0].reads) == {1, 2, 3, 4, 10}
+    # A global parity loss has no locality group: 10-read re-encode.
+    plan = lrc.repair_plan(tuple(range(13)), [13])
+    assert not plan[0].local and len(plan[0].reads) == 10
+    # Local parity of group B from its data members.
+    plan = lrc.repair_plan(tuple(s for s in range(14) if s != 11), [11])
+    assert plan[0].local and set(plan[0].reads) == {5, 6, 7, 8, 9}
+
+
+# -- exhaustive / randomized algebra ----------------------------------------
+
+def test_lrc_survives_every_loss_up_to_tolerance_exhaustively():
+    """All C(14,1) + C(14,2) + C(14,3) = 469 erasure patterns decode:
+    the 'survives loss of any 2 shards' acceptance criterion with a
+    margin (the Cauchy construction is maximally recoverable at 3)."""
+    lrc = get_codec("lrc")
+    data = RNG.integers(0, 256, (10, 48), dtype=np.uint8)
+    shards = _all_shards(lrc, data)
+    for k in (1, 2, 3):
+        for missing in itertools.combinations(range(14), k):
+            _roundtrip(lrc, shards, list(missing))
+
+
+def test_lrc_structured_four_loss_one_per_group_plus_globals():
+    """The acceptance pattern: any 1 loss per local group + BOTH
+    global parities (4 losses) still decodes via the local XORs."""
+    lrc = get_codec("lrc")
+    data = RNG.integers(0, 256, (10, 32), dtype=np.uint8)
+    shards = _all_shards(lrc, data)
+    for a in (0, 1, 2, 3, 4, 10):
+        for b in (5, 6, 7, 8, 9, 11):
+            _roundtrip(lrc, shards, [a, b, 12, 13])
+
+
+def test_lrc_raises_cleanly_past_what_the_code_expresses():
+    lrc = get_codec("lrc")
+    # 4 data shards of one group exceed the group's 1 local + 2 global
+    # equations: undecodable, and the solver says so instead of
+    # returning garbage.
+    present = tuple(s for s in range(14) if s not in (0, 1, 2, 3))
+    with pytest.raises(ValueError, match="unrecoverable"):
+        lrc.decode_matrix(present, (0, 1, 2, 3))
+    with pytest.raises(ValueError, match="unrecoverable"):
+        lrc.repair_plan(present, [0, 1, 2, 3])
+    # 3 same-group data + the group's local parity (4 losses).
+    present = tuple(s for s in range(14) if s not in (5, 6, 7, 11))
+    with pytest.raises(ValueError, match="unrecoverable"):
+        lrc.decode_matrix(present, (5, 6, 7, 11))
+
+
+@pytest.mark.parametrize("name", sorted({"rs", "lrc"}))
+def test_every_registered_codec_roundtrips_against_numpy_reference(name):
+    """The satellite property test: randomized erasures up to the
+    codec's tolerance round-trip through the NumpyCoder reference
+    backend, and one past the tolerance either round-trips (patterns
+    the code can still express) or raises ValueError — never silent
+    corruption."""
+    codec = get_codec(name)
+    coder = new_coder(backend="numpy", codec=name)
+    rng = random.Random(99)
+    data = RNG.integers(0, 256, (codec.data_shards, 96), dtype=np.uint8)
+    shards = np.asarray(coder.encode_all(data))
+    assert coder.verify(shards)
+    for _ in range(40):
+        k = rng.randint(1, codec.tolerance)
+        missing = sorted(rng.sample(range(codec.total_shards), k))
+        have = {s: shards[s] for s in range(codec.total_shards)
+                if s not in missing}
+        rec = coder.reconstruct(have)
+        for m in missing:
+            assert np.array_equal(np.asarray(rec[m]), shards[m]), \
+                (name, missing)
+    # One past the tolerance: must decode correctly or raise cleanly.
+    for _ in range(40):
+        missing = sorted(rng.sample(range(codec.total_shards),
+                                    codec.tolerance + 1))
+        have = {s: shards[s] for s in range(codec.total_shards)
+                if s not in missing}
+        try:
+            rec = coder.reconstruct(have)
+        except ValueError:
+            continue
+        for m in missing:
+            assert np.array_equal(np.asarray(rec[m]), shards[m]), \
+                (name, missing)
+
+
+def test_device_backends_match_numpy_reference_for_lrc():
+    """Same bytes out of every backend — the bit-matmul lowering of
+    the LRC matrices is semantics-preserving."""
+    data = RNG.integers(0, 256, (10, 4096), dtype=np.uint8)
+    ref = new_coder(backend="numpy", codec="lrc")
+    want = np.asarray(ref.encode_all(data))
+    for backend in ("jax", "pallas"):
+        coder = new_coder(backend=backend, codec="lrc")
+        got = np.asarray(coder.encode_all(data))
+        assert np.array_equal(got, want), backend
+        have = {s: want[s] for s in range(14) if s not in (4, 9)}
+        rec = coder.reconstruct(have)
+        assert np.array_equal(np.asarray(rec[4]), want[4])
+        assert np.array_equal(np.asarray(rec[9]), want[9])
+
+
+def test_lrc_bitmatrix_sibling_module():
+    """ops/lrc_bitmatrix mirrors rs_bitmatrix's API for the lrc codec."""
+    from seaweedfs_tpu.ops import lrc_bitmatrix, rs_bitmatrix
+    pb = lrc_bitmatrix.parity_bitmatrix()
+    assert pb.shape == (8 * 4, 8 * 10)
+    assert np.array_equal(
+        pb, rs_bitmatrix.expand_bitmatrix(
+            get_codec("lrc").parity_matrix()))
+    bmat, used = lrc_bitmatrix.decode_bitmatrix(tuple(range(1, 14)), (0,))
+    assert set(used) == {1, 2, 3, 4, 10}
+    assert bmat.shape == (8, 8 * 5)
+
+
+def test_solver_minimal_support_and_rs_equivalence():
+    """The generic solver drops survivors the algebra doesn't need and
+    reproduces klauspost's subshard selection for MDS codes."""
+    rs = rs_codec(10, 4)
+    mat, used = solve_decode(np.asarray(rs.matrix), tuple(range(1, 14)),
+                             (0,))
+    ref_mat, ref_used = gf256.decode_matrix(10, 14, list(range(1, 14)),
+                                            wanted=[0])
+    assert list(used) == ref_used
+    assert np.array_equal(mat, ref_mat)
+    # Ad-hoc RS schemes (bench table) still construct.
+    for k, m in ((16, 4), (8, 3)):
+        c = rs_codec(k, m)
+        assert c.total_shards == k + m
+
+
+# -- file pipeline: encode/rebuild/scrub with the lrc codec -----------------
+
+LARGE, SMALL = 10000, 100  # the reference test's shrunken block sizes
+
+
+@pytest.fixture(scope="module")
+def lrc_base(tmp_path_factory):
+    """A real volume with random needles, encoded to LRC shards with
+    the numpy reference backend."""
+    from seaweedfs_tpu.core.needle import Needle
+    from seaweedfs_tpu.ec.encoder import (write_ec_files,
+                                          write_sorted_file_from_idx)
+    from seaweedfs_tpu.storage.volume import Volume
+    tmp = tmp_path_factory.mktemp("lrcvol")
+    v = Volume(str(tmp), "", 1)
+    rng = random.Random(21)
+    payloads = {}
+    for i in range(1, 81):
+        data = bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(1, 700)))
+        payloads[i] = data
+        n = Needle(cookie=0x1234, id=i, data=data)
+        n.append_at_ns = i
+        v.write_needle(n)
+    v.sync()
+    base = v.file_name()
+    v.close()
+    write_sorted_file_from_idx(base)
+    write_ec_files(base, coder=new_coder(backend="numpy", codec="lrc"),
+                   large_block_size=LARGE, small_block_size=SMALL,
+                   chunk_size=SMALL)
+    return base, payloads
+
+
+def _open_lrc(base, **kw):
+    from seaweedfs_tpu.ec.volume import EcVolume
+    return EcVolume(base, coder=new_coder(backend="numpy", codec="lrc"),
+                    large_block_size=LARGE, small_block_size=SMALL, **kw)
+
+
+def test_lrc_vif_records_codec(lrc_base):
+    base, _ = lrc_base
+    from seaweedfs_tpu.ec.volume_info import ec_codec_name
+    assert ec_codec_name(base) == "lrc"
+
+
+def test_lrc_volume_detects_codec_from_vif(lrc_base):
+    """EcVolume with no explicit coder picks the lrc matrices from the
+    .vif — the end-to-end codec-id thread."""
+    from seaweedfs_tpu.ec.volume import EcVolume
+    base, payloads = lrc_base
+    ev = EcVolume(base, large_block_size=LARGE, small_block_size=SMALL)
+    try:
+        assert ev.codec.name == "lrc"
+        n = ev.read_needle(5)
+        assert n.data == payloads[5]
+    finally:
+        ev.close()
+
+
+def test_lrc_every_needle_reads_back(lrc_base):
+    base, payloads = lrc_base
+    ev = _open_lrc(base)
+    try:
+        for nid, want in payloads.items():
+            assert ev.read_needle(nid).data == want
+    finally:
+        ev.close()
+
+
+def test_lrc_degraded_read_uses_local_group_reads(lrc_base, tmp_path):
+    """Lose one shard per local group: every needle still reads, and
+    the reconstruction reads 5 shards per missing interval (asserted
+    via SeaweedFS_ec_repair_read_bytes_total{codec="lrc"})."""
+    import shutil
+    from seaweedfs_tpu.ec import to_ext
+    from seaweedfs_tpu.stats.metrics import ec_repair_read_bytes_total
+    base, payloads = lrc_base
+    dst = str(tmp_path / "v")
+    for sid in range(14):
+        if sid in (2, 7):
+            continue
+        shutil.copyfile(base + to_ext(sid), dst + to_ext(sid))
+    for ext in (".ecx", ".vif"):
+        shutil.copyfile(base + ext, dst + ext)
+    ev = _open_lrc(dst)
+    try:
+        before = ec_repair_read_bytes_total.value(codec="lrc")
+        for nid, want in payloads.items():
+            assert ev.read_needle(nid).data == want
+        read = ec_repair_read_bytes_total.value(codec="lrc") - before
+        # Each interval on a lost shard reconstructs from EXACTLY its
+        # 5-shard locality group; RS(10,4) would read 10 interval
+        # copies.  Predict the byte count from the layout math and
+        # require equality — the provably-fewer-reads acceptance.
+        expected = 0
+        for nid in payloads:
+            _off, _size, intervals = ev.locate_needle(nid)
+            for iv in intervals:
+                sid, _o = iv.to_shard_id_and_offset(LARGE, SMALL)
+                if sid in (2, 7):
+                    expected += 5 * iv.size
+        assert expected > 0 and read == expected
+    finally:
+        ev.close()
+
+
+def test_lrc_rebuild_reads_local_group_and_is_byte_identical(
+        lrc_base, tmp_path):
+    """rebuild_ec_files on an lrc volume: the missing in-group shard
+    is regenerated byte-identically while reading only its 5-shard
+    local group (satellite: codec-derived shard counts + planner)."""
+    import shutil
+    from seaweedfs_tpu.ec import to_ext
+    from seaweedfs_tpu.ec.encoder import rebuild_ec_files
+    from seaweedfs_tpu.stats.metrics import ec_repair_read_bytes_total
+    base, _ = lrc_base
+    dst = str(tmp_path / "v")
+    for sid in range(14):
+        if sid == 8:
+            continue
+        shutil.copyfile(base + to_ext(sid), dst + to_ext(sid))
+    for ext in (".ecx", ".vif"):
+        shutil.copyfile(base + ext, dst + ext)
+    shard_size = os.path.getsize(base + to_ext(0))
+    before = ec_repair_read_bytes_total.value(codec="lrc")
+    # No coder passed: codec comes from the .vif.
+    rebuilt = rebuild_ec_files(dst, coder=new_coder(backend="numpy",
+                                                    codec="lrc"))
+    read = ec_repair_read_bytes_total.value(codec="lrc") - before
+    assert rebuilt == [8]
+    assert read == 5 * shard_size  # local group, not 10 survivors
+    with open(base + to_ext(8), "rb") as a, \
+            open(dst + to_ext(8), "rb") as b:
+        assert a.read() == b.read()
+
+
+def test_lrc_rebuild_updates_ecc_sidecar_for_scrub(lrc_base, tmp_path):
+    """Scrub/.ecc satellite: the sidecar written for lrc volumes has
+    one CRC list per codec shard (not an RS-shaped 14 by accident but
+    derived), survives a rebuild, and the scrub verifier finds zero
+    corruption on clean shards + flags a real flip."""
+    import shutil
+    from seaweedfs_tpu.ec import to_ext
+    from seaweedfs_tpu.ec.integrity import ShardChecksums
+    base, _ = lrc_base
+    codec = get_codec("lrc")
+    ecc = ShardChecksums.load(base)
+    assert sorted(ecc.shards) == list(range(codec.total_shards))
+    for sid in range(codec.total_shards):
+        assert ecc.verify_file(sid, base + to_ext(sid)) == []
+    # Flip a byte in a parity shard copy: scrub math flags exactly it.
+    dst = str(tmp_path / "v")
+    for sid in range(14):
+        shutil.copyfile(base + to_ext(sid), dst + to_ext(sid))
+    for ext in (".ecx", ".vif", ".ecc"):
+        shutil.copyfile(base + ext, dst + ext)
+    with open(dst + to_ext(11), "r+b") as f:
+        f.seek(3)
+        b = f.read(1)
+        f.seek(3)
+        f.write(bytes([b[0] ^ 0xFF]))
+    ecc2 = ShardChecksums.load(dst)
+    assert ecc2.verify_file(11, dst + to_ext(11)) == [0]
+    assert ecc2.verify_file(10, dst + to_ext(10)) == []
+
+
+def test_rebuild_plan_is_codec_aware_for_mixed_clusters():
+    """The satellite fix: plan_rebuilds derives shard counts from each
+    volume's codec, so a mixed-codec cluster can't mis-plan."""
+    from seaweedfs_tpu.parallel.cluster_rebuild import (plan_rebuilds,
+                                                       plan_repair_reads)
+
+    class Env:
+        def __init__(self):
+            self.codecs = {1: "rs", 2: "lrc"}
+            self.locs = {
+                1: {s: ["h1:80"] for s in range(14) if s != 3},
+                2: {s: ["h2:80"] for s in range(14) if s != 3},
+            }
+
+        def data_nodes(self):
+            return [{"url": "h:80", "ec_shards": [
+                {"id": vid, "shard_bits": 0} for vid in self.locs]}]
+
+        def ec_shard_locations(self, vid):
+            return self.locs[vid]
+
+        def ec_codec(self, vid):
+            return self.codecs[vid]
+
+    plan = plan_rebuilds(Env())
+    assert len(plan.groups) == 2 and not plan.skipped
+    keys = sorted(plan.groups)
+    # Same survivor signature, different codec -> separate groups.
+    assert [k[0] for k in keys] == ["lrc", "rs"]
+    rs_report = plan_repair_reads(get_codec("rs"), keys[1][1], [3])
+    lrc_report = plan_repair_reads(get_codec("lrc"), keys[0][1], [3])
+    assert rs_report["planned_read_shards"] == 10
+    assert lrc_report["planned_read_shards"] == 5
+    assert lrc_report["local_repairs"] == 1
+    assert lrc_report["rs_read_shards"] == 10
+
+
+# -- cluster acceptance: -codec lrc end to end ------------------------------
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    master = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path),
+                          pulse_seconds=60)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _freshen(servers):
+    for vs in servers:
+        vs._send_heartbeat(full=True)
+        vs._ec_loc_cache.clear()
+
+
+def test_cluster_lrc_acceptance(cluster):
+    """ISSUE acceptance: a cluster volume encoded with `ec.encode
+    -codec lrc` survives loss of any 2 shards (and 1 per local group +
+    both globals), and a single-shard rebuild provably reads <= 6
+    shards — asserted via SeaweedFS_ec_repair_read_bytes_total and the
+    planner report — vs 10 for RS."""
+    from seaweedfs_tpu.cluster import rpc
+    from seaweedfs_tpu.cluster.client import WeedClient
+    from seaweedfs_tpu.ec import to_ext
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from seaweedfs_tpu.stats.metrics import ec_repair_read_bytes_total
+    master, servers = cluster
+    client = WeedClient(master.url())
+    pairs = [(f"lrc-payload-{i}".encode(),
+              client.upload_data(f"lrc-payload-{i}".encode()))
+             for i in range(24)]
+    vid = int(pairs[0][1].split(",")[0])
+    pairs = [(p, f) for p, f in pairs if int(f.split(",")[0]) == vid]
+    _freshen(servers)
+    env = CommandEnv(master.url())
+    try:
+        run_command(env, "lock")
+        out = run_command(env, f"ec.encode -volumeId {vid} -codec lrc")
+        assert f"volume {vid}" in out
+        _freshen(servers)
+        # Codec id is threaded end to end: .vif -> heartbeat ->
+        # master lookup -> shell view.
+        assert env.ec_codec(vid) == "lrc"
+        assert sorted(env.ec_shard_locations(vid)) == list(range(14))
+        for vs in servers:
+            assert vs.store.find_volume(vid) is None
+        for payload, fid in pairs[:3]:
+            assert bytes(rpc.call(
+                f"http://{servers[0].url()}/{fid}")) == payload
+
+        def holders_of(sid):
+            return env.ec_shard_locations(vid)[sid]
+
+        def drop(shards):
+            for sid in shards:
+                for url in holders_of(sid):
+                    rpc.call_json(f"http://{url}/admin/ec/delete_shards",
+                                  "POST", {"volume": vid,
+                                           "shards": [sid]})
+            _freshen(servers)
+
+        def heal():
+            out = run_command(env, f"ec.rebuild -volumeId {vid} -batch")
+            _freshen(servers)
+            assert sorted(env.ec_shard_locations(vid)) == \
+                list(range(14))
+            return out
+
+        # Loss of 2 shards (one per group): every payload still reads.
+        drop([1, 6])
+        for payload, fid in pairs:
+            assert bytes(rpc.call(
+                f"http://{servers[1].url()}/{fid}")) == payload
+        heal()
+
+        # Structured 4-loss: 1 per local group + BOTH globals.
+        drop([4, 9, 12, 13])
+        for payload, fid in pairs[:5]:
+            assert bytes(rpc.call(
+                f"http://{servers[2].url()}/{fid}")) == payload
+        heal()
+
+        # Single-shard rebuild provably reads <= 6 shards (5 actual).
+        url0 = holders_of(3)[0]
+        shard_size = os.path.getsize(os.path.join(
+            next(loc.directory for vs in servers
+                 if vs.url() == url0 for loc in vs.store.locations),
+            f"{vid}{to_ext(3)}"))
+        drop([3])
+        before = ec_repair_read_bytes_total.value(codec="lrc")
+        out = heal()
+        read = ec_repair_read_bytes_total.value(codec="lrc") - before
+        assert "read 5 shards vs 10 for RS" in out
+        assert read == 5 * shard_size <= 6 * shard_size
+        # The repair-bandwidth counter is on the volume server scrape.
+        scrape = bytes(rpc.call(
+            f"http://{servers[0].url()}/metrics")).decode()
+        assert "SeaweedFS_ec_repair_read_bytes_total" in scrape
+        # ... and an RS volume in the same cluster reads 10.
+        for payload, fid in pairs:
+            assert bytes(rpc.call(
+                f"http://{servers[0].url()}/{fid}")) == payload
+    finally:
+        env.close()
+
+
+def test_cluster_rs_volumes_untouched_beside_lrc(cluster):
+    """Acceptance guard: existing RS volumes still encode, report
+    codec rs, and rebuild with the classic 10-survivor read set."""
+    from seaweedfs_tpu.cluster import rpc
+    from seaweedfs_tpu.cluster.client import WeedClient
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    master, servers = cluster
+    client = WeedClient(master.url())
+    fid = client.upload_data(b"rs-control-payload")
+    vid = int(fid.split(",")[0])
+    _freshen(servers)
+    env = CommandEnv(master.url())
+    try:
+        run_command(env, "lock")
+        run_command(env, f"ec.encode -volumeId {vid}")
+        _freshen(servers)
+        assert env.ec_codec(vid) == "rs"
+        assert sorted(env.ec_shard_locations(vid)) == list(range(14))
+        sid, urls = next(iter(env.ec_shard_locations(vid).items()))
+        for url in urls:
+            rpc.call_json(f"http://{url}/admin/ec/delete_shards",
+                          "POST", {"volume": vid, "shards": [sid]})
+        _freshen(servers)
+        out = run_command(env, f"ec.rebuild -volumeId {vid} -batch")
+        assert "rebuilt" in out and "vs 10 for RS" not in out
+        _freshen(servers)
+        assert sorted(env.ec_shard_locations(vid)) == list(range(14))
+        assert bytes(rpc.call(
+            f"http://{servers[0].url()}/{fid}")) == b"rs-control-payload"
+    finally:
+        env.close()
+
+
+def test_codec_lookup_failure_skips_volume_instead_of_guessing_rs():
+    """A transient master failure while resolving a volume's codec must
+    SKIP the volume, never plan it as rs — decoding LRC shards with RS
+    matrices would scatter corrupt bytes cluster-wide."""
+    from seaweedfs_tpu.parallel.cluster_rebuild import plan_rebuilds
+
+    class Env:
+        def data_nodes(self):
+            # /vol/list payload without codec ids (stale master).
+            return [{"url": "h:80",
+                     "ec_shards": [{"id": 5, "shard_bits": 0}]}]
+
+        def ec_shard_locations(self, vid):
+            return {s: ["h:80"] for s in range(13)}
+
+        def ec_codec(self, vid):
+            raise ConnectionError("master lookup 503")
+
+    plan = plan_rebuilds(Env())
+    assert not plan.groups
+    assert plan.skipped and "cannot determine codec" in plan.skipped[0][1]
+
+
+def test_plan_rebuilds_reads_codec_from_vol_list_payload():
+    """The /vol/list ec_shards entries carry the codec: planning does
+    not fall back to per-volume lookups when the payload has it."""
+    from seaweedfs_tpu.parallel.cluster_rebuild import plan_rebuilds
+
+    class Env:
+        def data_nodes(self):
+            return [{"url": "h:80", "ec_shards": [
+                {"id": 5, "shard_bits": 0, "codec": "lrc"}]}]
+
+        def ec_shard_locations(self, vid):
+            return {s: ["h:80"] for s in range(13)}
+
+        def ec_codec(self, vid):
+            raise AssertionError("per-volume lookup should not run")
+
+    plan = plan_rebuilds(Env())
+    assert list(plan.groups) == [("lrc", tuple(range(13)), (13,))]
+
+
+def test_unrecoverable_pattern_is_skipped_not_misplanned():
+    from seaweedfs_tpu.parallel.cluster_rebuild import plan_rebuilds
+
+    class Env:
+        def data_nodes(self):
+            return [{"url": "h:80",
+                     "ec_shards": [{"id": 9, "shard_bits": 0}]}]
+
+        def ec_shard_locations(self, vid):
+            return {s: ["h:80"] for s in range(14)
+                    if s not in (0, 1, 2, 3)}
+
+        def ec_codec(self, vid):
+            return "lrc"
+
+    plan = plan_rebuilds(Env())
+    assert not plan.groups
+    assert plan.skipped and "unrecoverable" in plan.skipped[0][1]

@@ -319,6 +319,29 @@ def _drive_ec_rebuild(cl):
     assert sorted(out["rebuilt_shards"]) == [3, 7]
 
 
+def _drive_ec_repair_local(cl):
+    """Degraded read of an LRC volume with a lost shard: the interval
+    reconstructs from the shard's locality group (5 reads) and the
+    server journals the local repair."""
+    vid, url, fid = _new_volume(cl, "lrccol")
+    rpc.call_json(f"http://{url}/admin/ec/generate", "POST",
+                  {"volume": vid, "codec": "lrc"})
+    rpc.call_json(f"http://{url}/admin/ec/mount", "POST",
+                  {"volume": vid})
+    rpc.call_json(f"http://{url}/admin/delete_volume", "POST",
+                  {"volume": vid})
+    # The test needle sits at the head of the .dat -> shard 0.
+    rpc.call_json(f"http://{url}/admin/ec/delete_shards", "POST",
+                  {"volume": vid, "shards": [0]})
+    assert rpc.call(f"http://{url}/{fid}")
+    # Heal the volume so the healthz rollup tests that follow see a
+    # healthy cluster again.
+    rpc.call_json(f"http://{url}/admin/ec/rebuild", "POST",
+                  {"volume": vid})
+    rpc.call_json(f"http://{url}/admin/ec/mount", "POST",
+                  {"volume": vid})
+
+
 def _drive_breaker_open(cl):
     _m, _s, stub, _c, _t = cl
     hostport = f"127.0.0.1:{stub.port}"
@@ -562,6 +585,7 @@ DRIVERS = {
     "ec.encode.finish": _drive_ec_encode,
     "ec.rebuild.start": _drive_ec_rebuild,
     "ec.rebuild.finish": _drive_ec_rebuild,
+    "ec.repair.local": _drive_ec_repair_local,
     "breaker.open": _drive_breaker_open,
     "breaker.half_open": _drive_breaker_half_open,
     "breaker.close": _drive_breaker_close,
@@ -589,8 +613,8 @@ def test_driver_catalog_matches_registry():
     # Deliberate churn: growing the catalog must touch this number so
     # the diff shows the new types were consciously added (18 from the
     # journal's introduction + 6 data-integrity types + 5 overload/
-    # lifecycle types).
-    assert len(TYPES) == 29
+    # lifecycle types + 1 codec type: ec.repair.local).
+    assert len(TYPES) == 30
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))
