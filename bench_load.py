@@ -1,0 +1,483 @@
+#!/usr/bin/env python3
+"""Sustained mixed-workload load harness — the gating BENCH series for
+the million-user front-door arc (ROADMAP 3).
+
+Drives an OPEN-LOOP (fixed arrival rate — the load does not slow down
+because the server did, which is what exposes tail collapse) mixed
+read/write workload with a skewed (Zipf) key distribution against a
+REAL subprocess cluster (master + 2 volume servers), then:
+
+1. reports achieved throughput and client-measured p50/p95/p99 (both
+   service latency — send to last byte — and open-loop latency from
+   the scheduled arrival, which includes queueing);
+2. CROSS-CHECKS the client-side tail against the server-side sliding
+   quantile sketch (/debug/slo): the client feeds its own read
+   latencies into an identical sketch (|sketch - exact| <= alpha*exact,
+   the documented bound, gates hard), and the server quantiles must
+   agree with the client's within alpha on both sides plus the
+   measured per-request framing overhead (3x the p50 client-server gap
+   + 2ms) — a self-calibrating tolerance recorded in the JSON;
+3. runs the FAULT PHASE of the acceptance criteria: a deliberately
+   injected slow fault (volume.read delay via /debug/faults) must
+   produce a /debug/slow exemplar whose trace id resolves in
+   /debug/traces, flip /cluster/healthz to degraded via the latency
+   burn rate, and emit `slo.burn`.
+
+Output: one JSON document (default BENCH_load_r01.json) — the BENCH
+series beside the EC kernel numbers.
+
+Knobs (env): BENCH_LOAD_QUICK=1 (seconds-scale smoke: the `slow`
+pytest path), BENCH_LOAD_RATE, BENCH_LOAD_DURATION, BENCH_LOAD_WARMUP,
+BENCH_LOAD_KEYS, BENCH_LOAD_SIZE, BENCH_LOAD_WORKERS, BENCH_LOAD_ZIPF,
+BENCH_LOAD_WRITE_FRACTION.  CPU-only; no accelerator involved.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+QUICK = os.environ.get("BENCH_LOAD_QUICK", "") in ("1", "true")
+
+
+def _env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+RATE = _env("BENCH_LOAD_RATE", 150.0 if QUICK else 400.0)
+DURATION = _env("BENCH_LOAD_DURATION", 5.0 if QUICK else 30.0)
+WARMUP = _env("BENCH_LOAD_WARMUP", 1.0 if QUICK else 5.0)
+KEYS = int(_env("BENCH_LOAD_KEYS", 80 if QUICK else 400))
+SIZE = int(_env("BENCH_LOAD_SIZE", 4096 if QUICK else 8192))
+# Enough for the offered concurrency (rate x ~2ms service time << 8)
+# with headroom for tail stalls; hundreds of idle threads would convoy
+# the CLIENT's own tail on the GIL and corrupt the measurement.
+WORKERS = int(_env("BENCH_LOAD_WORKERS", 16 if QUICK else 24))
+ZIPF_S = _env("BENCH_LOAD_ZIPF", 1.2)
+WRITE_FRACTION = _env("BENCH_LOAD_WRITE_FRACTION", 0.2)
+# Burn windows for the fault phase: short enough that the post-load
+# cool-down (both windows must shed the healthy main-run traffic
+# before the all-slow phase can dominate them) fits a bench run.
+SHORT_WINDOW = 6.0 if QUICK else 15.0
+LONG_WINDOW = 12.0 if QUICK else 30.0
+SLO_READ_P99 = 0.25          # generous: the main run must NOT burn
+FAULT_DELAY = 0.4            # >> objective: every faulted read burns
+ALPHA = 0.01                 # sketch bound (stats/sketch.py)
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+class Cluster:
+    """Subprocess master + 2 volume servers."""
+
+    def __init__(self, tmp: str):
+        from seaweedfs_tpu.cluster import rpc
+        self.tmp = tmp
+        self.procs: list[subprocess.Popen] = []
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   SEAWEEDFS_TPU_TRACES="1",
+                   SEAWEEDFS_TPU_FAULTS_DEBUG="1",
+                   SEAWEEDFS_TPU_SLO_SHORT_WINDOW=str(SHORT_WINDOW),
+                   SEAWEEDFS_TPU_SLO_LONG_WINDOW=str(LONG_WINDOW))
+        mport = rpc.free_port()
+        self.master_url = f"http://127.0.0.1:{mport}"
+        self._spawn(["master", f"-port={mport}",
+                     f"-mdir={tmp}/meta"], env)
+        self.volume_urls = []
+        for i in range(2):
+            vport = rpc.free_port()
+            d = f"{tmp}/vs{i}"
+            os.makedirs(d)
+            self._spawn(["volume", f"-port={vport}", f"-dir={d}",
+                         "-max=50", f"-mserver=127.0.0.1:{mport}",
+                         f"-slo.read.p99={SLO_READ_P99}",
+                         "-slo.availability=99.9"], env)
+            self.volume_urls.append(f"127.0.0.1:{vport}")
+
+    def _spawn(self, args: list[str], env: dict) -> None:
+        p = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu"] + args,
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.procs.append(p)
+
+    def wait_ready(self, timeout: float = 60.0) -> None:
+        from seaweedfs_tpu.cluster import rpc
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                st, doc = rpc.call_status(
+                    f"{self.master_url}/cluster/healthz", timeout=2.0)
+                if st == 200 and len(doc.get("nodes", [])) == 2:
+                    return
+            except Exception:  # noqa: BLE001 — still starting
+                pass
+            time.sleep(0.2)
+        raise TimeoutError("subprocess cluster never became healthy")
+
+    def stop(self) -> None:
+        for p in self.procs:
+            p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def populate(client, n: int, size: int, rng) -> list[str]:
+    payload = rng.integers(0, 256, size, dtype="uint8").tobytes()
+    fids = []
+    for _ in range(n):
+        fids.append(client.upload_data(payload))
+    return fids
+
+
+def percentiles(vals: list[float]) -> dict:
+    import math
+
+    import numpy as np
+    if not vals:
+        return {"count": 0}
+    arr = np.sort(np.asarray(vals))
+
+    def nearest(q):
+        # Nearest-rank with ceil — the SAME rank convention
+        # QuantileSketch.quantile uses.  A round-half-up here would
+        # compare adjacent order statistics against the sketch and
+        # fail the alpha gate on tails where neighbors differ > alpha.
+        return float(arr[max(0, math.ceil(q * len(arr)) - 1)])
+    return {"count": len(arr), "p50": nearest(0.5),
+            "p95": nearest(0.95), "p99": nearest(0.99)}
+
+
+def run_load(cluster: Cluster) -> dict:
+    """Open-loop mixed workload; returns client-side results + the
+    op log for the window-matched server comparison."""
+    import numpy as np
+
+    from seaweedfs_tpu.cluster import rpc
+    from seaweedfs_tpu.cluster.client import WeedClient
+    rng = np.random.default_rng(1)
+    client = WeedClient(cluster.master_url)
+    log(f"populating {KEYS} keys of {SIZE}B ...")
+    fids = populate(client, KEYS, SIZE, rng)
+
+    # Zipf-ranked key popularity: rank r drawn with p ~ 1/r^s.
+    ranks = np.arange(1, KEYS + 1)
+    probs = 1.0 / ranks ** ZIPF_S
+    probs /= probs.sum()
+    total_ops = int(RATE * (WARMUP + DURATION))
+    key_choice = rng.choice(KEYS, size=total_ops, p=probs)
+    is_write = rng.random(total_ops) < WRITE_FRACTION
+    payload = rng.integers(0, 256, SIZE, dtype="uint8").tobytes()
+
+    ops: list[tuple] = []   # (kind, sched, start, end, status)
+    ops_lock = threading.Lock()
+    pool = ThreadPoolExecutor(max_workers=WORKERS)
+    t0 = time.perf_counter()
+
+    def one(i: int, sched: float) -> None:
+        kind = "write" if is_write[i] else "read"
+        start = time.perf_counter()
+        status = 200
+        try:
+            if kind == "write":
+                client.upload_data(payload)
+            else:
+                client.download(fids[key_choice[i]])
+        except rpc.RpcError as e:
+            status = e.status
+        except Exception:  # noqa: BLE001 — connection-level failure
+            status = 599
+        end = time.perf_counter()
+        with ops_lock:
+            ops.append((kind, sched, start, end, status))
+
+    log(f"open loop: {RATE:g} req/s for {WARMUP + DURATION:g}s "
+        f"({WRITE_FRACTION:.0%} writes, zipf s={ZIPF_S:g}) ...")
+    futures = []
+    for i in range(total_ops):
+        sched = t0 + i / RATE
+        now = time.perf_counter()
+        if sched > now:
+            time.sleep(sched - now)
+        futures.append(pool.submit(one, i, sched))
+    for f in futures:
+        f.result()
+    pool.shutdown(wait=True)
+    t_end = time.perf_counter()
+    elapsed = t_end - t0
+
+    warm_cut = t0 + WARMUP
+    recorded = [op for op in ops if op[1] >= warm_cut]
+    reads = [op for op in recorded if op[0] == "read"]
+    writes = [op for op in recorded if op[0] == "write"]
+    errors = sum(1 for op in recorded if op[4] >= 500)
+    shed = sum(1 for op in recorded if op[4] == 429)
+
+    def svc(rows):
+        return [r[3] - r[2] for r in rows]
+
+    def sched_lat(rows):
+        return [r[3] - r[1] for r in rows]
+
+    # The client's own sketch over the same read latencies: the
+    # documented |sketch - exact| <= alpha*exact bound, checked hard.
+    from seaweedfs_tpu.stats.sketch import QuantileSketch
+    csk = QuantileSketch(alpha=ALPHA)
+    for v in svc(reads):
+        csk.observe(v)
+    exact = percentiles(svc(reads))
+    sketch_err = {}
+    within_alpha = True
+    for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+        est = csk.quantile(q)
+        rel = abs(est - exact[key]) / exact[key] if exact[key] else 0.0
+        sketch_err[key] = round(rel, 6)
+        if rel > ALPHA + 1e-9:
+            within_alpha = False
+
+    # Window-matched subset for the server comparison: the server's
+    # sliding sketch only covers its short window, so compare against
+    # the client reads that finished inside it.
+    cut = t_end - SHORT_WINDOW * (1.0 - 1.0 / 6.0)
+    recent_reads = [r for r in reads if r[3] >= cut] or reads
+    return {
+        "client": client,
+        "fids": fids,
+        "elapsed": elapsed,
+        "achieved_rps": len(recorded) / max(elapsed - WARMUP, 1e-9),
+        "totals": {"ops": len(recorded), "reads": len(reads),
+                   "writes": len(writes), "errors": errors,
+                   "shed": shed,
+                   "shed_rate": round(shed / max(len(recorded), 1), 6)},
+        "read": {**exact,
+                 "sched": percentiles(sched_lat(reads))},
+        "write": {**percentiles(svc(writes)),
+                  "sched": percentiles(sched_lat(writes))},
+        "recent_read": percentiles(svc(recent_reads)),
+        "sketch_vs_exact": {"rel_err": sketch_err,
+                            "alpha": ALPHA,
+                            "within_alpha": within_alpha},
+    }
+
+
+def server_read_quantiles(cluster: Cluster) -> dict:
+    """Merge both volume servers' live read sketches (the same
+    mergeable wire format /cluster/healthz folds)."""
+    from seaweedfs_tpu.cluster import rpc
+    from seaweedfs_tpu.stats.slo import merge_sketch_dicts
+    dicts, per_node = [], []
+    for url in cluster.volume_urls:
+        snap = rpc.call(f"http://{url}/debug/slo")
+        dicts.append(snap["read"]["sketch"])
+        per_node.append({"node": url,
+                         **snap["read"]["quantiles"]})
+    merged = merge_sketch_dicts(dicts)
+    if merged is None or merged.count == 0:
+        return {"count": 0, "per_node": per_node}
+    return {"count": merged.count,
+            "p50": merged.quantile(0.5),
+            "p95": merged.quantile(0.95),
+            "p99": merged.quantile(0.99),
+            "per_node": per_node}
+
+
+def agreement(client_q: dict, server_q: dict) -> dict:
+    """Client-vs-server tail agreement.  The server sketch measures
+    handler time; the client adds framing + loopback overhead, which
+    the p50 gap measures directly — the tolerance is alpha on both
+    sides plus 3x that constant plus 2ms, all recorded."""
+    overhead = max(0.0, client_q.get("p50", 0.0)
+                   - server_q.get("p50", 0.0))
+    out = {"overhead_p50": round(overhead, 6), "alpha": ALPHA,
+           "per_quantile": {}, "within_bound": True}
+    for key in ("p95", "p99"):
+        c, s = client_q.get(key), server_q.get(key)
+        if not c or not s:
+            out["within_bound"] = False
+            continue
+        tol = ALPHA * (c + s) + 3.0 * overhead + 0.002
+        diff = abs(c - s)
+        out["per_quantile"][key] = {
+            "client": round(c, 6), "server": round(s, 6),
+            "diff": round(diff, 6), "tolerance": round(tol, 6),
+            "ok": diff <= tol}
+        if diff > tol:
+            out["within_bound"] = False
+    return out
+
+
+def fault_phase(cluster: Cluster, client, fids: list[str]) -> dict:
+    """Acceptance: slow fault -> /debug/slow exemplar -> trace resolves
+    -> healthz degraded via burn -> slo.burn emitted."""
+    from seaweedfs_tpu.cluster import rpc
+    vs0 = cluster.volume_urls[0]
+    checks = {"exemplar_recorded": False, "trace_resolved": False,
+              "healthz_degraded": False, "slo_burn_emitted": False}
+
+    # Cool down: both burn windows must forget the healthy main run,
+    # or the fast-read majority would dilute the slow fraction below
+    # the fast-burn threshold.
+    cool = LONG_WINDOW * (1.0 + 1.0 / 6.0) + 1.0
+    log(f"fault phase: cooling {cool:.0f}s so the burn windows forget "
+        f"the healthy run ...")
+    time.sleep(cool)
+
+    # Find fids actually hosted on vs0 so every faulted read hits it.
+    local = []
+    for fid in fids[:50]:
+        vid = int(fid.split(",")[0])
+        try:
+            locs = client.lookup(vid)
+        except Exception:  # noqa: BLE001
+            continue
+        if any(loc.get("url") == vs0 for loc in locs):
+            local.append(fid)
+        if len(local) >= 4:
+            break
+    if not local:
+        log("no fid hosted on vs0 — cannot run fault phase")
+        return checks
+
+    log(f"arming volume.read delay:{FAULT_DELAY}s on {vs0} ...")
+    rpc.call(f"http://{vs0}/debug/faults?point=volume.read"
+             f"&spec=delay:{FAULT_DELAY}", "POST")
+    stop = time.time() + (4.0 if QUICK else 10.0)
+
+    def slow_reader():
+        i = 0
+        while time.time() < stop:
+            try:
+                rpc.call(f"http://{vs0}/{local[i % len(local)]}",
+                         timeout=30.0)
+            except Exception:  # noqa: BLE001
+                pass
+            i += 1
+
+    threads = [threading.Thread(target=slow_reader) for _ in range(6)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    rpc.call(f"http://{vs0}/debug/faults?point=volume.read&spec=off",
+             "POST")
+
+    slow = rpc.call(f"http://{vs0}/debug/slow")
+    exemplars = [e for e in slow.get("exemplars", [])
+                 if e.get("family") == "/needle"
+                 and e.get("seconds", 0) >= FAULT_DELAY]
+    if exemplars:
+        checks["exemplar_recorded"] = True
+        tid = exemplars[0].get("trace_id", "")
+        if tid:
+            try:
+                trace = rpc.call(
+                    f"http://{vs0}/debug/traces?trace={tid}")
+                checks["trace_resolved"] = bool(trace.get("spans"))
+            except Exception:  # noqa: BLE001
+                pass
+
+    # Burn rides the heartbeat (2s pulse) to the master.
+    deadline = time.time() + 25.0
+    while time.time() < deadline:
+        st, doc = rpc.call_status(
+            f"{cluster.master_url}/cluster/healthz", timeout=5.0)
+        if st == 503 and any("SLO fast burn" in p
+                             for p in doc.get("problems", [])):
+            checks["healthz_degraded"] = True
+            break
+        time.sleep(0.5)
+    try:
+        evs = rpc.call(f"http://{vs0}/debug/events?type=slo.burn")
+        checks["slo_burn_emitted"] = bool(evs.get("events"))
+        if evs.get("events"):
+            checks["slo_burn_trace_id"] = \
+                evs["events"][-1].get("trace_id", "")
+    except Exception:  # noqa: BLE001
+        pass
+    return checks
+
+
+def main() -> int:
+    out_path = "BENCH_load_r01.json"
+    args = sys.argv[1:]
+    if "-o" in args:
+        out_path = args[args.index("-o") + 1]
+
+    from seaweedfs_tpu.utils.jaxenv import force_cpu
+    force_cpu(device_count=1)
+    # The client-side measurement needs the same 1ms GIL switch
+    # interval the servers set: with the 5ms default, worker threads
+    # convoy and the measured CLIENT tail is the interpreter's, not
+    # the cluster's.
+    sys.setswitchinterval(0.001)
+
+    tmp = tempfile.mkdtemp(prefix="bench_load_")
+    cluster = Cluster(tmp)
+    t_start = time.time()
+    try:
+        cluster.wait_ready()
+        log("cluster ready:", cluster.master_url, cluster.volume_urls)
+        res = run_load(cluster)
+        server_q = server_read_quantiles(cluster)
+        agree = agreement(res["recent_read"], server_q)
+        checks = fault_phase(cluster, res["client"], res["fids"])
+        doc = {
+            "bench": "load", "round": 1, "quick": QUICK,
+            "config": {"rate": RATE, "duration": DURATION,
+                       "warmup": WARMUP, "keys": KEYS, "size": SIZE,
+                       "workers": WORKERS, "zipf_s": ZIPF_S,
+                       "write_fraction": WRITE_FRACTION,
+                       "slo_read_p99": SLO_READ_P99,
+                       "slo_availability": 0.999,
+                       "short_window": SHORT_WINDOW,
+                       "long_window": LONG_WINDOW,
+                       "sketch_alpha": ALPHA},
+            "achieved_rps": round(res["achieved_rps"], 2),
+            "target_rps": RATE,
+            "totals": res["totals"],
+            "client": {"read": res["read"], "write": res["write"],
+                       "recent_read": res["recent_read"]},
+            "client_sketch_vs_exact": res["sketch_vs_exact"],
+            "server": {"read": server_q},
+            "agreement": {"read": agree},
+            "fault_checks": checks,
+            "elapsed_s": round(time.time() - t_start, 1),
+        }
+        print(json.dumps(doc, indent=1))
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        log(f"wrote {out_path}")
+        ok = (res["sketch_vs_exact"]["within_alpha"]
+              and agree["within_bound"]
+              and all(checks.get(k) for k in
+                      ("exemplar_recorded", "trace_resolved",
+                       "healthz_degraded", "slo_burn_emitted")))
+        return 0 if ok else 1
+    finally:
+        cluster.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

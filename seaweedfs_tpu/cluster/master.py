@@ -69,7 +69,9 @@ class MasterServer:
                  admin_scripts: str = "",
                  admin_script_interval: float = 17 * 60,
                  max_concurrent: int = 0,
-                 idle_timeout: float = 120.0):
+                 idle_timeout: float = 120.0,
+                 slo_read_p99: float | None = None,
+                 slo_availability: float | None = None):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
@@ -148,6 +150,12 @@ class MasterServer:
         s.route("POST", "/admin/lease", self._admin_lease)
         s.route("POST", "/admin/release", self._admin_release)
         reg = s.enable_metrics("master")
+        # SLO plane: declared objectives drive the burn engine behind
+        # /cluster/healthz; /debug/slow + /debug/slo expose exemplars
+        # and live quantiles like on the other roles.
+        from ..stats.slo import setup_slo_routes
+        setup_slo_routes(s)
+        s.slo.set_objectives(slo_read_p99, slo_availability)
         reg.gauge("SeaweedFS_master_volume_count",
                   "registered volume replicas cluster-wide",
                   callback=lambda: float(self.topo.volume_count))
@@ -473,6 +481,11 @@ class MasterServer:
             # draining and reserve-breached nodes.
             dn.draining = bool(hb.get("draining", False))
             dn.low_disk = bool(hb.get("low_disk", False))
+            if "slo" in hb:
+                # Burn verdict + mergeable quantile sketches: the
+                # health rollup degrades on fast burn and folds every
+                # node's sketch into the cluster-wide tail.
+                dn.slo_state = hb["slo"]
             seq = hb.get("seq")
             if seq is not None:
                 # The epoch changes when the volume server restarts, so
@@ -923,10 +936,14 @@ class MasterServer:
                              for sid, dns in loc.locations.items() if dns},
                             loc.codec)
                       for vid, loc in self.topo.ec_shard_map.items()}
+        slo_reads: list[dict] = []
+        slo_writes: list[dict] = []
+        burning_nodes: list[str] = []
         for dn in leaves:
             age = now - dn.last_seen
             alive = age <= fresh
             breaker = _res._breakers.get(dn.url())
+            slo_state = getattr(dn, "slo_state", None) or {}
             row = {"node": dn.url(), "heartbeat_age": round(age, 3),
                    "alive": alive,
                    "breaker": breaker.state if breaker else "closed",
@@ -934,8 +951,27 @@ class MasterServer:
                    "ec_shards": len(dn.ec_shards),
                    "draining": getattr(dn, "draining", False),
                    "low_disk": getattr(dn, "low_disk", False),
-                   "disks": getattr(dn, "disk_statuses", [])}
+                   "disks": getattr(dn, "disk_statuses", []),
+                   "slo": {k: slo_state.get(k, False)
+                           for k in ("declared", "fast_burn",
+                                     "slow_burn")}}
             nodes.append(row)
+            # Heartbeat-fed SLO state: fast burn degrades the cluster
+            # (the node is violating a declared objective NOW); its
+            # read/write sketches fold into the cluster-wide tail.
+            # Gated on liveness — a dead node's FINAL verdict and
+            # window must not haunt the "live" rollup forever (its
+            # staleness is already its own problem row above).
+            if alive and slo_state.get("fast_burn"):
+                burning_nodes.append(dn.url())
+                problems.append(
+                    f"node {dn.url()}: SLO fast burn — a declared "
+                    f"objective's error budget is burning at page "
+                    f"rate (see /debug/slo on the node)")
+            if alive and isinstance(slo_state.get("read"), dict):
+                slo_reads.append(slo_state["read"])
+            if alive and isinstance(slo_state.get("write"), dict):
+                slo_writes.append(slo_state["write"])
             if not alive:
                 problems.append(
                     f"node {dn.url()}: heartbeat stale {age:.1f}s")
@@ -999,10 +1035,37 @@ class MasterServer:
                 problems.append(
                     f"ec volume {vid}: degraded — missing shards "
                     f"{missing}")
+        # Cluster-wide SLO rollup: the master's own tracker plus every
+        # node's heartbeat sketches, merged (exact bucket addition,
+        # stats/sketch.py) into one read tail and one write tail — the
+        # number a load balancer or the bench harness cross-checks.
+        from ..stats import slo as _slo
+        own = self.server.slo
+        own_view = own.heartbeat_view()
+        if own_view.get("fast_burn"):
+            burning_nodes.append(f"master {self.url()}")
+            problems.append(
+                f"master {self.url()}: SLO fast burn — a declared "
+                f"objective's error budget is burning at page rate")
+        slo_reads.append(own_view["read"])
+        slo_writes.append(own_view["write"])
+
+        def _qs(dicts: list[dict]) -> dict:
+            merged = _slo.merge_sketch_dicts(dicts)
+            if merged is None or merged.count == 0:
+                return {"count": 0}
+            return {"count": merged.count,
+                    "p50": merged.quantile(0.5),
+                    "p95": merged.quantile(0.95),
+                    "p99": merged.quantile(0.99)}
+
+        slo_doc = {"read": _qs(slo_reads), "write": _qs(slo_writes),
+                   "sources": len(slo_reads),
+                   "fast_burn": burning_nodes}
         doc = {"healthy": not problems, "problems": problems,
                "leader": self.leader_url(), "is_leader": self.is_leader(),
                "nodes": nodes, "volumes": volumes,
-               "ec_volumes": ec_volumes}
+               "ec_volumes": ec_volumes, "slo": slo_doc}
         return not problems, doc
 
     def _healthz(self, query: dict, body: bytes):

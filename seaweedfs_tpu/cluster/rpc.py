@@ -66,6 +66,30 @@ import re as _re
 
 _RANGE_RE = _re.compile(r"^bytes=([0-9]*)-([0-9]*)$")
 
+# A needle fid path: `/3,0172cb7d…` (optionally `/vid,fid/name.ext`).
+_FID_PATH_RE = _re.compile(r"^/\d+,")
+
+
+def endpoint_family(path: str, literal: bool) -> str:
+    """Bounded-cardinality endpoint label for the request histogram and
+    the SLO plane.  Literal routes (the static route table — which is
+    how every real /admin/* endpoint is mounted, so the admin surface
+    keeps its literal paths) keep their path; the per-needle data plane
+    (`/3,0172…`) collapses to `/needle`; everything else (filer user
+    paths, S3 objects, probes of unmounted paths — unbounded,
+    client-chosen namespaces) collapses to `/other`.  The label set is
+    therefore bounded by the route table + 3.  There is deliberately
+    NO startswith("/admin/") carve-out: on a gateway whose / namespace
+    is user-controlled, a client could mint unlimited /admin/<x> paths
+    and grow the label set (and the SLO sketch table) without bound."""
+    if literal:
+        return path
+    if _FID_PATH_RE.match(path):
+        return "/needle"
+    if path.startswith("/debug/"):
+        return "/debug/*"
+    return "/other"
+
 
 def parse_byte_range(rng: str, size: int) -> tuple[int, int] | None:
     """Single-range 'bytes=' header -> (lo, hi) inclusive; None means
@@ -542,6 +566,7 @@ class JsonHttpServer:
         self.routes: dict[tuple[str, str], Callable] = {}
         self.prefix_routes: list[tuple[str, str, Callable]] = []
         self.metrics = None  # (Registry, Counter, Histogram) when on
+        self.slo = None      # stats.slo.SloTracker once metrics are on
         # Service name for the tracing middleware; set by
         # trace.setup_server_tracing — None means no server spans.
         self.trace_service: str | None = None
@@ -562,16 +587,47 @@ class JsonHttpServer:
         vectors) and, unless serve_route=False (gateways whose URL
         namespace is user-controlled serve /metrics on a separate
         port, like the reference's metricsHttpPort), expose /metrics.
-        Returns the Registry for the caller to add its own gauges."""
+        Returns the Registry for the caller to add its own gauges.
+
+        Idempotent: a second call returns the existing registry instead
+        of stacking a second counter/histogram family (a duplicate
+        exposition block fails the promtool validator — the
+        rolling-restart / re-init regression in tests/test_slo.py)."""
+        from ..stats import slo as _slo
         from ..stats.metrics import Registry
+        if self.metrics is not None:
+            return self.metrics[0]
         reg = registry or Registry()
         counter = reg.counter(
             f"SeaweedFS_{subsystem}_request_total",
             f"{subsystem} request count", ("type",))
+        # The latency histogram separates error tails from success
+        # tails: status-class (2xx/4xx/5xx) and a bounded
+        # endpoint-family label (endpoint_family) beside the method.
         hist = reg.histogram(
             f"SeaweedFS_{subsystem}_request_seconds",
-            f"{subsystem} request latency", ("type",))
+            f"{subsystem} request latency",
+            ("type", "family", "status"))
         self.metrics = (reg, counter, hist)
+        # SLO plane (stats/slo.py): live windowed quantiles + exemplars
+        # for every role, burn rates once objectives are declared
+        # (set_objectives).  The gauges are PER-TRACKER, registered
+        # into this (fresh) registry — process-global singletons below
+        # use register_once so re-registration can never duplicate an
+        # exposition family.
+        self.slo = _slo.SloTracker(subsystem,
+                                   node=f"{self.host}:{self.port}")
+        reg.gauge("SeaweedFS_request_quantile_seconds",
+                  "live request-latency quantiles over the sliding "
+                  "window (sketch relative error documented in "
+                  "stats/sketch.py)",
+                  ("role", "family", "status", "q"),
+                  callback=self.slo.quantile_gauge_values)
+        reg.gauge("SeaweedFS_slo_burn_rate",
+                  "error-budget burn rate per declared SLO and window "
+                  "(fast burn >= 14.4 degrades /cluster/healthz)",
+                  ("role", "slo", "window"),
+                  callback=self.slo.burn_gauge_values)
         # RPC-plane resilience instruments are process-global singletons
         # (every role's outbound client shares the pool + breakers);
         # registering them here puts retry counts, breaker states, and
@@ -632,16 +688,17 @@ class JsonHttpServer:
     def _accept_loop(self) -> None:
         while self._running:
             try:
-                conn, _addr = self._sock.accept()
+                conn, addr = self._sock.accept()
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._serve_conn, args=(conn,),
+            threading.Thread(target=self._serve_conn,
+                             args=(conn, addr[0] if addr else ""),
                              daemon=True).start()
 
     # -- connection loop -----------------------------------------------------
 
-    def _serve_conn(self, conn: socket.socket) -> None:
+    def _serve_conn(self, conn: socket.socket, peer_ip: str = "") -> None:
         try:
             if self.ssl_context is not None:
                 # Handshake in the connection thread so a slow/bogus
@@ -665,7 +722,7 @@ class JsonHttpServer:
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
             rf = conn.makefile("rb", buffering=1 << 16)
             while self._running:
-                if not self._serve_one(conn, rf):
+                if not self._serve_one(conn, rf, peer_ip):
                     return
         except Exception:  # noqa: BLE001 — peer reset / TLS failure / ...
             pass
@@ -675,7 +732,7 @@ class JsonHttpServer:
             except OSError:
                 pass
 
-    def _serve_one(self, conn, rf) -> bool:
+    def _serve_one(self, conn, rf, peer_ip: str = "") -> bool:
         """Handle one request; returns False when the connection is done."""
         line = rf.readline(65537)
         if not line:
@@ -735,6 +792,11 @@ class JsonHttpServer:
         # Select request headers handlers care about (Range for partial
         # reads, Content-Type for upload mime) ride along in the query
         # dict under reserved keys.
+        if peer_ip:
+            # Peer address for the heavy-hitter tracker (hot client
+            # IPs, stats/hotkeys.py) — reserved key, unforgeable like
+            # the header-derived ones.
+            query["_remote_addr"] = peer_ip
         if "range" in headers:
             query["_range_header"] = headers["range"]
         if "if-none-match" in headers:
@@ -798,9 +860,18 @@ class JsonHttpServer:
         lane = None
         if not _admission_exempt(req_path):
             lane = self.admission.lane_for(method, headers, query)
+            t_gate = time.perf_counter()
             if not lane.enter():
                 if not self._finish_stream_body(body):
                     keep = False
+                # Sheds are part of the error tail: count them in the
+                # request histogram (status-class 4xx, with the REAL
+                # time spent waiting in the bounded queue) and the SLO
+                # burn windows' dedicated `shed` column — the tracker
+                # keeps them out of the latency sketches, where a
+                # refused request would fake a fast one.
+                self._observe_request(method, req_path, 429,
+                                      time.perf_counter() - t_gate)
                 self._respond(
                     conn, method, 429,
                     {"error": f"overloaded: {lane.name} lane and its "
@@ -816,14 +887,33 @@ class JsonHttpServer:
             if lane is not None:
                 lane.exit()
 
+    def _observe_request(self, method: str, req_path: str, status: int,
+                         seconds: float, trace_id: str = "") -> None:
+        """One request observed: request counter + the labeled latency
+        histogram (method / endpoint-family / status-class) + the SLO
+        plane (windowed quantiles, burn windows, slow exemplars).
+        Excludes the scrape endpoint where /metrics IS the scrape."""
+        if self._metrics_route and req_path == "/metrics":
+            return
+        metrics = self.metrics
+        if metrics is None:
+            return
+        family = endpoint_family(req_path,
+                                 (method, req_path) in self.routes)
+        _reg, counter, hist = metrics
+        counter.inc(type=method)
+        hist.observe(seconds, type=method, family=family,
+                     status=f"{status // 100}xx")
+        if self.slo is not None:
+            self.slo.observe(family, method, status, seconds, trace_id)
+
     def _dispatch(self, conn, method: str, req_path: str,
                   headers: dict, query: dict, body, fn, args,
                   keep: bool) -> bool:
         """Run the routed handler and write its response — the back
         half of _serve_one, split out so the admission gate can wrap
         it in one try/finally slot release."""
-        metrics = self.metrics
-        t0 = time.perf_counter() if metrics else 0.0
+        t0 = time.perf_counter()
         # Tracing middleware: one server span per routed request,
         # continuing the caller's traceparent context (or head-sampling
         # a fresh root).  Scrape/debug endpoints are not traced — a
@@ -843,6 +933,16 @@ class JsonHttpServer:
             tspan = _tracer.begin_server_span(
                 self.trace_service, method, req_path,
                 headers.get("traceparent", ""))
+
+        def _observe(status: int) -> None:
+            # Status is known at every exit (unlike the pre-SLO finally
+            # block, which observed before the handler's tuple was
+            # parsed) — that is what makes the status-class label and
+            # the exemplar's trace id possible.
+            self._observe_request(
+                method, req_path, status, time.perf_counter() - t0,
+                tspan.trace_id if tspan is not None else "")
+
         try:
             result = fn(*args)
         except _fault.DropConnection:
@@ -850,9 +950,11 @@ class JsonHttpServer:
             # response bytes, just a dead connection — the client sees
             # EOF exactly as if the process was killed.
             _tracer.end_server_span(tspan, 500)
+            _observe(500)
             return False
         except RpcError as e:
             _tracer.end_server_span(tspan, e.status)
+            _observe(e.status)
             if not self._finish_stream_body(body):
                 keep = False
             self._respond(conn, method, e.status, {"error": e.message},
@@ -860,6 +962,7 @@ class JsonHttpServer:
             return keep
         except ConnectionError as e:
             _tracer.end_server_span(tspan, 500)
+            _observe(500)
             if isinstance(body, BodyReader) and body.truncated:
                 # Truncated streaming body: the wire framing is gone,
                 # no reliable response is possible.
@@ -875,20 +978,13 @@ class JsonHttpServer:
             return keep
         except Exception as e:  # noqa: BLE001
             _tracer.end_server_span(tspan, 500)
+            _observe(500)
             if not self._finish_stream_body(body):
                 keep = False
             self._respond(conn, method, 500,
                           {"error": f"{type(e).__name__}: {e}"},
                           None, close=not keep)
             return keep
-        finally:
-            # Exclude /metrics only where it IS the scrape endpoint; on
-            # gateways it's a user path to count.
-            if metrics and not (self._metrics_route
-                                and req_path == "/metrics"):
-                _reg, counter, hist = metrics
-                counter.inc(type=method)
-                hist.observe(time.perf_counter() - t0, type=method)
 
         if not self._finish_stream_body(body):
             keep = False
@@ -901,8 +997,10 @@ class JsonHttpServer:
         else:
             status, payload = 200, result
         # Span end covers handler execution, not the response write (a
-        # slow reader streaming a 30GB body is not server time).
+        # slow reader streaming a 30GB body is not server time) — and
+        # the histogram/SLO observation matches that boundary.
         _tracer.end_server_span(tspan, status)
+        _observe(status)
         self._respond(conn, method, status, payload, extra,
                       close=not keep)
         return keep

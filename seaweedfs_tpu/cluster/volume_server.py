@@ -64,7 +64,9 @@ class VolumeServer:
                  shutdown_grace: float = 30.0,
                  disk_reserve_mb: float = 0.0,
                  idle_timeout: float = 120.0,
-                 ec_codec: str = "rs"):
+                 ec_codec: str = "rs",
+                 slo_read_p99: float | None = None,
+                 slo_availability: float | None = None):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -177,6 +179,19 @@ class VolumeServer:
         s.route("POST", "/admin/tier_upload", self._tier_upload)
         s.route("POST", "/admin/tier_download", self._tier_download)
         self._setup_metrics()
+        # SLO plane: /debug/slow exemplars + /debug/slo state, declared
+        # objectives (-slo.read.p99 / -slo.availability) feeding the
+        # burn engine; heartbeats carry heartbeat_view() so the master
+        # folds this node into /cluster/healthz.
+        from ..stats.slo import setup_slo_routes
+        setup_slo_routes(s)
+        self.server.slo.set_objectives(slo_read_p99, slo_availability)
+        # Heavy hitters (stats/hotkeys.py): hot volumes / needles /
+        # client IPs on the read+write data paths, for /debug/hot and
+        # the shell's cluster.hot — the cache/packing target list.
+        from ..stats.hotkeys import HotKeyTracker
+        self.hot = HotKeyTracker()
+        s.route("GET", "/debug/hot", self._debug_hot)
         s.route("GET", "/admin/volume_file", self._volume_file)
         s.route("POST", "/admin/copy_volume", self._copy_volume)
         s.route("POST", "/admin/mount", self._admin_mount)
@@ -288,8 +303,12 @@ class VolumeServer:
         # them here puts kernel/staging/fan-out time on this server's
         # /metrics scrape.
         from ..stats.metrics import ec_stage_bytes, ec_stage_seconds
-        reg.register(ec_stage_seconds)
-        reg.register(ec_stage_bytes)
+        # register_once, not register: process-global singletons must
+        # never land twice in one registry (an in-process re-init would
+        # emit a duplicate exposition family and fail promcheck — the
+        # regression in tests/test_slo.py).
+        reg.register_once(ec_stage_seconds)
+        reg.register_once(ec_stage_bytes)
         # Scrub + self-healing instruments (process-global singletons,
         # storage/scrub.py) on this server's scrape.
         from ..stats.metrics import (scrub_bytes_total,
@@ -367,6 +386,11 @@ class VolumeServer:
                 # reports them without a per-node scrape.
                 "draining": self.draining,
                 "low_disk": bool(self.store.low_disk_dirs),
+                # SLO state (stats/slo.py): burn verdict + mergeable
+                # aggregate read/write quantile sketches — the master
+                # folds every node into one cluster-wide tail on
+                # /cluster/healthz and degrades on fast burn.
+                "slo": self.server.slo.heartbeat_view(),
             }
             if full:
                 hb["volumes"] = [
@@ -543,6 +567,7 @@ class VolumeServer:
 
     def _get_needle(self, path: str, query: dict, body: bytes):
         vid, key, cookie = self._parse_fid_path(path)
+        self.hot.read(vid, key, query.get("_remote_addr", ""))
         if _fault.ARMED:
             _fault.hit("volume.read", vid=vid, server=self.url())
         v = self.store.find_volume(vid)
@@ -1140,6 +1165,21 @@ class VolumeServer:
                 {"Content-Type": "application/octet-stream",
                  "X-Volume-Version": str(v.version)})
 
+    def _debug_hot(self, query: dict, body: bytes) -> dict:
+        """GET /debug/hot — heavy-hitter snapshot: top-k hot volumes,
+        needles, and client IPs by read/write (stats/hotkeys.py).
+        ?k=N sizes the lists; ?reset=1 clears the counters (a new
+        observation window starts)."""
+        try:
+            k = int(query.get("k", 16) or 16)
+        except ValueError:
+            raise rpc.RpcError(400, "k must be a number") from None
+        if query.get("reset") == "1":
+            self.hot.clear()
+        out = self.hot.snapshot(k=k)
+        out["node"] = self.url()
+        return out
+
     def _ui(self, query: dict, body: bytes):
         """Status page (the reference's volume UI, server/volume_ui)."""
         from html import escape as esc
@@ -1208,6 +1248,7 @@ class VolumeServer:
         self._check_write_jwt(path, query)
         self._refuse_if_draining(query)
         vid, key, cookie = self._parse_fid_path(path)
+        self.hot.write(vid, key, query.get("_remote_addr", ""))
         if _fault.ARMED:
             _fault.hit("volume.write", vid=vid, server=self.url())
         v = self.store.find_volume(vid)
@@ -1279,6 +1320,7 @@ class VolumeServer:
         self._check_write_jwt(path, query)
         self._refuse_if_draining(query)
         vid, key, _cookie = self._parse_fid_path(path)
+        self.hot.write(vid, key, query.get("_remote_addr", ""))
         v = self.store.find_volume(vid)
         if v is None:
             raise rpc.RpcError(404, f"volume {vid} not on this server")

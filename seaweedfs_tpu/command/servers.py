@@ -45,6 +45,16 @@ def _security(component: str):
     return ctx
 
 
+def _slo_flags(flags: Flags) -> dict:
+    """-slo.read.p99 (seconds) / -slo.availability (0.999 or 99.9):
+    declared objectives for the role's SLO burn engine (stats/slo.py).
+    0/absent = undeclared — quantiles and /debug/slow exemplars still
+    run, but nothing can burn."""
+    return {"slo_read_p99": flags.get_float("slo.read.p99", 0.0) or None,
+            "slo_availability":
+                flags.get_float("slo.availability", 0.0) or None}
+
+
 def _wait_forever(servers: list, grace: float | None = None) -> int:
     stop = threading.Event()
 
@@ -154,7 +164,8 @@ def run_master(flags: Flags, args: list[str]) -> int:
         admin_script_interval=60 * mcfg.get_int(
             "master.maintenance.sleep_minutes", 17),
         max_concurrent=flags.get_int("max.concurrent", 0),
-        idle_timeout=flags.get_float("idle.timeout", 120.0))
+        idle_timeout=flags.get_float("idle.timeout", 120.0),
+        **_slo_flags(flags))
     m.start()
     glog.infof("master serving at %s", m.server.url())
     g = _start_master_grpc(m, flags, flags.get("ip", "127.0.0.1"))
@@ -200,7 +211,10 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         # -ec.codec: default erasure codec for /admin/ec/generate —
         # "rs" (reference-compatible RS(10,4)) or "lrc" (LRC(10,2,2),
         # 5-read single-shard repair).
-        ec_codec=flags.get("ec.codec", "rs"))
+        ec_codec=flags.get("ec.codec", "rs"),
+        # -slo.read.p99 / -slo.availability: declared objectives for
+        # the burn engine; exemplars + quantiles run regardless.
+        **_slo_flags(flags))
     vs.start()
     glog.infof("volume server serving at %s (dirs %s)",
                vs.server.url(), dirs)
@@ -237,7 +251,8 @@ def run_filer(flags: Flags, args: list[str]) -> int:
         replication=flags.get("defaultReplicaPlacement") or None,
         metrics_port=flags.get_int("metricsPort", 0) or None,
         ssl_context=_security("filer"),
-        cipher=flags.get_bool("encryptVolumeData", False))
+        cipher=flags.get_bool("encryptVolumeData", False),
+        **_slo_flags(flags))
     fs.start()
     glog.infof("filer serving at %s", fs.server.url())
     g = _start_filer_grpc(fs, flags, flags.get("ip", "127.0.0.1"))
@@ -295,7 +310,11 @@ def run_server(flags: Flags, args: list[str]) -> int:
                volume_size_limit_mb=flags.get_int(
                    "volumeSizeLimitMB", 30 * 1024),
                default_replication=flags.get("defaultReplication", "000"),
-               ssl_context=_security("master"))
+               ssl_context=_security("master"),
+               # -slo.* applies to EVERY embedded role, same as the
+               # standalone commands — half-declared objectives would
+               # silently disable master-side burn.
+               **_slo_flags(flags))
     m.start()
     servers.append(m)
     dirs = [d for d in flags.get("dir", "./data").split(",") if d]
@@ -318,7 +337,8 @@ def run_server(flags: Flags, args: list[str]) -> int:
                                                      30.0),
                       disk_reserve_mb=flags.get_float("disk.reserve",
                                                       0.0),
-                      ec_codec=flags.get("ec.codec", "rs"))
+                      ec_codec=flags.get("ec.codec", "rs"),
+                      **_slo_flags(flags))
     vs.start()
     servers.append(vs)
     glog.infof("master at %s, volume at %s", m.server.url(),
@@ -372,7 +392,8 @@ register(Command("volume",
                  "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
                  " [-fsync] [-scrub.mbps=32] [-scrub.interval=3600]"
                  " [-max.concurrent=0] [-disk.reserve=0(MB)]"
-                 " [-shutdown.grace=30] [-ec.codec=rs|lrc]",
+                 " [-shutdown.grace=30] [-ec.codec=rs|lrc]"
+                 " [-slo.read.p99=0.05] [-slo.availability=99.9]",
                  "start a volume server", run_volume))
 register(Command("filer", "filer -port=8888 -master=host:9333",
                  "start a filer server", run_filer))

@@ -100,6 +100,10 @@ TYPES: dict[str, str] = {
     "server.shed": "admission control shed requests (429) under "
                    "overload — one record per shedding episode with "
                    "the cumulative count",
+    "slo.burn": "a declared SLO (-slo.read.p99 / -slo.availability) "
+                "is burning its error budget at the fast-burn rate "
+                "over both the 5m and 1h windows; /cluster/healthz "
+                "reports the role degraded until the burn subsides",
 }
 
 SEVERITIES = ("info", "warn", "error")

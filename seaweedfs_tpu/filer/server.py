@@ -106,7 +106,9 @@ class FilerServer:
                  chunk_size: int = 4 * 1024 * 1024,
                  collection: str = "", replication: str | None = None,
                  metrics_port: int | None = None,
-                 ssl_context=None, cipher: bool = False):
+                 ssl_context=None, cipher: bool = False,
+                 slo_read_p99: float | None = None,
+                 slo_availability: float | None = None):
         # Accepts an HA seed list; all master traffic (including the
         # /dir/* proxies mounts rely on) fails over via WeedClient.
         self.client = WeedClient(master_url)
@@ -160,6 +162,13 @@ class FilerServer:
         # port like the other gateways (the reference's -metricsPort).
         self.metrics_registry = s.enable_metrics(
             "filer", serve_route=False)
+        # SLO plane: exemplars + live quantiles on /debug/slow and
+        # /debug/slo (literal routes win over the user-path prefix
+        # routes, same as the other /debug surfaces above); declared
+        # objectives drive the filer's own burn engine.
+        from ..stats.slo import setup_slo_routes
+        setup_slo_routes(s)
+        s.slo.set_objectives(slo_read_p99, slo_availability)
         self.metrics_server = None
         if metrics_port is not None:
             self.metrics_server = rpc.JsonHttpServer(host, metrics_port)

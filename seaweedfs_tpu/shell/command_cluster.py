@@ -114,6 +114,103 @@ class ClusterDrain(Command):
 
 
 @register
+class ClusterHot(Command):
+    name = "cluster.hot"
+    help = ("cluster.hot [-k N] [-dimension volume|needle|client] "
+            "[-node host:port] — heavy hitters from every volume "
+            "server's /debug/hot (space-saving top-k): the hot "
+            "volumes, needles, and client IPs that decide where a "
+            "cache or small-file pack pays off.  The true cluster "
+            "count of a KEY lies within [count-err, count+err]")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, _rest = self.parse_flags(args)
+        k = int(flags.get("k", "10"))
+        want_dim = flags.get("dimension", "")
+        if flags.get("node"):
+            nodes = [flags["node"]]
+        else:
+            try:
+                nodes = [n["url"] for n in env.data_nodes()]
+            except Exception as e:  # noqa: BLE001
+                raise ShellError(f"cannot list volume servers: {e}") \
+                    from None
+        # Pull each node's FULL table, then merge per (dimension, op).
+        # A key a full node evicted may hold up to that node's minimum
+        # counter there — fold that into the key's error (as under-
+        # count slack) instead of pretending the sum is still a pure
+        # upper bound; a non-full table means absence = exactly zero.
+        node_tables: list[dict] = []
+        reached = 0
+        for node in nodes:
+            base = node if "://" in node else f"http://{node}"
+            try:
+                out = rpc.call(f"{base}/debug/hot?k=1000000",
+                               timeout=5.0)
+            except Exception:  # noqa: BLE001 — node gone
+                continue
+            if isinstance(out, dict):
+                reached += 1
+                node_tables.append(out)
+        if not reached:
+            raise ShellError("no /debug/hot endpoint reachable")
+        # First pass: per (dimension, op), each node's table + the
+        # slack a full table implies for keys it evicted.
+        per_dim: dict[tuple[str, str], list[tuple[dict, int]]] = {}
+        totals: dict[tuple[str, str], int] = {}
+        for out in node_tables:
+            capacity = out.get("capacity", 0)
+            for dim, ops in out.get("dimensions", {}).items():
+                for op, data in ops.items():
+                    dkey = (dim, op)
+                    totals[dkey] = totals.get(dkey, 0) \
+                        + data.get("total", 0)
+                    rows = data.get("top", [])
+                    table = {str(r["key"]): r for r in rows}
+                    full = capacity and len(rows) >= capacity
+                    node_min = min((r["count"] for r in rows),
+                                   default=0) if full else 0
+                    per_dim.setdefault(dkey, []).append(
+                        (table, node_min))
+        # Second pass: union of keys; a node that tracks the key
+        # contributes its count+error, a full node that evicted it
+        # contributes up to its minimum counter as error slack.
+        merged: dict[tuple[str, str], dict] = {}
+        for dkey, tables in per_dim.items():
+            bucket = merged.setdefault(dkey, {})
+            union: set[str] = set()
+            for table, _ in tables:
+                union.update(table)
+            for key in union:
+                count = err = 0
+                for table, node_min in tables:
+                    r = table.get(key)
+                    if r is not None:
+                        count += r["count"]
+                        err += r["error"]
+                    else:
+                        err += node_min
+                bucket[key] = [count, err]
+        lines = []
+        for (dim, op) in sorted(merged):
+            if want_dim and dim != want_dim:
+                continue
+            total = totals.get((dim, op), 0)
+            if not total:
+                continue
+            lines.append(f"{dim} ({op}, {total} ops):")
+            lines.append(f"  {'KEY':24} {'COUNT':>9} {'ERR':>7}  SHARE")
+            rows = sorted(merged[(dim, op)].items(),
+                          key=lambda kv: kv[1][0], reverse=True)[:k]
+            for key, (count, err) in rows:
+                share = 100.0 * count / total if total else 0.0
+                lines.append(f"  {key:24} {count:9d} {err:7d}  "
+                             f"{share:5.1f}%")
+        return "\n".join(lines) if lines else \
+            "no traffic recorded yet"
+
+
+@register
 class ClusterCheck(Command):
     name = "cluster.check"
     help = ("cluster.check — health rollup from the master's "

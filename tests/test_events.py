@@ -572,6 +572,24 @@ def _drive_server_shed(cl):
         server.stop()
 
 
+def _drive_slo_burn(cl):
+    """Fast burn through the real engine (stats/slo.py): a tracker
+    with a declared availability objective watches 100% of its
+    data-plane requests fail — burn rate 1000x the budget over both
+    windows — and emits slo.burn exactly once per episode."""
+    from seaweedfs_tpu.stats.slo import SloTracker
+    tr = SloTracker("driver", node="slo.test:1", clock=lambda: 1000.0)
+    tr.set_objectives(availability=0.999)
+    for _ in range(20):
+        tr.observe("/needle", "GET", 500, 0.001)
+    state = tr.burn_state()
+    assert state["fast_burn"], state
+    # Same episode: no second event (the flip emits, the state doesn't).
+    before = events.events_total.value(type="slo.burn")
+    tr.burn_state()
+    assert events.events_total.value(type="slo.burn") == before
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -603,6 +621,7 @@ DRIVERS = {
     "disk.low": _drive_disk_low,
     "disk.full": _drive_disk_full,
     "server.shed": _drive_server_shed,
+    "slo.burn": _drive_slo_burn,
 }
 
 
@@ -613,8 +632,9 @@ def test_driver_catalog_matches_registry():
     # Deliberate churn: growing the catalog must touch this number so
     # the diff shows the new types were consciously added (18 from the
     # journal's introduction + 6 data-integrity types + 5 overload/
-    # lifecycle types + 1 codec type: ec.repair.local).
-    assert len(TYPES) == 30
+    # lifecycle types + 1 codec type: ec.repair.local + 1 SLO type:
+    # slo.burn).
+    assert len(TYPES) == 31
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))
