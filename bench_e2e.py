@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import sys
 import tempfile
@@ -230,6 +231,267 @@ def bench_weed_benchmark(n: int, size: int = 1024, concurrency: int = 16,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_cluster_encode(vol_mb: int | None = None,
+                         n_vols: int | None = None,
+                         out_path: str = "BENCH_e2e_r01.json") -> dict:
+    """Wire-to-wire cluster encode MB/s — volume bytes in to mounted
+    shards out (ROADMAP 1's missing BENCH metric), streamed pipeline
+    (depth=2) vs the serialized baseline (depth=0) in the SAME run.
+
+    Two identical volume sets are generated straight into a volume
+    server's directory; each set is batch-encoded through the real
+    cluster path (freeze + fetch over HTTP -> stacked mesh encode ->
+    shard scatter + mount + replica delete), one set per pipeline
+    depth.  Per-stage wall/bytes come from the `ec.encode.finish`
+    journal events the batch emits.
+
+    Beside the measured ratio the JSON records a stage-replay
+    projection: the serialized run's own stage times scheduled with
+    prefetch/device/drain overlapped (makespan = fetch + max(stack,
+    device, write) + scatter + residual).  On a host where the stages
+    occupy distinct resources (TPU + multicore: DMA, MXU, disk) the
+    measured ratio approaches the projection; on a 1-core CPU-only
+    host the stages time-share one resource, so the measured ratio
+    stays ~1x no matter how well the pipeline overlaps — both numbers
+    are published, clearly labeled, with the host shape recorded.
+    """
+    import numpy as np  # noqa: F401 — generate_volume needs the env
+    import jax
+
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.events import JOURNAL
+    from seaweedfs_tpu.parallel.cluster_encode import batch_encode
+    from seaweedfs_tpu.shell import CommandEnv
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    if vol_mb is None:
+        vol_mb = int(os.environ.get(
+            "BENCH_E2E_WIRE_MB", "256" if on_tpu else "16"))
+    if n_vols is None:
+        n_vols = int(os.environ.get("BENCH_E2E_WIRE_VOLS", "2"))
+    # Fused device CRCs pay off on the TPU (the sidecar rides the
+    # kernel); on the CPU backend the same einsum costs more than the
+    # native crc32c pass it replaces, so keep BOTH measured configs on
+    # the platform-appropriate setting — the comparison isolates the
+    # pipeline, not the CRC fusion.
+    fused = "1" if on_tpu else "0"
+    prev_fused = os.environ.get("SEAWEEDFS_TPU_EC_FUSED_CRC")
+    os.environ["SEAWEEDFS_TPU_EC_FUSED_CRC"] = fused
+
+    tmp = tempfile.mkdtemp(prefix="bench_e2e_wire_")
+    master = None
+    servers = []
+    try:
+        dirs = [os.path.join(tmp, f"vs{i}") for i in range(3)]
+        for d in dirs:
+            os.makedirs(d)
+        # One fresh volume set per (config, repetition): an encode
+        # consumes its volumes (originals deleted), so reps can't reuse
+        # them.  Best-of-reps wall per config filters scheduler noise —
+        # on a busy host a single run can swing the ratio +-30%.
+        reps = max(1, int(os.environ.get("BENCH_E2E_WIRE_REPS", "2")))
+        nxt = 1
+        vol_sets: dict[tuple[str, int], list[int]] = {}
+        for cfg in ("serial", "stream"):
+            for r in range(reps):
+                vol_sets[(cfg, r)] = list(range(nxt, nxt + n_vols))
+                nxt += n_vols
+        vids_serial = vol_sets[("serial", 0)]
+        # Same-SHAPE warmup set: the first encode in the process pays
+        # the XLA compile for each distinct stacked chunk shape —
+        # charged to NEITHER timed config, or the serialized-first run
+        # would eat it all and inflate measured_ratio (the acceptance
+        # number).  Must be n_vols volumes, not one: the stacked vol
+        # dimension is part of the jit shape.
+        vids_warm = list(range(nxt, nxt + n_vols))
+        all_vids = [v for vs in vol_sets.values() for v in vs] + vids_warm
+        for vid in all_vids:
+            generate_volume(dirs[0], vid, vol_mb)
+        in_bytes = sum(
+            os.path.getsize(os.path.join(dirs[0], f"{vid}.dat"))
+            for vid in vids_serial)
+
+        master = MasterServer(volume_size_limit_mb=vol_mb,
+                              meta_dir=tmp, pulse_seconds=60)
+        master.start()
+        for d in dirs:
+            vs = VolumeServer(master.url(), [d], pulse_seconds=60)
+            vs.start()
+            servers.append(vs)
+        env = CommandEnv(master.url())
+        for vid in all_vids:
+            assert env.volume_locations(vid), f"volume {vid} not seen"
+
+        def one(vids, depth):
+            JOURNAL.clear()
+            t0 = time.perf_counter()
+            batch_encode(env, vids, depth=depth)
+            wall = time.perf_counter() - t0
+            for vs in servers:
+                vs._ec_loc_cache.clear()
+                vs._send_heartbeat(full=True)
+            for vid in vids:
+                locs = env.ec_shard_locations(vid)
+                assert sorted(locs) == list(range(14)), \
+                    f"volume {vid}: shards not all mounted"
+            stages: dict[str, list[float]] = {}
+            for ev in JOURNAL.snapshot(type_="ec.encode.finish"):
+                for k, v in ev["attrs"].items():
+                    m = re.match(r"^(batch_\w+)_(seconds|bytes)$", k)
+                    if m:
+                        acc = stages.setdefault(m.group(1), [0.0, 0])
+                        acc[0 if m.group(2) == "seconds" else 1] += v
+            return wall, stages
+
+        log(f"wire-to-wire: {n_vols} x {vol_mb}MB volumes per config, "
+            f"platform={platform}, fused_crc={fused}")
+        one(vids_warm, depth=0)  # untimed: absorb XLA compile
+        w_serial, st_serial = min(
+            (one(vol_sets[("serial", r)], depth=0) for r in range(reps)),
+            key=lambda t: t[0])
+        w_stream, st_stream = min(
+            (one(vol_sets[("stream", r)], depth=2) for r in range(reps)),
+            key=lambda t: t[0])
+
+        def sec(st, k):
+            return round(st.get(k, [0.0, 0])[0], 3)
+
+        f, s = sec(st_serial, "batch_fetch"), sec(st_serial, "batch_stack")
+        d, w = sec(st_serial, "batch_encode_device"), \
+            sec(st_serial, "batch_write")
+        sc = sec(st_serial, "batch_scatter")
+        residual = max(0.0, w_serial - (f + s + d + w + sc))
+        makespan = f + max(s, d, w) + sc + residual
+        doc = {
+            "bench": "e2e_cluster_encode", "round": 1,
+            "platform": platform, "cpu_count": os.cpu_count(),
+            "fused_crc": fused == "1",
+            "config": {"volumes": n_vols, "vol_mb": vol_mb,
+                       "codec": "rs", "depth_streamed": 2,
+                       "reps_best_of": reps},
+            "in_bytes": in_bytes,
+            "serialized": {"wall_s": round(w_serial, 3),
+                           "mbps": round(in_bytes / w_serial / 1e6, 2),
+                           "stages_s": {k: round(v[0], 3)
+                                        for k, v in st_serial.items()}},
+            "streamed": {"wall_s": round(w_stream, 3),
+                         "mbps": round(in_bytes / w_stream / 1e6, 2),
+                         "stages_s": {k: round(v[0], 3)
+                                      for k, v in st_stream.items()}},
+            "measured_ratio": round(w_serial / w_stream, 3),
+            "projected_ratio": round(w_serial / makespan, 3)
+            if makespan else None,
+            "note": ("wire-to-wire: volume bytes in -> mounted shards "
+                     "out through the real cluster path (freeze, HTTP "
+                     "fetch, stacked mesh encode, scatter, mount, "
+                     "replica delete). projected_ratio replays the "
+                     "serialized run's own stage times with "
+                     "prefetch/device/drain overlapped; the measured "
+                     "ratio reaches it only when stages occupy "
+                     "distinct resources (accelerator + multicore "
+                     "host). On a 1-core CPU-only host all stages "
+                     "time-share one core, so measured ~1x is the "
+                     "physics, not the pipeline."),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(doc, fh, indent=1)
+            fh.write("\n")
+        log(f"wrote {out_path}: serialized "
+            f"{doc['serialized']['mbps']} MB/s, streamed "
+            f"{doc['streamed']['mbps']} MB/s, measured x"
+            f"{doc['measured_ratio']}, projected x"
+            f"{doc['projected_ratio']}")
+        return doc
+    finally:
+        if prev_fused is None:
+            os.environ.pop("SEAWEEDFS_TPU_EC_FUSED_CRC", None)
+        else:
+            os.environ["SEAWEEDFS_TPU_EC_FUSED_CRC"] = prev_fused
+        for vs in servers:
+            vs.stop()
+        if master:
+            master.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _multichip_child(n_devices: int) -> None:
+    """MULTICHIP row body: sharded batch encode WITH fused CRCs over an
+    n-device mesh via shard_map — verified bit-exact against the numpy
+    coder + reference crc32c, zero collectives in the lowered HLO, and
+    timed against the single-device serialized loop over the same
+    volumes (the recorded comparison baseline)."""
+    from seaweedfs_tpu.utils.jaxenv import force_cpu
+    force_cpu(device_count=n_devices)
+    import numpy as np
+
+    from seaweedfs_tpu.core.crc import crc32c
+    from seaweedfs_tpu.ops.coder_numpy import NumpyCoder
+    from seaweedfs_tpu.parallel.cluster_rebuild import make_mesh
+    from seaweedfs_tpu.parallel.sharded_codec import (
+        batched_encode_with_crc)
+
+    mesh = make_mesh()
+    vol, col = mesh.shape["vol"], mesh.shape["col"]
+    block = 1 << 20
+    n = block * col
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (vol, 10, n), dtype=np.uint8)
+
+    t0 = time.perf_counter()
+    base = [[np.asarray(x) for x in batched_encode_with_crc(data[v:v + 1])]
+            for v in range(vol)]
+    t_serial = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    parity, crcs = batched_encode_with_crc(data, mesh)
+    parity, crcs = np.asarray(parity), np.asarray(crcs)
+    t_shard = time.perf_counter() - t0
+
+    oracle = NumpyCoder()
+    for v in range(vol):
+        assert np.array_equal(parity[v], base[v][0][0]), f"vol {v}"
+        assert np.array_equal(parity[v], oracle.encode(data[v])), \
+            f"vol {v} parity vs numpy"
+        rows = np.concatenate([data[v], parity[v]], axis=0)
+        for r in range(rows.shape[0]):
+            want = [crc32c(rows[r, b * block:(b + 1) * block].tobytes())
+                    for b in range(n // block)]
+            assert [int(c) for c in crcs[v, r]] == want, (v, r)
+
+    from seaweedfs_tpu.parallel.sharded_codec import assert_no_collectives
+    assert_no_collectives(mesh, 4, (vol, 10, n))
+
+    print(f"dryrun_multichip OK: mesh={dict(mesh.shape)} sharded batch "
+          f"encode+fused-crc over {n_devices} devices bit-exact vs "
+          f"numpy+crc32c, zero collectives in HLO; sharded "
+          f"{t_shard:.2f}s vs single-device serialized {t_serial:.2f}s "
+          f"for {vol}x10x{n >> 20}MB (virtual CPU devices share one "
+          f"core: wall parity expected off-TPU)")
+
+
+def multichip_row(n_devices: int = 8,
+                  out_path: str = "MULTICHIP_r06.json") -> None:
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--multichip-child", str(n_devices)],
+        env=env, capture_output=True, text=True, timeout=1800)
+    tail = (p.stdout.strip().splitlines() or [""])[-1] + "\n"
+    if p.returncode != 0:
+        tail = (p.stderr.strip().splitlines() or ["failed"])[-1] + "\n"
+    doc = {"n_devices": n_devices, "rc": p.returncode,
+           "ok": p.returncode == 0 and "OK" in tail,
+           "skipped": False, "tail": tail}
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    log(f"wrote {out_path}: {tail.strip()}")
+
+
 def main() -> None:
     vol_mb = int(os.environ.get("BENCH_E2E_VOL_MB", "1024"))
     n = int(os.environ.get("BENCH_E2E_N", "20000"))
@@ -262,6 +524,22 @@ def main() -> None:
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
+    if os.environ.get("BENCH_E2E_WIRE", "1") == "1":
+        try:
+            doc = bench_cluster_encode()
+            emit("cluster ec.encode wire-to-wire MB/s (streamed)",
+                 doc["streamed"]["mbps"], "MB/s",
+                 doc["measured_ratio"],
+                 f"vs serialized {doc['serialized']['mbps']} MB/s in "
+                 f"the same run; projected overlap x"
+                 f"{doc['projected_ratio']}; BENCH_e2e_r01.json")
+        except Exception as e:  # noqa: BLE001
+            log(f"wire-to-wire pass failed: {type(e).__name__}: {e}")
+        try:
+            multichip_row()
+        except Exception as e:  # noqa: BLE001
+            log(f"multichip row failed: {type(e).__name__}: {e}")
+
     wr, rd = bench_weed_benchmark(n)
     emit("weed benchmark write req/s", wr["req_per_sec"], "req/s",
          wr["req_per_sec"] / REF_WRITE_RPS,
@@ -275,4 +553,10 @@ def main() -> None:
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--multichip-child":
+        _multichip_child(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--wire-only":
+        bench_cluster_encode()
+        multichip_row()
+    else:
+        main()

@@ -44,6 +44,12 @@ from ..storage.volume import (CorruptNeedleError, DiskFullError,
 from ..trace import span as trace_span
 from . import rpc
 
+# How long a receive_ecc fragment may wait for its receive_shard before
+# it stops being trusted (see VolumeServer._ec_pending_ecc).  Scatter
+# pushes follow their fragment within seconds; minutes-old entries mean
+# the push failed and a LATER encode generation must not inherit them.
+_PENDING_ECC_TTL = 600.0
+
 
 class VolumeServer:
     def __init__(self, master_url: str | list[str],
@@ -113,6 +119,20 @@ class VolumeServer:
         self.ec_volumes: dict[int, EcVolume] = {}
         self._ec_recv_lock = threading.Lock()
         self._ec_recv_vlocks: dict[int, threading.Lock] = {}
+        # vid -> {sid: (shipped_at, crcs)} entries that arrived via
+        # receive_ecc and have not yet been claimed by their
+        # receive_shard.  Kept SEPARATE from the on-disk .ecc sidecar:
+        # a sidecar entry might be a stale leftover from a prior encode
+        # generation (same shard size, so the block count matches), and
+        # trusting it for a fresh push would make the first scrub
+        # quarantine a healthy shard.  Only an entry the encoder
+        # shipped THIS time may stand in for fingerprinting the pushed
+        # body; entries expire after _PENDING_ECC_TTL (a fragment whose
+        # shard push failed must not haunt a later re-encode that
+        # happens to match its block count), and a restart in between
+        # just loses the map — receive_shard falls back safely.
+        self._ec_pending_ecc: \
+            dict[int, dict[int, tuple[float, list[int]]]] = {}
         # vid -> (fetched_at, ttl, shard->urls).  TTL is tiered by how
         # complete the last lookup was (store_ec.go:221-229): a lookup
         # that can't even serve reads retries quickly, a full set is
@@ -171,6 +191,7 @@ class VolumeServer:
         s.route("POST", "/admin/ec/copy_shard", self._ec_copy_shard)
         s.route("POST", "/admin/ec/receive_shard", self._ec_receive_shard)
         s.route("POST", "/admin/ec/receive_file", self._ec_receive_file)
+        s.route("POST", "/admin/ec/receive_ecc", self._ec_receive_ecc)
         s.route("POST", "/admin/ec/to_volume", self._ec_to_volume)
         s.route("POST", "/query", self._query)
         s.route("GET", "/admin/volume_tail", self._volume_tail)
@@ -1801,15 +1822,39 @@ class VolumeServer:
                 vid, threading.Lock())
         from ..ec.integrity import (BlockCrcAccumulator,
                                     ShardChecksums, ecc_lock)
+        with self._ec_recv_lock:
+            pend = self._ec_pending_ecc.get(vid, {}).pop(sid, None)
+            if not self._ec_pending_ecc.get(vid):
+                self._ec_pending_ecc.pop(vid, None)
+        if pend is not None:
+            shipped_at, crcs = pend
+            pend = crcs if (time.monotonic() - shipped_at
+                            < _PENDING_ECC_TTL) else None
         with vlock, ecc_lock(base):
-            # Fingerprint the pushed bytes so the scrub can verify
-            # this shard from its first sweep (the body IS the
-            # intended content; ec/integrity.py).
             ecc = ShardChecksums.load(base)
-            acc = BlockCrcAccumulator(ecc.block)
-            acc.feed(body)
-            ecc.set_shard(sid, acc.finalize())
-            ecc.save()
+            nblocks = -(-len(body) // ecc.block) if body else 0
+            if pend is not None and len(pend) == nblocks:
+                # The encoder shipped this shard's kernel-computed CRCs
+                # for THIS push (receive_ecc) — strictly better than
+                # fingerprinting the pushed body here: they describe
+                # the INTENDED bytes, so even wire corruption on the
+                # push itself is detectable by the first scrub.  Skip
+                # the CPU pass over the payload.  (receive_ecc already
+                # merged them into the sidecar; re-assert in case a
+                # concurrent writer dropped them.)
+                if ecc.get(sid) != pend:
+                    ecc.set_shard(sid, pend)
+                    ecc.save()
+            else:
+                # Fingerprint the pushed bytes so the scrub can verify
+                # this shard from its first sweep (the body IS the
+                # intended content; ec/integrity.py).  This also
+                # OVERWRITES any stale sidecar entry a prior encode
+                # generation left behind.
+                acc = BlockCrcAccumulator(ecc.block)
+                acc.feed(body)
+                ecc.set_shard(sid, acc.finalize())
+                ecc.save()
         source = query.get("ecx_source", "")
         if source:
             with vlock:
@@ -1857,6 +1902,70 @@ class VolumeServer:
             except FileNotFoundError:
                 pass
         return {"volume": vid, "ext": ext, "bytes": len(body)}
+
+    def _ec_receive_ecc(self, query: dict, body: bytes) -> dict:
+        """Merge kernel-computed `.ecc` entries pushed by the batched
+        mesh encode/rebuild BEFORE the shards arrive: the CRCs come
+        from the encode kernel's fused CRC32-C output (ops/crc_fold.py)
+        — the *intended* bytes — so receive_shard can skip its CPU
+        re-read of each pushed payload and divergence anywhere past the
+        device (wire, disk) is detectable by the first scrub."""
+        vid = int(query["volume"])
+        try:
+            doc = json.loads(body)
+            block = int(doc.get("block", 0))
+            raw = doc["shards"]
+            if not isinstance(raw, dict):
+                raise ValueError("shards must be an object")
+            shards = {}
+            for sid, crcs in raw.items():
+                if not isinstance(crcs, list):
+                    # A bare hex string would char-iterate into eight
+                    # bogus one-digit CRCs — refuse, don't mangle.
+                    raise ValueError(f"shard {sid}: crcs must be a list")
+                vals = [int(c, 16) for c in crcs]
+                if any(not 0 <= v <= 0xFFFFFFFF for v in vals):
+                    # A >32-bit value can never equal a recomputed
+                    # crc32c: merged into the sidecar it would make the
+                    # first scrub quarantine a healthy shard.
+                    raise ValueError(f"shard {sid}: crc out of range")
+                shards[int(sid)] = vals
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            raise rpc.RpcError(400, f"bad .ecc fragment: {e}")
+        base = self._volume_base(vid)
+        total = self._ec_total_shards(vid, base)
+        bad = [sid for sid in shards if not 0 <= sid < total]
+        if bad:
+            raise rpc.RpcError(400, f"bad shard ids {bad}")
+        from ..ec.integrity import ShardChecksums, ecc_lock
+        os.makedirs(os.path.dirname(base) or ".", exist_ok=True)
+        with ecc_lock(base):
+            ecc = ShardChecksums.load(base)
+            if block and ecc.shards and block != ecc.block:
+                raise rpc.RpcError(
+                    409, f"block {block} != existing {ecc.block}")
+            if block and not ecc.shards:
+                ecc.block = block
+            for sid, crcs in shards.items():
+                ecc.set_shard(sid, crcs)
+            ecc.save()
+        # Mark the entries claimable by this generation's receive_shard
+        # (see _ec_pending_ecc) — a shard push with no pending entry
+        # fingerprints its body instead of trusting the sidecar.  Prune
+        # expired leftovers (failed pushes) while we hold the lock so
+        # the map stays bounded.
+        now = time.monotonic()
+        with self._ec_recv_lock:
+            for v in list(self._ec_pending_ecc):
+                entries = self._ec_pending_ecc[v]
+                for s in [s for s, (ts, _c) in entries.items()
+                          if now - ts >= _PENDING_ECC_TTL]:
+                    del entries[s]
+                if not entries:
+                    del self._ec_pending_ecc[v]
+            self._ec_pending_ecc.setdefault(vid, {}).update(
+                {sid: (now, crcs) for sid, crcs in shards.items()})
+        return {"volume": vid, "shards": sorted(shards), "merged": True}
 
     def _ec_to_volume(self, query: dict, body: bytes) -> dict:
         """VolumeEcShardsToVolume: local data shards (.ec00-.ec09) + .ecx

@@ -87,12 +87,28 @@ def write_ec_files(base_file_name: str, coder: ErasureCoder | None = None,
     dat_size = os.path.getsize(base_file_name + ".dat")
     outputs = [open(base_file_name + to_ext(i), "wb")
                for i in range(cd.total_shards)]
-    accs = [BlockCrcAccumulator() for _ in range(cd.total_shards)]
+    # Fused path: the device coder emits every shard's per-block
+    # CRC32-C alongside the parity (ops/crc_fold.py) — no CPU pass over
+    # the shard bytes.  Requires the DEFAULT block geometry: only then
+    # are `_chunk_reader` widths 1MB-block multiples (except the final
+    # tail), which keeps the kernel partials block-aligned.  Custom
+    # large/small block sizes (or the SEAWEEDFS_TPU_EC_FUSED_CRC=0
+    # kill switch) fall back to the byte accumulators — a mid-stream
+    # unaligned chunk would abort the encode in feed_tiles.
+    from ..ops.crc_fold import fused_crc_enabled
+    fused = (fused_crc_enabled()
+             and getattr(coder, "fused_crc_ok", False)
+             and chunk_size % SMALL_BLOCK_SIZE == 0
+             and small_block_size == SMALL_BLOCK_SIZE
+             and large_block_size % SMALL_BLOCK_SIZE == 0)
+    accs = None if fused \
+        else [BlockCrcAccumulator() for _ in range(cd.total_shards)]
     try:
         with open(base_file_name + ".dat", "rb") as dat:
-            _encode_dat_file(dat, dat_size, coder, outputs,
-                             large_block_size, small_block_size, chunk_size,
-                             accs=accs)
+            crc_map = _encode_dat_file(
+                dat, dat_size, coder, outputs,
+                large_block_size, small_block_size, chunk_size,
+                accs=accs)
     finally:
         for f in outputs:
             f.close()
@@ -102,16 +118,17 @@ def write_ec_files(base_file_name: str, coder: ErasureCoder | None = None,
     update_volume_info(base_file_name, codec=cd.name)
     with ecc_lock(base_file_name):
         ecc = ShardChecksums(base_file_name)
-        for sid, acc in enumerate(accs):
-            ecc.set_shard(sid, acc.finalize())
+        for sid in range(cd.total_shards):
+            ecc.set_shard(sid, crc_map[sid] if crc_map is not None
+                          else accs[sid].finalize())
         ecc.save()
 
 
 def _encode_dat_file(dat, dat_size: int, coder: ErasureCoder, outputs,
                      large: int, small: int, chunk_size: int,
-                     accs=None) -> None:
+                     accs=None):
     chunks = _chunk_reader(dat, dat_size, large, small, chunk_size)
-    _pipelined_encode(chunks, coder, outputs, accs=accs)
+    return _pipelined_encode(chunks, coder, outputs, accs=accs)
 
 
 def _chunk_reader(dat, dat_size: int, large: int, small: int,
@@ -163,7 +180,7 @@ def _chunk_reader(dat, dat_size: int, large: int, small: int,
 
 
 def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
-                      depth: int = 2, accs=None) -> None:
+                      depth: int = 2, accs=None):
     """Double-buffered encode pipeline (SURVEY §2.3 'double-buffered
     host→HBM DMA + batched kernel launches'):
 
@@ -175,7 +192,14 @@ def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
     Device coders dispatch asynchronously, so up to `depth` encodes are
     in flight while the next chunk is being read — pread, host→device,
     kernel, device→host, and shard writes all overlap instead of
-    serializing (the round-2/3 verdict's weak spot #3)."""
+    serializing (the round-2/3 verdict's weak spot #3).
+
+    When ``accs is None`` the coder must support fused CRC
+    (`encode_with_crc`): the kernel emits every shard's `.ecc` tile
+    partials as a second output and this function returns the
+    per-shard CRC lists (crc_fold.FusedCrcAccumulator folds them,
+    including CPU fallback for a ragged tail chunk).  With byte
+    accumulators passed, behavior is unchanged and None is returned."""
     import collections
     import queue
     import threading
@@ -227,12 +251,35 @@ def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
 
     data_shards = coder.data_shards
     parity_shards = coder.parity_shards
+    fused = accs is None
+    faccs = None
+    block = SMALL_BLOCK_SIZE
+    if fused:
+        from ..ops.crc_fold import FusedCrcAccumulator
+        faccs = [FusedCrcAccumulator(coder.block_n)
+                 for _ in range(data_shards + parity_shards)]
 
     def flush_one() -> None:
-        parity = np.asarray(inflight.popleft())
+        if not fused:
+            parity = np.asarray(inflight.popleft())
+            for p in range(parity_shards):
+                _shard_write(outputs[data_shards + p], data_shards + p,
+                             parity[p].tobytes(), accs)
+            return
+        handle, crc_handle, width, data_tail = inflight.popleft()
+        parity = np.asarray(handle)
+        crc_np = np.asarray(crc_handle)
+        full = width // block * block
+        for i in range(data_shards):
+            faccs[i].feed_tiles(crc_np[i], full)
+            if width > full:
+                faccs[i].feed_bytes(data_tail[i].tobytes())
         for p in range(parity_shards):
-            _shard_write(outputs[data_shards + p], data_shards + p,
-                         parity[p].tobytes(), accs)
+            sid = data_shards + p
+            faccs[sid].feed_tiles(crc_np[sid], full)
+            if width > full:
+                faccs[sid].feed_bytes(parity[p, full:width].tobytes())
+            _shard_write(outputs[sid], sid, parity[p].tobytes(), None)
 
     try:
         while True:
@@ -242,9 +289,19 @@ def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
             # Dispatch first: device coders return an async handle and
             # the kernel runs while we write the data shards and read
             # the next chunk.
-            inflight.append(coder.encode(data))
+            if fused:
+                handle, crc_handle = coder.encode_with_crc(data)
+                width = data.shape[1]
+                full = width // block * block
+                # Ragged tail (non-block-multiple chunk_size): keep the
+                # tail bytes for the CPU fallback fold in flush_one.
+                tail = data[:, full:].copy() if width > full else None
+                inflight.append((handle, crc_handle, width, tail))
+            else:
+                inflight.append(coder.encode(data))
             for i in range(data_shards):
-                _shard_write(outputs[i], i, data[i].tobytes(), accs)
+                _shard_write(outputs[i], i, data[i].tobytes(),
+                             None if fused else accs)
             if len(inflight) >= depth:
                 flush_one()
         while inflight:
@@ -259,6 +316,10 @@ def _pipelined_encode(chunks, coder: ErasureCoder, outputs,
         t.join()
     if error:
         raise error[0]
+    if fused:
+        return {sid: faccs[sid].finalize()
+                for sid in range(data_shards + parity_shards)}
+    return None
 
 
 def rebuild_ec_files(base_file_name: str,

@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..stats.metrics import observe_ec_stage
+from . import crc_fold
 
 
 def _prof_on() -> bool:
@@ -105,6 +106,118 @@ def apply_bitmatrix_pallas(bmat_pm: jax.Array, shards: jax.Array,
     )(bmat_pm.astype(mm_dtype), shards)
 
 
+def _rs_crc_kernel(b_ref, d_ref, w0_ref, pl_ref, pm_ref, o_ref, c_ref, *,
+                   out_rows: int, in_rows: int, mm_dtype):
+    """One tile of the CRC-fused pipeline: bytes (in_rows, BN) ->
+    parity bytes (out_rows, BN) PLUS a position-shifted CRC32-C tile
+    partial per row (in_rows data rows first, then out_rows parity
+    rows) — the `.ecc` sidecar computed from the bits already unpacked
+    in VMEM (ops/crc_fold.py has the algebra).  pm_ref is the
+    tile-position-in-block shift matrix, selected by the grid index
+    mod tiles-per-block, so host-side folding is a plain XOR."""
+    x = d_ref[:].astype(jnp.int32)
+    bits_i = jnp.concatenate(
+        [(x >> s) & 1 for s in range(8)], axis=0)
+    bits = bits_i.astype(mm_dtype)
+    acc_t = jnp.float32 if mm_dtype == jnp.bfloat16 else jnp.int32
+    acc = jnp.dot(b_ref[:], bits, preferred_element_type=acc_t)
+    pbits = acc.astype(jnp.int32) & 1
+    out = pbits[0:out_rows]
+    for s in range(1, 8):
+        out = out | (pbits[s * out_rows:(s + 1) * out_rows] << s)
+    o_ref[:] = out.astype(jnp.uint8)
+
+    w0 = w0_ref[:]          # (BN, 32)
+    pm = pm_ref[:]          # (32, 32) — position shift, transposed
+
+    def row_crcs(plane_bits, rows):
+        # (8*rows, BN) plane-major 0/1 -> (rows, 1) uint32 partial
+        u = jnp.dot(plane_bits, w0, preferred_element_type=acc_t)
+        ub = (u.astype(jnp.int32) & 1).astype(mm_dtype)
+        fold = jnp.zeros((rows, 32), acc_t)
+        for s in range(8):
+            fold = fold + jnp.dot(
+                ub[s * rows:(s + 1) * rows],
+                pl_ref[s * 32:(s + 1) * 32],
+                preferred_element_type=acc_t)
+        vb = (fold.astype(jnp.int32) & 1).astype(mm_dtype)
+        sh = jnp.dot(vb, pm, preferred_element_type=acc_t) \
+            .astype(jnp.int32) & 1
+        w = jnp.left_shift(
+            jnp.uint32(1),
+            jax.lax.broadcasted_iota(jnp.uint32, (1, 32), 1))
+        return jnp.sum(sh.astype(jnp.uint32) * w, axis=1,
+                       keepdims=True, dtype=jnp.uint32)
+
+    c_ref[:] = jnp.concatenate(
+        [row_crcs(bits, in_rows),
+         row_crcs(pbits.astype(mm_dtype), out_rows)], axis=0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("out_rows", "in_rows", "interpret",
+                                    "block_n", "mm", "crc_block"))
+def apply_bitmatrix_crc_pallas(bmat_pm: jax.Array, shards: jax.Array,
+                               w0: jax.Array, planes_t: jax.Array,
+                               posmats_t: jax.Array,
+                               out_rows: int, in_rows: int,
+                               interpret: bool = False,
+                               block_n: int = BLOCK_N,
+                               mm: str = "bf16",
+                               crc_block: int = crc_fold.BLOCK):
+    """apply_bitmatrix_pallas plus fused `.ecc` CRC32-C: returns
+    (parity (out_rows, n) uint8, crc tile partials
+    (in_rows + out_rows, n // block_n) uint32).
+
+    The partials are position-shifted: XOR-ing the `crc_block //
+    block_n` partials of one `.ecc` block and XOR-ing the zero-block
+    constant yields the actual crc32c of that block
+    (crc_fold.block_crcs_from_partials / FusedCrcAccumulator).
+    The input must start on a `.ecc` block boundary.
+    """
+    n = shards.shape[1]
+    grid = (n // block_n,)
+    tpb = crc_block // block_n
+    mm_dtype = jnp.bfloat16 if mm == "bf16" else jnp.int8
+    kernel = functools.partial(_rs_crc_kernel, out_rows=out_rows,
+                               in_rows=in_rows, mm_dtype=mm_dtype)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * out_rows, 8 * in_rows), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((in_rows, block_n), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_n, 32), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8 * 32, 32), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((32, 32), lambda i: (i % tpb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((out_rows, block_n), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((in_rows + out_rows, 1), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((out_rows, n), jnp.uint8),
+            jax.ShapeDtypeStruct((in_rows + out_rows, n // block_n),
+                                 jnp.uint32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * 8 * out_rows * 8 * in_rows * n
+            + 2 * 8 * (in_rows + out_rows) * 32 * n,
+            bytes_accessed=(in_rows + out_rows) * n,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(bmat_pm.astype(mm_dtype), shards, w0.astype(mm_dtype),
+      planes_t.astype(mm_dtype), posmats_t.astype(mm_dtype))
+
+
 def pad_to_block(n: int, block_n: int = BLOCK_N) -> int:
     return -(-n // block_n) * block_n
 
@@ -135,7 +248,12 @@ class PallasCoder:
 
         self.block_n = block_n or int(
             os.environ.get("SEAWEEDFS_TPU_BLOCK_N", BLOCK_N))
-        self.mm = mm or os.environ.get("SEAWEEDFS_TPU_MM", "bf16")
+        # int8 is the measured on-TPU winner (BENCH_r05: 22.5 GB/s
+        # round-trip vs 21.0 for bf16) and exact for 0/1 bit planes
+        # (int32 accumulation; correctness-gated vs NumpyCoder in
+        # tests/test_ecpipe.py).  bf16 stays the off-TPU default.
+        self.mm = mm or os.environ.get("SEAWEEDFS_TPU_MM") \
+            or ("int8" if _on_tpu() else "bf16")
         self.codec = rs_codec(data_shards, parity_shards, matrix_kind) \
             if codec is None else get_codec(codec)
         self.data_shards = self.codec.data_shards
@@ -162,6 +280,47 @@ class PallasCoder:
                                      interpret=self.interpret,
                                      block_n=self.block_n, mm=self.mm)
         return out[:, :n]
+
+    @property
+    def fused_crc_ok(self) -> bool:
+        """True when this coder can emit `.ecc` CRC32-C tile partials
+        fused into the encode kernel (ops/crc_fold.py): the kernel tile
+        must evenly divide the sidecar block."""
+        return crc_fold.BLOCK % self.block_n == 0
+
+    def encode_with_crc(self, data) -> tuple[jax.Array, jax.Array]:
+        """Encode AND emit `.ecc` CRC tile partials in one fused kernel.
+
+        Returns (parity (p, n) uint8, partials (k + p, padded_n //
+        block_n) uint32) — rows ordered data shards then parity shards,
+        exactly the shard-file order.  Feed the partials to
+        crc_fold.FusedCrcAccumulator; `data` must start block-aligned
+        in its shard files (the encoder's chunks do).
+        """
+        if not self.fused_crc_ok:
+            raise ValueError(
+                f"block_n {self.block_n} does not divide the .ecc "
+                f"block {crc_fold.BLOCK}")
+        data = jnp.asarray(data, jnp.uint8)
+        if data.shape[0] != self.data_shards:
+            raise ValueError(
+                f"expected {self.data_shards} data shards, "
+                f"got {data.shape[0]}")
+        t = crc_fold.tables(self.block_n)
+        consts = getattr(self, "_crc_consts", None)
+        if consts is None:
+            consts = self._crc_consts = (
+                jnp.asarray(t.w0), jnp.asarray(t.planes_t),
+                jnp.asarray(t.posmats_t))
+        n = data.shape[1]
+        padded = pad_to_block(n, self.block_n)
+        if padded != n:
+            data = jnp.pad(data, ((0, 0), (0, padded - n)))
+        parity, partials = apply_bitmatrix_crc_pallas(
+            self._parity_pm, data, *consts, self.parity_shards,
+            self.data_shards, interpret=self.interpret,
+            block_n=self.block_n, mm=self.mm)
+        return parity[:, :n], partials
 
     def encode(self, data) -> jax.Array:
         data = jnp.asarray(data, jnp.uint8)

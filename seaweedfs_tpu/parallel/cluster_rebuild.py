@@ -27,6 +27,7 @@ import numpy as np
 
 from ..cluster import rpc
 from ..codecs import Codec, get_codec
+from ..ec import SMALL_BLOCK_SIZE
 from ..ec.shard_bits import ShardBits
 from ..events import emit as emit_event
 from ..fault import registry as _fault
@@ -34,7 +35,8 @@ from ..stats.metrics import (ec_repair_read_bytes_total,
                              observe_batch_stage, stage_attrs)
 from ..trace import root_span
 from ..utils import env_float as _env_float
-from .sharded_codec import batched_reconstruct
+from .sharded_codec import batched_reconstruct, batched_reconstruct_with_crc
+from .stream_pipeline import run_pipeline
 
 # Column padding granularity: keeps the jitted matmul's N divisible by
 # the mesh col axis and lane-aligned (128 lanes) for any mesh <= 16 wide.
@@ -243,9 +245,10 @@ def _pad_to(n: int, align: int) -> int:
 
 def batch_rebuild(env, vids=None, mesh=None, max_batch_bytes=1 << 28,
                   workers: int = 16, matrix_kind: str = "vandermonde",
-                  progress=None) -> list[str]:
+                  progress=None, depth: int | None = None) -> list[str]:
     """Rebuild all missing EC shards across the cluster in mesh-batched
     compiled steps.  Returns one human-readable line per volume.
+    `depth` overrides the stream-pipeline depth (0 = serialized).
 
     env: duck-typed cluster view (shell CommandEnv): ec_shard_locations,
     data_nodes, vs_call.
@@ -265,15 +268,18 @@ def batch_rebuild(env, vids=None, mesh=None, max_batch_bytes=1 << 28,
             messages += _rebuild_group(
                 env, mesh, pool, picker, get_codec(codec_name),
                 present, missing, entries, max_batch_bytes,
-                matrix_kind, progress)
+                matrix_kind, progress, depth)
     finally:
-        pool.shutdown(wait=False)
+        # cancel_futures: a failed group must not leave queued shard
+        # fetches/pushes running (and holders busy) after the
+        # exception has unwound.
+        pool.shutdown(wait=False, cancel_futures=True)
     return messages
 
 
 def _rebuild_group(env, mesh, pool, picker, codec, present, missing,
                    entries, max_batch_bytes, matrix_kind,
-                   progress) -> list[str]:
+                   progress, depth: int | None = None) -> list[str]:
     """One (codec, survivor-signature) group — journaled as
     ec.rebuild.start/finish with per-stage byte/second attrs plus the
     planner's planned-vs-RS read accounting, under a root span so the
@@ -292,7 +298,7 @@ def _rebuild_group(env, mesh, pool, picker, codec, present, missing,
             out = _rebuild_group_inner(env, mesh, pool, picker, codec,
                                        present, missing, entries,
                                        max_batch_bytes, matrix_kind,
-                                       progress, stages, report)
+                                       progress, stages, report, depth)
         except Exception as e:
             emit_event("ec.rebuild.finish", severity="error",
                        volumes=vids, batch=True, missing=list(missing),
@@ -312,7 +318,15 @@ def _rebuild_group(env, mesh, pool, picker, codec, present, missing,
 
 def _rebuild_group_inner(env, mesh, pool, picker, codec, present,
                          missing, entries, max_batch_bytes, matrix_kind,
-                         progress, stages, report) -> list[str]:
+                         progress, stages, report,
+                         depth: int | None = None) -> list[str]:
+    """Streamed rebuild of one survivor-signature group: the producer
+    gathers + stacks the NEXT sub-batch's shards over HTTP while the
+    device decodes the current one and the drain thread scatters
+    completed shards — gather, decode and scatter overlap instead of
+    serializing (stream_pipeline.py; sums of the batch_* stage
+    histograms exceed the wall clock when the overlap is working)."""
+    from .cluster_encode import fused_crc_enabled, pipeline_depth
     # The codec's planned read set, not "first data_shards survivors":
     # an in-group LRC loss gathers 5 shards per volume instead of 10.
     _mat, used = codec.decode_matrix(present, missing)
@@ -320,59 +334,97 @@ def _rebuild_group_inner(env, mesh, pool, picker, codec, present,
         report["local_repairs"] == len(missing)
     vol_axis = mesh.shape["vol"]
     col_axis = mesh.shape["col"]
-    align = _pad_to(_COL_ALIGN, col_axis * 8)
+    fused = fused_crc_enabled()
+    block = SMALL_BLOCK_SIZE
+    align = block * col_axis if fused \
+        else _pad_to(_COL_ALIGN, col_axis * 8)
+    depth = pipeline_depth(depth)
     out: list[str] = []
-    i = 0
-    while i < len(entries):
-        # Probe the first volume's shard size to bound the sub-batch.
-        t_gather = time.perf_counter()
-        vid0, locs0 = entries[i]
-        rows0 = _fetch_rows(pool, vid0, locs0, used)
-        shard_bytes = len(rows0[0])
-        per_vol = shard_bytes * (len(used) + len(missing))
-        chunk_v = max(1, min(len(entries) - i,
-                             int(max_batch_bytes // max(per_vol, 1))))
-        chunk = entries[i:i + chunk_v]
-        # Flat fan-out of every (volume, shard) fetch — nested submits
-        # from inside pool workers would deadlock a bounded pool.
-        futs = [[pool.submit(_fetch_shard, locs[sid], vid, sid)
-                 for sid in used] for vid, locs in chunk[1:]]
-        fetched = [rows0] + [[f.result() for f in row] for row in futs]
-        gathered = sum(len(row) for rows in fetched for row in rows)
-        observe_batch_stage(stages, "batch_gather",
-                       time.perf_counter() - t_gather, gathered)
-        ec_repair_read_bytes_total.inc(gathered, codec=codec.name)
-        sizes = [len(rows[0]) for rows in fetched]
-        n_pad = _pad_to(max(sizes), align)
-        v_pad = _pad_to(len(chunk), vol_axis)
-        stacked = np.zeros((v_pad, len(used), n_pad), np.uint8)
-        for v, rows in enumerate(fetched):
-            for r, row in enumerate(rows):
-                if len(row) != sizes[v]:
-                    raise rpc.RpcError(
-                        502, f"volume {chunk[v][0]}: survivor shards "
-                        f"disagree on size ({len(row)} vs {sizes[v]})")
-                stacked[v, r, :len(row)] = np.frombuffer(row, np.uint8)
-        # ONE compiled step for the whole sub-batch: volumes sharded on
-        # "vol", byte columns on "col", no collectives.  np.asarray
-        # fences the dispatch, so this is execution-fenced device time.
+    saved = f" ({codec.name}: read {len(used)} shards vs " \
+            f"{codec.data_shards} for RS)" \
+        if len(used) < codec.data_shards else ""
+
+    def produce():
+        i = 0
+        while i < len(entries):
+            # Probe the first volume's shard size to bound the
+            # sub-batch.
+            t_gather = time.perf_counter()
+            vid0, locs0 = entries[i]
+            rows0 = _fetch_rows(pool, vid0, locs0, used)
+            shard_bytes = len(rows0[0])
+            per_vol = shard_bytes * (len(used) + len(missing))
+            chunk_v = max(1, min(len(entries) - i,
+                                 int(max_batch_bytes
+                                     // max(per_vol, 1))))
+            chunk = entries[i:i + chunk_v]
+            # Flat fan-out of every (volume, shard) fetch — nested
+            # submits from inside pool workers would deadlock a
+            # bounded pool.
+            futs = [[pool.submit(_fetch_shard, locs[sid], vid, sid)
+                     for sid in used] for vid, locs in chunk[1:]]
+            fetched = [rows0] + [[f.result() for f in row]
+                                 for row in futs]
+            gathered = sum(len(row) for rows in fetched for row in rows)
+            ec_repair_read_bytes_total.inc(gathered, codec=codec.name)
+            sizes = [len(rows[0]) for rows in fetched]
+            n_pad = _pad_to(max(sizes), align)
+            v_pad = _pad_to(len(chunk), vol_axis)
+            stacked = np.zeros((v_pad, len(used), n_pad), np.uint8)
+            for v, rows in enumerate(fetched):
+                for r, row in enumerate(rows):
+                    if len(row) != sizes[v]:
+                        raise rpc.RpcError(
+                            502, f"volume {chunk[v][0]}: survivor "
+                            f"shards disagree on size "
+                            f"({len(row)} vs {sizes[v]})")
+                    stacked[v, r, :len(row)] = np.frombuffer(row,
+                                                             np.uint8)
+            observe_batch_stage(stages, "batch_gather",
+                                time.perf_counter() - t_gather,
+                                gathered)
+            yield (stacked, chunk, sizes)
+            i += chunk_v
+
+    def dispatch(item):
+        stacked, chunk, sizes = item
+        # Device CRCs for the rebuilt rows ride along when every shard
+        # in the sub-batch covers whole `.ecc` blocks (they always do:
+        # shard files are 1MB-block padded by construction).
+        use_crc = fused and all(s % block == 0 for s in sizes)
+        if use_crc:
+            rebuilt, crcs = batched_reconstruct_with_crc(
+                stacked, present, missing, mesh, codec=codec)
+        else:
+            rebuilt = batched_reconstruct(
+                stacked, present, missing, mesh,
+                matrix_kind=matrix_kind, codec=codec)
+            crcs = None
+        return rebuilt, crcs, chunk, sizes, stacked.nbytes
+
+    def drain(handle):
+        rebuilt, crcs, chunk, sizes, nbytes = handle
+        # np.asarray fences the dispatch — the EXPOSED device wait.
         t_dev = time.perf_counter()
-        rebuilt = np.asarray(batched_reconstruct(
-            stacked, present, missing, mesh,
-            matrix_kind=matrix_kind, codec=codec))
+        rebuilt = np.asarray(rebuilt)
+        if crcs is not None:
+            crcs = np.asarray(crcs)
         observe_batch_stage(stages, "batch_rebuild_device",
-                       time.perf_counter() - t_dev, stacked.nbytes)
+                            time.perf_counter() - t_dev, nbytes)
         t_scatter = time.perf_counter()
         scattered = 0
-        saved = f" ({codec.name}: read {len(used)} shards vs " \
-                f"{codec.data_shards} for RS)" \
-            if len(used) < codec.data_shards else ""
         for v, (vid, locs) in enumerate(chunk):
             shards = [rebuilt[v, m, :sizes[v]].tobytes()
                       for m in range(len(missing))]
             scattered += sum(len(s) for s in shards)
+            shard_crcs = None
+            if crcs is not None:
+                nb = sizes[v] // block
+                shard_crcs = [[int(c) for c in crcs[v, m, :nb]]
+                              for m in range(len(missing))]
             placed = _scatter_volume(
-                env, pool, picker, vid, locs, missing, shards)
+                env, pool, picker, vid, locs, missing, shards,
+                shard_crcs=shard_crcs)
             if all_local:
                 emit_event("ec.repair.local", vid=vid,
                            codec=codec.name, shard=list(missing),
@@ -385,8 +437,9 @@ def _rebuild_group_inner(env, mesh, pool, picker, codec, present,
             if progress:
                 progress(out[-1])
         observe_batch_stage(stages, "batch_scatter",
-                       time.perf_counter() - t_scatter, scattered)
-        i += chunk_v
+                            time.perf_counter() - t_scatter, scattered)
+
+    run_pipeline(produce(), dispatch, drain, depth=depth)
     return out
 
 
@@ -400,10 +453,16 @@ def _fetch_rows(pool, vid, locs, used) -> list[bytes]:
 
 
 def _push_shard(vid: int, sid: int, payload: bytes, target: str,
-                sources: list[str]) -> None:
+                sources: list[str], ecc_push=None) -> None:
     """Push one rebuilt shard; the target pulls the .ecx index from a
     source holder, so fail over across sources — a stale/dead entry in
     the location map must not sink the scatter."""
+    if ecc_push is not None:
+        # Ship the target its kernel-computed `.ecc` entries before the
+        # first shard body lands (once per target, inside this worker —
+        # a slow target can't stall the drain thread; cluster_encode.
+        # _EccOncePush).
+        ecc_push.ensure(target)
     errors: list[str] = []
     for src in sources:
         try:
@@ -433,18 +492,26 @@ def _push_shard(vid: int, sid: int, payload: bytes, target: str,
 
 
 def _scatter_volume(env, pool, picker, vid, locs, missing,
-                    shards: list[bytes]) -> list[tuple[int, str]]:
+                    shards: list[bytes],
+                    shard_crcs=None) -> list[tuple[int, str]]:
     """Push rebuilt shards to balanced targets, pulling the .ecx index
-    alongside, then mount."""
+    alongside, then mount.  When `shard_crcs` carries the device-
+    computed per-block CRC32-C of each rebuilt shard, the target gets
+    its `.ecc` entries FIRST so receive_shard skips the CPU re-read of
+    the pushed payload (and wire corruption of the push itself is
+    scrub-detectable)."""
     holders = {u for urls in locs.values() for u in urls}
     sources = sorted(holders)
-    placed: list[tuple[int, str]] = []
-    futs = []
-    for sid, payload in zip(missing, shards):
-        target = picker.pick(holders)
-        placed.append((sid, target))
-        futs.append(pool.submit(_push_shard, vid, sid, payload, target,
-                                sources))
+    placed = [(sid, picker.pick(holders)) for sid in missing]
+    pusher = None
+    if shard_crcs is not None:
+        from .cluster_encode import _ecc_push_plan
+        pusher = _ecc_push_plan(
+            vid, ((target, sid, crcs)
+                  for (sid, target), crcs in zip(placed, shard_crcs)))
+    futs = [pool.submit(_push_shard, vid, sid, payload, target,
+                        sources, pusher)
+            for (sid, target), payload in zip(placed, shards)]
     for f in futs:
         f.result()
     for _sid, target in placed:

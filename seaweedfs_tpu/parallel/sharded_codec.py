@@ -39,6 +39,18 @@ else:  # pragma: no cover — exercised on the 0.4.x image
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
+def _mm_dtype():
+    """Bit-matrix matmul dtype for the batch paths: bf16 feeds the MXU
+    on TPU; off-TPU, XLA emulates bf16 slowly in software while f32 is
+    exactly as correct for 0/1 bit planes (counts < 2^24 accumulate
+    exactly either way) and measured ~1.7x faster on the CPU backend."""
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # pragma: no cover — backend init failure
+        platform = "cpu"
+    return jnp.bfloat16 if platform == "tpu" else jnp.float32
+
+
 def _codec_of(data_shards: int, parity_shards: int, matrix_kind: str,
               codec):
     """Resolve the scheme: an explicit codec wins, else ad-hoc RS from
@@ -61,25 +73,156 @@ def _encode_batch(bmat_pm, data, parity_shards: int):
     return jax.vmap(lambda d: apply_bitmatrix(bmat_pm, d, parity_shards))(data)
 
 
+def _check_mesh_divisible(mesh: Mesh, v: int, n: int) -> None:
+    if v % mesh.shape["vol"]:
+        raise ValueError(
+            f"batch of {v} volumes must divide over vol axis "
+            f"{mesh.shape['vol']}")
+    if n % mesh.shape["col"]:
+        raise ValueError(
+            f"byte width {n} must divide over col axis "
+            f"{mesh.shape['col']}")
+
+
+def _local_map(fn, mesh: Mesh):
+    """shard_map a (bmat, (V_loc, R, N_loc)) -> pytree-of-(V_loc, *,
+    N_loc) volume-batch function over the ("vol", "col") mesh: the bit
+    matrix rides along replicated, data shards over volumes/columns.
+    Every chip computes ONLY its own volume/column block — by
+    construction there are ZERO collectives in the lowered program
+    (asserted by tests/test_ecpipe.py on the compiled HLO).  check_rep
+    is off: no output claims replication, and the 0.4.x rep-rewriter
+    chokes on jitted decode matrices.
+
+    Callers MUST route through the `_mapped_*` lru_cached factories
+    below (never wrap a fresh closure per call): jax.jit caches by
+    callable identity, so an uncached wrapper would retrace + XLA
+    compile on EVERY dispatched chunk batch of the stream pipeline."""
+    try:
+        mapped = _shard_map(fn, mesh=mesh,
+                            in_specs=(P(None, None),
+                                      P("vol", None, "col")),
+                            out_specs=P("vol", None, "col"),
+                            check_rep=False)
+    except TypeError:  # pragma: no cover — newer API dropped check_rep
+        mapped = _shard_map(fn, mesh=mesh,
+                            in_specs=(P(None, None),
+                                      P("vol", None, "col")),
+                            out_specs=P("vol", None, "col"))
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=64)
+def _mapped_encode(mesh: Mesh, parity_shards: int):
+    return _local_map(
+        lambda bmat, d: _encode_batch(bmat, d, parity_shards), mesh)
+
+
+@functools.lru_cache(maxsize=64)
+def _mapped_reconstruct(mesh: Mesh, wanted_count: int):
+    return _local_map(
+        lambda pm, s: _reconstruct_batch(pm, s, wanted_count), mesh)
+
+
+def _crc_local(parity_shards: int, tile: int, block: int):
+    from ..ops import crc_fold
+
+    def fn(bmat, d):
+        parity = _encode_batch(bmat, d, parity_shards)
+        rows = jnp.concatenate([d, parity], axis=1)
+        crcs = jax.vmap(
+            lambda r: crc_fold.block_crcs_jnp(r, tile, block))(rows)
+        return parity, crcs
+    return fn
+
+
+@functools.lru_cache(maxsize=64)
+def _mapped_encode_crc(mesh: Mesh | None, parity_shards: int,
+                       tile: int, block: int):
+    fn = _crc_local(parity_shards, tile, block)
+    if mesh is None:
+        return jax.jit(fn)
+    return _local_map(fn, mesh)
+
+
+def _crc_reconstruct_local(wanted_count: int, tile: int, block: int):
+    from ..ops import crc_fold
+
+    def fn(pm, s):
+        rebuilt = _reconstruct_batch(pm, s, wanted_count)
+        crcs = jax.vmap(
+            lambda r: crc_fold.block_crcs_jnp(r, tile, block))(rebuilt)
+        return rebuilt, crcs
+    return fn
+
+
+@functools.lru_cache(maxsize=64)
+def _mapped_reconstruct_crc(mesh: Mesh | None, wanted_count: int,
+                            tile: int, block: int):
+    fn = _crc_reconstruct_local(wanted_count, tile, block)
+    if mesh is None:
+        return jax.jit(fn)
+    return _local_map(fn, mesh)
+
+
 def batched_encode(data, mesh: Mesh | None = None,
                    data_shards: int = 10, parity_shards: int = 4,
                    matrix_kind: str = "vandermonde", codec=None):
     """(V, data_shards, N) uint8 -> (V, parity_shards, N) parity.
 
-    With a mesh, inputs are placed (vol, None, col)-sharded so each chip
-    encodes its own volume/column block — no cross-chip traffic.
+    With a mesh the batch runs under `shard_map` on the ("vol", "col")
+    axes: volumes data-parallel over "vol", byte columns over "col",
+    each chip encoding its own block with zero collectives (parity is
+    columnwise for every codec, so no cross-chip bytes exist to move).
     `codec` swaps the generator matrix (e.g. "lrc"); the kernel and
     sharding story are identical.
     """
     cd = _codec_of(data_shards, parity_shards, matrix_kind, codec)
     bmat = jnp.asarray(
         plane_major(cd.parity_bitmatrix(), cd.parity_shards,
-                    cd.data_shards), jnp.bfloat16)
+                    cd.data_shards), _mm_dtype())
     data = jnp.asarray(data, jnp.uint8)
-    if mesh is not None:
-        data = jax.device_put(
-            data, NamedSharding(mesh, P("vol", None, "col")))
-    return _encode_batch(bmat, data, cd.parity_shards)
+    if mesh is None:
+        return _encode_batch(bmat, data, cd.parity_shards)
+    _check_mesh_divisible(mesh, data.shape[0], data.shape[2])
+    data = jax.device_put(
+        data, NamedSharding(mesh, P("vol", None, "col")))
+    return _mapped_encode(mesh, cd.parity_shards)(bmat, data)
+
+
+def batched_encode_with_crc(data, mesh: Mesh | None = None,
+                            codec=None, crc_tile: int | None = None):
+    """batched_encode plus per-`.ecc`-block CRC32-C of EVERY shard row
+    (data rows first, then parity), computed on device in the same
+    compiled step (ops/crc_fold.py).
+
+    data: (V, k, N) uint8 with N a multiple of the `.ecc` block
+    (1MB) times the mesh col axis — zero-padded tail blocks simply
+    yield the crc of a zero block and are sliced off by true width.
+    Returns (parity (V, p, N) uint8, crcs (V, k+p, N//BLOCK) uint32).
+    """
+    from ..ops import crc_fold
+    cd = _codec_of(10, 4, "vandermonde", codec)
+    bmat = jnp.asarray(
+        plane_major(cd.parity_bitmatrix(), cd.parity_shards,
+                    cd.data_shards), _mm_dtype())
+    tile = crc_tile or crc_fold.JNP_TILE
+    data = jnp.asarray(data, jnp.uint8)
+    v, _k, n = data.shape
+    block = crc_fold.BLOCK
+    cols = mesh.shape["col"] if mesh is not None else 1
+    if n % (block * cols):
+        raise ValueError(
+            f"byte width {n} must be a multiple of the .ecc block "
+            f"{block} x col axis {cols}")
+
+    fn = _mapped_encode_crc(mesh, cd.parity_shards, tile, block)
+    if mesh is None:
+        return fn(bmat, data)
+    _check_mesh_divisible(mesh, v, n)
+    data = jax.device_put(
+        data, NamedSharding(mesh, P("vol", None, "col")))
+    return fn(bmat, data)
 
 
 @functools.partial(jax.jit, static_argnames=("wanted_count",))
@@ -104,16 +247,56 @@ def batched_reconstruct(stacked, present: tuple[int, ...],
     cd = _codec_of(data_shards, parity_shards, matrix_kind, codec)
     bmat, used = cd.decode_bitmatrix(tuple(present), tuple(wanted))
     pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted), len(used)),
-                     jnp.bfloat16)
+                     _mm_dtype())
     stacked = jnp.asarray(stacked, jnp.uint8)
     if stacked.shape[1] != len(used):
         raise ValueError(
             f"stacked must carry the {len(used)} used survivor rows "
             f"({[int(u) for u in used]}), got {stacked.shape[1]}")
-    if mesh is not None:
-        stacked = jax.device_put(
-            stacked, NamedSharding(mesh, P("vol", None, "col")))
-    return _reconstruct_batch(pm, stacked, len(wanted))
+    if mesh is None:
+        return _reconstruct_batch(pm, stacked, len(wanted))
+    _check_mesh_divisible(mesh, stacked.shape[0], stacked.shape[2])
+    stacked = jax.device_put(
+        stacked, NamedSharding(mesh, P("vol", None, "col")))
+    return _mapped_reconstruct(mesh, len(wanted))(pm, stacked)
+
+
+def batched_reconstruct_with_crc(stacked, present: tuple[int, ...],
+                                 wanted: tuple[int, ...],
+                                 mesh: Mesh | None = None, codec=None,
+                                 crc_tile: int | None = None):
+    """batched_reconstruct plus per-`.ecc`-block CRC32-C of every
+    REBUILT row, on device in the same compiled step — the scatter
+    ships ready-made sidecar entries instead of each holder re-reading
+    the pushed bytes.  Returns (rebuilt (V, W, N) uint8,
+    crcs (V, W, N//BLOCK) uint32).  N must be a multiple of the `.ecc`
+    block times the mesh col axis."""
+    from ..ops import crc_fold
+    cd = _codec_of(10, 4, "vandermonde", codec)
+    bmat, used = cd.decode_bitmatrix(tuple(present), tuple(wanted))
+    pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted), len(used)),
+                     _mm_dtype())
+    tile = crc_tile or crc_fold.JNP_TILE
+    stacked = jnp.asarray(stacked, jnp.uint8)
+    if stacked.shape[1] != len(used):
+        raise ValueError(
+            f"stacked must carry the {len(used)} used survivor rows "
+            f"({[int(u) for u in used]}), got {stacked.shape[1]}")
+    v, _s, n = stacked.shape
+    block = crc_fold.BLOCK
+    cols = mesh.shape["col"] if mesh is not None else 1
+    if n % (block * cols):
+        raise ValueError(
+            f"byte width {n} must be a multiple of the .ecc block "
+            f"{block} x col axis {cols}")
+
+    fn = _mapped_reconstruct_crc(mesh, len(wanted), tile, block)
+    if mesh is None:
+        return fn(pm, stacked)
+    _check_mesh_divisible(mesh, v, n)
+    stacked = jax.device_put(
+        stacked, NamedSharding(mesh, P("vol", None, "col")))
+    return fn(pm, stacked)
 
 
 def _shard_major_prep(stacked, present, wanted, mesh,
@@ -126,7 +309,7 @@ def _shard_major_prep(stacked, present, wanted, mesh,
     bmat, _used = rs_bitmatrix.decode_bitmatrix(
         data_shards, total, tuple(present), tuple(wanted), matrix_kind)
     pm = jnp.asarray(plane_major(np.asarray(bmat), len(wanted),
-                                 data_shards), jnp.bfloat16)
+                                 data_shards), _mm_dtype())
     n_axis = mesh.shape["col"]
     if data_shards % n_axis != 0:
         raise ValueError(
@@ -253,3 +436,30 @@ def ring_reconstruct(stacked, present: tuple[int, ...],
         in_specs=P("vol", "col", None),
         out_specs=P("vol", None, "col")))
     return fn(stacked)
+
+
+def assert_no_collectives(mesh: Mesh, parity_shards: int,
+                          shape: tuple[int, int, int]) -> str:
+    """Compile the sharded batch-encode step for `shape` and assert the
+    HLO contains no cross-chip collectives — parity and CRCs are
+    columnwise, so no cross-chip bytes should exist to move.  Shared by
+    the ecpipe test suite and bench_e2e's MULTICHIP row (one copy, one
+    collective-name list).  Returns the HLO text."""
+    import re
+
+    from ..codecs import get_codec
+
+    cd = get_codec("rs")
+    bmat = jnp.asarray(
+        plane_major(cd.parity_bitmatrix(), parity_shards,
+                    cd.data_shards), _mm_dtype())
+    fn = _mapped_encode(mesh, parity_shards)
+    hlo = fn.lower(bmat, jax.ShapeDtypeStruct(shape, np.uint8)) \
+        .compile().as_text()
+    found = re.search(
+        r"all-reduce|all-gather|all-to-all|collective-permute|"
+        r"reduce-scatter", hlo)
+    if found:
+        raise AssertionError(
+            f"collective found in sharded encode HLO: {found.group(0)}")
+    return hlo

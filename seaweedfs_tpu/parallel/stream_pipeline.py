@@ -1,0 +1,217 @@
+"""Bounded-depth three-stage stream pipeline: prefetch | device | drain.
+
+The serialized batch EC loop pays sum(stages) per chunk — stack the
+next batch, THEN dispatch the matmul, THEN fence and write.  This
+pipeline overlaps them so per-chunk wall time approaches max(stage):
+
+    producer thread:  items() generator — fetch/pread/stack chunk k+2
+                      (IO + numpy, runs while the device computes)
+    caller thread:    dispatch(item) — H2D + kernel launch for k+1
+                      (async on device backends: returns a handle)
+    drain thread:     drain(handle) — fence (D2H) + shard writes /
+                      scatter for chunk k
+
+Bounded queues on both sides cap live chunks at depth per side, so a
+30GB volume batch never holds more than ~2*depth stacked chunks in
+host memory — the "reusable pinned host buffer" discipline is the
+caller's (cluster_encode keeps a buffer pool sized to the pipeline
+depth and recycles a buffer only after its chunk drains).
+
+``depth=0`` degenerates to the fully serialized loop — the measured
+baseline `bench_e2e.py` compares against.
+
+The ``recorder`` hook exists for the overlap regression test: every
+stage transition is recorded with an injectable clock (no sleeps, no
+wall-time flakiness) so a test can assert the next H2D was issued
+before the previous device step completed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+
+class PipelineRecorder:
+    """Thread-safe (event, index, t) log with an injectable clock.
+
+    Tests inject a counter clock so event ordering is exact sequence
+    order; production leaves it None (events aren't recorded at all on
+    the hot path unless a recorder is passed)."""
+
+    def __init__(self, clock: Callable[[], float] | None = None):
+        self.clock = clock or time.monotonic
+        self._events: list[tuple[str, int, float]] = []
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def record(self, event: str, index: int) -> None:
+        with self._cond:
+            self._events.append((event, index, self.clock()))
+            self._cond.notify_all()
+
+    def events(self) -> list[tuple[str, int, float]]:
+        with self._lock:
+            return list(self._events)
+
+    def seen(self, event: str, index: int) -> bool:
+        with self._lock:
+            return any(e == event and i == index
+                       for e, i, _t in self._events)
+
+    def wait_for(self, event: str, index: int,
+                 timeout: float = 30.0) -> bool:
+        """Block until (event, index) is recorded — lets a fake device
+        gate its completion on pipeline progress without sleeping."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not any(e == event and i == index
+                          for e, i, _t in self._events):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def first_time(self, event: str, index: int) -> float | None:
+        with self._lock:
+            for e, i, t in self._events:
+                if e == event and i == index:
+                    return t
+        return None
+
+
+class _Stop:
+    """End-of-stream / error sentinel."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException | None = None):
+        self.error = error
+
+
+def run_pipeline(items: Iterable[Any],
+                 dispatch: Callable[[Any], Any],
+                 drain: Callable[[Any], None],
+                 depth: int = 2,
+                 recorder: PipelineRecorder | None = None,
+                 cancel: threading.Event | None = None) -> int:
+    """Drive items through dispatch -> drain with `depth` in flight.
+
+    Returns the number of items processed.  Exceptions from any stage
+    cancel the others and re-raise on the caller thread (producer
+    blocked on a full queue is unblocked — never deadlocks).
+
+    `cancel` (optional) is used as the internal cancellation flag, so a
+    producer that blocks on resources OUTSIDE the pipeline's queues
+    (e.g. a bounded buffer pool whose buffers are released by drain)
+    can share it: when any stage dies, the flag is set and the
+    producer's own blocking waits can observe it instead of waiting on
+    a release that will never come."""
+    if depth <= 0:
+        n = 0
+        for i, item in enumerate(items):
+            if recorder:
+                recorder.record("produced", i)
+                recorder.record("dispatched", i)
+            handle = dispatch(item)
+            drain(handle)
+            if recorder:
+                recorder.record("drained", i)
+            n += 1
+        return n
+
+    q_in: "queue.Queue" = queue.Queue(maxsize=depth)
+    q_out: "queue.Queue" = queue.Queue(maxsize=depth)
+    cancelled = cancel if cancel is not None else threading.Event()
+    errors: list[BaseException] = []
+
+    # Every blocking queue op polls the cancel flag: whichever stage
+    # dies, the other two always unblock (no sleep-free deadlock path —
+    # the 0.2s poll only runs during shutdown/error, never steady state).
+    def _put(q, obj) -> bool:
+        while not cancelled.is_set():
+            try:
+                q.put(obj, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _get(q):
+        while True:
+            try:
+                return q.get(timeout=0.2)
+            except queue.Empty:
+                if cancelled.is_set():
+                    return _Stop()
+
+    def producer() -> None:
+        try:
+            for i, item in enumerate(items):
+                if recorder:
+                    recorder.record("produced", i)
+                if not _put(q_in, (i, item)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+            cancelled.set()
+        finally:
+            _put(q_in, _Stop())
+
+    def drainer() -> None:
+        try:
+            while True:
+                got = _get(q_out)
+                if isinstance(got, _Stop):
+                    return
+                i, handle = got
+                drain(handle)
+                if recorder:
+                    recorder.record("drained", i)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+            cancelled.set()
+
+    t_prod = threading.Thread(target=producer, daemon=True,
+                              name="ecpipe-prefetch")
+    t_drain = threading.Thread(target=drainer, daemon=True,
+                               name="ecpipe-drain")
+    t_prod.start()
+    t_drain.start()
+    n = 0
+    try:
+        while True:
+            got = _get(q_in)
+            if isinstance(got, _Stop) or cancelled.is_set():
+                break
+            i, item = got
+            handle = dispatch(item)
+            if recorder:
+                recorder.record("dispatched", i)
+            if not _put(q_out, (i, handle)):
+                break
+            n += 1
+    except BaseException:
+        cancelled.set()
+        raise
+    finally:
+        # Orderly finish: deliver the stop sentinel so the drainer
+        # fences and writes every in-flight handle FIFO (a full q_out
+        # blocks until it makes room); on error paths the cancel flag
+        # short-circuits the wait.  Then free a producer stuck on a
+        # full q_in, and join both sides before surfacing anything.
+        _put(q_out, _Stop())
+        cancelled.set()
+        while True:
+            try:
+                q_in.get_nowait()
+            except queue.Empty:
+                break
+        t_prod.join()
+        t_drain.join()
+    if errors:
+        raise errors[0]
+    return n
