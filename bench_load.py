@@ -21,9 +21,18 @@ REAL subprocess cluster (master + 2 volume servers), then:
    injected slow fault (volume.read delay via /debug/faults) must
    produce a /debug/slow exemplar whose trace id resolves in
    /debug/traces, flip /cluster/healthz to degraded via the latency
-   burn rate, and emit `slo.burn`.
+   burn rate, and emit `slo.burn`;
+4. (round 2) exercises the TIME-ATTRIBUTION plane: slow-exemplar
+   phase budgets must sum to >= 90% of each exemplar's wall, the p99
+   phase breakdown (where the tail's time goes) is published from the
+   live phase sketches, `cluster.profile` merges collapsed stacks
+   from every node of the subprocess cluster, and a second
+   plane-DISARMED cluster measured in the same run prices the whole
+   plane (always-on sampler + phase ledger + lock metering) as a
+   closed-loop throughput ratio — the r02 overhead row and "before"
+   baseline the ROADMAP-3 front-door refactor diffs against.
 
-Output: one JSON document (default BENCH_load_r01.json) — the BENCH
+Output: one JSON document (default BENCH_load_r02.json) — the BENCH
 series beside the EC kernel numbers.
 
 Knobs (env): BENCH_LOAD_QUICK=1 (seconds-scale smoke: the `slow`
@@ -84,18 +93,39 @@ def log(*args):
 
 
 class Cluster:
-    """Subprocess master + 2 volume servers."""
+    """Subprocess master + 2 volume servers.
 
-    def __init__(self, tmp: str):
+    attribution=True runs the full time-attribution plane (always-on
+    continuous profiler + /debug/pprof, phase ledger, lock metering);
+    attribution=False disarms all three — the overhead comparison's
+    control group, measured in the same bench run."""
+
+    def __init__(self, tmp: str, attribution: bool = True,
+                 traces: bool = True):
         from seaweedfs_tpu.cluster import rpc
         self.tmp = tmp
         self.procs: list[subprocess.Popen] = []
         env = dict(os.environ,
                    JAX_PLATFORMS="cpu",
-                   SEAWEEDFS_TPU_TRACES="1",
+                   SEAWEEDFS_TPU_TRACES="1" if traces else "0",
                    SEAWEEDFS_TPU_FAULTS_DEBUG="1",
+                   # Deterministic string hashing: without this, each
+                   # server process draws a random dict-collision
+                   # profile and cluster instances differ by a few %
+                   # throughput from SEED LUCK — fatal for an A/B
+                   # that prices a 3% plane.
+                   PYTHONHASHSEED="0",
                    SEAWEEDFS_TPU_SLO_SHORT_WINDOW=str(SHORT_WINDOW),
                    SEAWEEDFS_TPU_SLO_LONG_WINDOW=str(LONG_WINDOW))
+        if attribution:
+            env.update(SEAWEEDFS_TPU_PPROF="1",
+                       # short ring windows so ?window= has data
+                       # within bench timescales
+                       SEAWEEDFS_TPU_PPROF_WINDOW="5")
+        else:
+            env.update(SEAWEEDFS_TPU_PPROF="0",
+                       SEAWEEDFS_TPU_LOCK_METER="0",
+                       SEAWEEDFS_TPU_PHASES="0")
         mport = rpc.free_port()
         self.master_url = f"http://127.0.0.1:{mport}"
         self._spawn(["master", f"-port={mport}",
@@ -417,8 +447,175 @@ def fault_phase(cluster: Cluster, client, fids: list[str]) -> dict:
     return checks
 
 
+# Overhead rounds are deliberately SHORT: shared boxes oscillate
+# ±10-15% in available CPU on 20-40s periods, so an ABBA block must
+# complete well inside one period for its drift-cancelling algebra to
+# hold — many short blocks beat few long ones for a 2-3% effect.
+SAT_SECONDS = _env("BENCH_LOAD_SAT_SECONDS", 2.0 if QUICK else 2.5)
+SAT_WORKERS = int(_env("BENCH_LOAD_SAT_WORKERS", 6))
+SAT_ROUNDS = int(_env("BENCH_LOAD_SAT_ROUNDS", 3))
+# Overhead blocks: the on/off comparison runs ABBA round blocks
+# (on, off, off, on) — a linear machine drift inside a block hits
+# both sides symmetrically and cancels in the block's ratio
+# (sum(A) / sum(B)); the median across blocks then discards whole
+# blocks hit by a noisy-neighbor burst.
+SAT_BLOCKS = int(_env("BENCH_LOAD_SAT_BLOCKS", 3 if QUICK else 8))
+# Fresh-cluster warmup before timed rounds: a just-spawned server
+# climbs for several seconds (thread creation, allocator, page cache,
+# the scrub daemon's initial pass) — measured rounds must start past
+# that knee on BOTH sides of the overhead pair or the comparison
+# prices warmup, not the plane.
+SAT_WARMUP = _env("BENCH_LOAD_SAT_WARMUP", 6.0 if QUICK else 12.0)
+
+
+def _resolve_read_urls(cluster: Cluster, fids: list[str]) -> list[str]:
+    """Direct volume-server URLs for the fids: the saturation rounds
+    must price the SERVER plane, not client lookups."""
+    from seaweedfs_tpu.cluster.client import WeedClient
+    client = WeedClient(cluster.master_url)
+    urls = []
+    for fid in fids:
+        vid = int(fid.split(",")[0])
+        try:
+            locs = client.lookup(vid)
+        except Exception:  # noqa: BLE001
+            continue
+        if locs:
+            urls.append(f"http://{locs[0]['url']}/{fid}")
+    assert urls, "no readable fid for the saturation round"
+    return urls
+
+
+def _sat_round(urls: list[str],
+               seconds: float) -> tuple[float, int]:
+    """One closed-loop read round: SAT_WORKERS hammering random fids
+    as fast as they go; returns (achieved req/s, request count)."""
+    import random as _random
+
+    from seaweedfs_tpu.cluster import rpc
+    stop = time.perf_counter() + seconds
+    counts = [0] * SAT_WORKERS
+
+    def worker(wi: int) -> None:
+        rng = _random.Random(wi)
+        n = 0
+        while time.perf_counter() < stop:
+            try:
+                rpc.call(rng.choice(urls), timeout=10.0)
+                n += 1
+            except Exception:  # noqa: BLE001
+                pass
+        counts[wi] = n
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(SAT_WORKERS)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    total = sum(counts)
+    return total / (time.perf_counter() - t0), total
+
+
+def _server_cpu_seconds(cluster: Cluster) -> float:
+    """Summed cpu_seconds of every server process (the /admin/status
+    and /cluster/status counters) — the denominator-side of the
+    CPU-per-request overhead measurand."""
+    from seaweedfs_tpu.cluster import rpc
+    total = rpc.call(
+        f"{cluster.master_url}/cluster/status")["cpu_seconds"]
+    for u in cluster.volume_urls:
+        total += rpc.call(
+            f"http://{u}/admin/status")["cpu_seconds"]
+    return total
+
+
+def saturation_rps(cluster: Cluster, fids: list[str],
+                   warmup: float = 0.0) -> dict:
+    """Closed-loop read throughput: median of SAT_ROUNDS rounds (the
+    overhead comparison's measurand — open-loop rates are pinned by
+    the arrival schedule and can't price a 1-3% tax).  `warmup`
+    seconds of identical untimed traffic run first."""
+    urls = _resolve_read_urls(cluster, fids)
+    if warmup > 0:
+        _sat_round(urls, warmup)
+    rounds = [_sat_round(urls, SAT_SECONDS)[0]
+              for _ in range(SAT_ROUNDS)]
+    ordered = sorted(rounds)
+    return {"rounds_rps": [round(r, 1) for r in rounds],
+            "median_rps": round(ordered[len(ordered) // 2], 1),
+            "workers": SAT_WORKERS, "seconds": SAT_SECONDS,
+            "warmup": warmup}
+
+
+def phase_budget(cluster: Cluster) -> dict:
+    """Pull every slow exemplar carrying a phase budget from both
+    volume servers and check the budget-sums-to-wall invariant, plus
+    the p99 phase breakdown from the live phase sketches."""
+    from seaweedfs_tpu.cluster import rpc
+    fractions, sample = [], None
+    shares: dict[str, float] = {}
+    for url in cluster.volume_urls:
+        slow = rpc.call(f"http://{url}/debug/slow")
+        for e in slow.get("exemplars", []):
+            ph = e.get("phases")
+            if not ph or not e.get("seconds"):
+                continue
+            covered = sum(v for k, v in ph.items() if k != "queue")
+            fractions.append(covered / e["seconds"])
+            if sample is None:
+                sample = e
+            for k, v in ph.items():
+                shares[k] = shares.get(k, 0.0) + v
+    total_share = sum(shares.values()) or 1.0
+    out = {
+        "exemplars_with_phases": len(fractions),
+        "mean_fraction": round(sum(fractions) / len(fractions), 4)
+        if fractions else 0.0,
+        "min_fraction": round(min(fractions), 4) if fractions else 0.0,
+        "slow_wall_share": {k: round(v / total_share, 4)
+                            for k, v in sorted(shares.items())},
+        "sample_exemplar": sample,
+        "budget_ok": bool(fractions) and
+        (sum(fractions) / len(fractions)) >= 0.9,
+    }
+    # p99 phase breakdown of the data plane from the live sketches
+    # (SeaweedFS_request_phase_seconds source) on the first node that
+    # has one.
+    for url in cluster.volume_urls:
+        snap = rpc.call(f"http://{url}/debug/slo")
+        needle = snap.get("phases", {}).get("/needle")
+        if needle:
+            out["p99_breakdown"] = {
+                phase: round(d.get("p99", 0.0), 6)
+                for phase, d in sorted(needle.items())}
+            break
+    return out
+
+
+def cluster_profile_merge(cluster: Cluster) -> dict:
+    """Acceptance: cluster.profile across the 3-node subprocess
+    cluster merges collapsed stacks carrying frames from >= 2 distinct
+    nodes.  A live concurrent sample runs while a short read burst
+    keeps every role busy."""
+    from seaweedfs_tpu.shell.command_profile import (
+        NODE_FRAME_PREFIX, merge_cluster_profile)
+    urls = [cluster.master_url] + \
+        [f"http://{u}" for u in cluster.volume_urls]
+    merged, nodes = merge_cluster_profile(urls, seconds=1.5)
+    distinct = {stack.split(";", 1)[0] for stack in merged}
+    distinct = {f for f in distinct
+                if f.startswith(NODE_FRAME_PREFIX)}
+    return {"nodes_answering": len(nodes),
+            "nodes_in_merged_stacks": len(distinct),
+            "total_samples": sum(merged.values()),
+            "distinct_stacks": len(merged),
+            "merged_ok": len(distinct) >= 2}
+
+
 def main() -> int:
-    out_path = "BENCH_load_r01.json"
+    out_path = "BENCH_load_r02.json"
     args = sys.argv[1:]
     if "-o" in args:
         out_path = args[args.index("-o") + 1]
@@ -432,7 +629,7 @@ def main() -> int:
     sys.setswitchinterval(0.001)
 
     tmp = tempfile.mkdtemp(prefix="bench_load_")
-    cluster = Cluster(tmp)
+    cluster = Cluster(tmp, attribution=True)
     t_start = time.time()
     try:
         cluster.wait_ready()
@@ -440,9 +637,131 @@ def main() -> int:
         res = run_load(cluster)
         server_q = server_read_quantiles(cluster)
         agree = agreement(res["recent_read"], server_q)
+        log("saturation round (attribution plane ON) ...")
+        sat_on = saturation_rps(cluster, res["fids"])
+        log("merging cluster profile across the 3 nodes ...")
+        profile = cluster_profile_merge(cluster)
         checks = fault_phase(cluster, res["client"], res["fids"])
+        budget = phase_budget(cluster)
+    finally:
+        cluster.stop()
+    # Overhead comparison on ONE cluster instance: the plane is
+    # armed/disarmed at RUNTIME via POST /debug/attribution between
+    # rounds, in ABBA blocks (armed, disarmed, disarmed, armed).  Two
+    # separate clusters — even identically configured — differ by
+    # several % from instance luck alone (allocator layout, ASLR),
+    # which would drown a 2-3% effect; toggling one instance removes
+    # that term entirely, and the ABBA order cancels linear machine
+    # drift inside each block.
+    try:
+        from seaweedfs_tpu.cluster import rpc as _rpc
+        log("overhead phase: fresh plane-armed cluster "
+            "(runtime-toggled A/B) ...")
+        tmp_ov = tempfile.mkdtemp(prefix="bench_load_ov_")
+        # traces=False: the overhead cluster runs at PRODUCTION trace
+        # defaults — the 100%-sampled tracing the fault phase needs
+        # would record a span per request in BOTH A and B rounds and
+        # is not part of the plane being priced.
+        c_ov = Cluster(tmp_ov, attribution=True, traces=False)
+        try:
+            c_ov.wait_ready()
+            import numpy as np
+
+            from seaweedfs_tpu.cluster.client import WeedClient
+            rng = np.random.default_rng(1)
+            urls_ov = _resolve_read_urls(c_ov, populate(
+                WeedClient(c_ov.master_url), min(KEYS, 100), SIZE,
+                rng))
+
+            def set_plane(on: bool) -> None:
+                flag = "1" if on else "0"
+                for node in [c_ov.master_url] + \
+                        [f"http://{u}" for u in c_ov.volume_urls]:
+                    _rpc.call(f"{node}/debug/attribution"
+                              f"?enabled={flag}", "POST")
+
+            def set_plane_settled(on: bool) -> None:
+                # Short untimed burst after each flip: the first round
+                # in a new plane state runs measurably hot (profiler
+                # thread restart, branch-predictor/cache transients) —
+                # timed rounds must start in steady state.
+                set_plane(on)
+                _sat_round(urls_ov, 0.5)
+
+            def measured_round() -> tuple[float, float]:
+                """(achieved rps, server cpu-µs per request)."""
+                cpu0 = _server_cpu_seconds(c_ov)
+                rps, n = _sat_round(urls_ov, SAT_SECONDS)
+                cpu1 = _server_cpu_seconds(c_ov)
+                return rps, (cpu1 - cpu0) / max(n, 1) * 1e6
+
+            log(f"warming {SAT_WARMUP:g}s ...")
+            _sat_round(urls_ov, SAT_WARMUP)
+            rounds_on, rounds_off = [], []
+            cpu_on, cpu_off, ratios, cpu_ratios = [], [], [], []
+            for i in range(SAT_BLOCKS):
+                set_plane_settled(True)
+                a1, ca1 = measured_round()
+                set_plane_settled(False)
+                b1, cb1 = measured_round()
+                b2, cb2 = measured_round()
+                set_plane_settled(True)
+                a2, ca2 = measured_round()
+                rounds_on += [a1, a2]
+                rounds_off += [b1, b2]
+                cpu_on += [ca1, ca2]
+                cpu_off += [cb1, cb2]
+                ratios.append((a1 + a2) / (b1 + b2))
+                cpu_ratios.append((ca1 + ca2) / (cb1 + cb2))
+                log(f"  block {i} (ABBA): on {a1:.0f}/{a2:.0f} rps "
+                    f"{ca1:.0f}/{ca2:.0f} us/req, "
+                    f"off {b1:.0f}/{b2:.0f} rps "
+                    f"{cb1:.0f}/{cb2:.0f} us/req "
+                    f"(cpu ratio {cpu_ratios[-1]:.3f})")
+        finally:
+            c_ov.stop()
+            shutil.rmtree(tmp_ov, ignore_errors=True)
+
+        def _sat_doc(rounds: list[float], cpus: list[float]) -> dict:
+            ordered = sorted(rounds)
+            cpu_ordered = sorted(cpus)
+            return {"rounds_rps": [round(r, 1) for r in rounds],
+                    "median_rps": round(
+                        ordered[len(ordered) // 2], 1),
+                    "cpu_us_per_request": [round(c, 1) for c in cpus],
+                    "median_cpu_us_per_request": round(
+                        cpu_ordered[len(cpu_ordered) // 2], 1),
+                    "workers": SAT_WORKERS, "seconds": SAT_SECONDS,
+                    "warmup": SAT_WARMUP}
+
+        sat_on_fresh = _sat_doc(rounds_on, cpu_on)
+        sat_off = _sat_doc(rounds_off, cpu_off)
+        # The GATING measurand is the criterion's: end-to-end
+        # throughput (median ABBA block ratio).  Server CPU per
+        # request rides along as the sharper diagnostic — it isolates
+        # the server-side plane cost from the client/framing share of
+        # the core, so the two numbers bracket the truth: wall-clock
+        # is what users see, cpu/req is what the refactor arc should
+        # watch.
+        ratios.sort()
+        cpu_ratios.sort()
+        overhead = 1.0 - ratios[len(ratios) // 2]
+        overhead_cpu = cpu_ratios[len(cpu_ratios) // 2] - 1.0
+        overhead_doc = {
+            "on": sat_on_fresh, "off": sat_off,
+            "loaded_cluster_on": sat_on,
+            "pair_ratios": [round(r, 4) for r in ratios],
+            "cpu_pair_ratios": [round(r, 4) for r in cpu_ratios],
+            "overhead_fraction": round(overhead, 4),
+            "overhead_fraction_server_cpu": round(overhead_cpu, 4),
+            "measurand": "closed-loop throughput, median ABBA block "
+                         "ratio (runtime-toggled plane, one cluster "
+                         "instance); server cpu-us/request is the "
+                         "noise-resistant diagnostic",
+            "within_3pct": overhead < 0.03,
+        }
         doc = {
-            "bench": "load", "round": 1, "quick": QUICK,
+            "bench": "load", "round": 2, "quick": QUICK,
             "config": {"rate": RATE, "duration": DURATION,
                        "warmup": WARMUP, "keys": KEYS, "size": SIZE,
                        "workers": WORKERS, "zipf_s": ZIPF_S,
@@ -451,7 +770,10 @@ def main() -> int:
                        "slo_availability": 0.999,
                        "short_window": SHORT_WINDOW,
                        "long_window": LONG_WINDOW,
-                       "sketch_alpha": ALPHA},
+                       "sketch_alpha": ALPHA,
+                       "sat_seconds": SAT_SECONDS,
+                       "sat_workers": SAT_WORKERS,
+                       "sat_rounds": SAT_ROUNDS},
             "achieved_rps": round(res["achieved_rps"], 2),
             "target_rps": RATE,
             "totals": res["totals"],
@@ -461,6 +783,9 @@ def main() -> int:
             "server": {"read": server_q},
             "agreement": {"read": agree},
             "fault_checks": checks,
+            "phase_budget": budget,
+            "cluster_profile": profile,
+            "attribution_overhead": overhead_doc,
             "elapsed_s": round(time.time() - t_start, 1),
         }
         print(json.dumps(doc, indent=1))
@@ -472,10 +797,15 @@ def main() -> int:
               and agree["within_bound"]
               and all(checks.get(k) for k in
                       ("exemplar_recorded", "trace_resolved",
-                       "healthz_degraded", "slo_burn_emitted")))
+                       "healthz_degraded", "slo_burn_emitted"))
+              and budget["budget_ok"]
+              and profile["merged_ok"]
+              # Quick mode is a machinery smoke: seconds-scale
+              # saturation rounds are too noisy to gate a 3% ratio on
+              # (the full run gates it).
+              and (QUICK or overhead_doc["within_3pct"]))
         return 0 if ok else 1
     finally:
-        cluster.stop()
         shutil.rmtree(tmp, ignore_errors=True)
 
 

@@ -155,6 +155,10 @@ class MasterServer:
         # and live quantiles like on the other roles.
         from ..stats.slo import setup_slo_routes
         setup_slo_routes(s)
+        # Lock-contention surface: /debug/locks (holders/waiters with
+        # stacks + per-lock wait/hold counters).
+        from ..stats.contention import setup_contention_routes
+        setup_contention_routes(s)
         s.slo.set_objectives(slo_read_p99, slo_availability)
         reg.gauge("SeaweedFS_master_volume_count",
                   "registered volume replicas cluster-wide",

@@ -35,6 +35,8 @@ from typing import Callable
 
 from ..events import journal as _events
 from ..fault import registry as _fault
+from ..stats import contention as _contention
+from ..stats import phases as _phases
 from ..stats.metrics import Counter, Gauge
 from ..trace import tracer as _tracer
 from . import resilience as _res
@@ -199,7 +201,19 @@ class _Lane:
         self.inflight = 0
         self.waiting = 0
         self.shed = 0
-        self._lock = threading.Lock()
+        # Metered (stats/contention.py) only when a concurrency cap is
+        # configured: with cap=0 this lock guards a bare in-flight
+        # counter on EVERY request and admission can never queue or
+        # shed — wrapping it would stretch a ~100ns critical section
+        # into ~1µs of Python bookkeeping under the GIL (a measured
+        # ~5% throughput tax at 4k req/s) for a lock whose contention
+        # explains nothing.  With a cap, lane behavior IS the
+        # front-door story and the metering earns its cost.
+        # hold_observe_min: the normal hold is two counter increments;
+        # only pathological holds deserve histogram rows.
+        self._lock = _contention.MeteredLock(
+            f"admission.{name}", hold_observe_min=1e-3) \
+            if cap > 0 else threading.Lock()
         self._last_shed_emit = 0.0
 
     def enter(self) -> bool:
@@ -259,7 +273,12 @@ class _Lane:
 # reachable exactly when the server is overloaded or draining (which is
 # when they are needed), heartbeats keep the master's liveness view
 # honest, and long-lived push streams (/cluster/watch) would pin a lane
-# slot forever.
+# slot forever.  The /debug/ PREFIX exemption below covers the whole
+# profiling plane (/debug/pprof/*, /debug/locks, /debug/slow, ...):
+# a 30s blocking profile runs exactly when the server is saturated —
+# the one moment it must not occupy a read-lane slot and compete with
+# the traffic being diagnosed (asserted by
+# tests/test_attribution.py's saturated-server profile test).
 _ADMISSION_EXEMPT = {"/metrics", "/cluster/healthz", "/heartbeat",
                      "/admin/drain", "/admin/status", "/cluster/watch"}
 
@@ -628,6 +647,16 @@ class JsonHttpServer:
                   "(fast burn >= 14.4 degrades /cluster/healthz)",
                   ("role", "slo", "window"),
                   callback=self.slo.burn_gauge_values)
+        # Time-attribution plane (stats/phases.py): live windowed
+        # quantiles of each request phase — where the wall time of
+        # this role's requests actually goes, per endpoint family.
+        reg.gauge("SeaweedFS_request_phase_seconds",
+                  "live request phase-time quantiles over the sliding "
+                  "window (queue/lock/handler/disk/device/"
+                  "rpc_downstream; same sketch bounds as the request "
+                  "quantiles)",
+                  ("role", "family", "phase", "q"),
+                  callback=self.slo.phase_gauge_values)
         # RPC-plane resilience instruments are process-global singletons
         # (every role's outbound client shares the pool + breakers);
         # registering them here puts retry counts, breaker states, and
@@ -640,6 +669,13 @@ class JsonHttpServer:
         # counts by lane and the live in-flight gauge.
         reg.register_once(requests_shed_total)
         reg.register_once(inflight_requests)
+        # Lock-contention metering (stats/contention.py) and the
+        # continuous profiler's runnable-threads gauge — process-global
+        # singletons like the breaker/fault instruments above.
+        reg.register_once(_contention.lock_wait_seconds)
+        reg.register_once(_contention.lock_hold_seconds)
+        from ..utils.pprof import runnable_threads as _runnable
+        reg.register_once(_runnable)
         if serve_route:
             self.serve_metrics_route(reg)
         return reg
@@ -858,6 +894,7 @@ class JsonHttpServer:
         # keep-alive framing survives a shed.  Exempt paths
         # (introspection, heartbeats, push streams) skip the gate.
         lane = None
+        queue_wait = 0.0
         if not _admission_exempt(req_path):
             lane = self.admission.lane_for(method, headers, query)
             t_gate = time.perf_counter()
@@ -880,19 +917,26 @@ class JsonHttpServer:
                      f"{self.admission.retry_after:g}"},
                     close=not keep)
                 return keep
+            # Admitted (possibly after a bounded wait): the wait is
+            # the request's `queue` phase — seeded into the ledger so
+            # slow exemplars show admission pressure, not mystery wall.
+            queue_wait = time.perf_counter() - t_gate
         try:
             return self._dispatch(conn, method, req_path, headers,
-                                  query, body, fn, args, keep)
+                                  query, body, fn, args, keep,
+                                  queue_wait)
         finally:
             if lane is not None:
                 lane.exit()
 
     def _observe_request(self, method: str, req_path: str, status: int,
-                         seconds: float, trace_id: str = "") -> None:
+                         seconds: float, trace_id: str = "",
+                         phases: dict | None = None) -> None:
         """One request observed: request counter + the labeled latency
         histogram (method / endpoint-family / status-class) + the SLO
-        plane (windowed quantiles, burn windows, slow exemplars).
-        Excludes the scrape endpoint where /metrics IS the scrape."""
+        plane (windowed quantiles, burn windows, slow exemplars, the
+        per-phase time budget).  Excludes the scrape endpoint where
+        /metrics IS the scrape."""
         if self._metrics_route and req_path == "/metrics":
             return
         metrics = self.metrics
@@ -905,11 +949,12 @@ class JsonHttpServer:
         hist.observe(seconds, type=method, family=family,
                      status=f"{status // 100}xx")
         if self.slo is not None:
-            self.slo.observe(family, method, status, seconds, trace_id)
+            self.slo.observe(family, method, status, seconds, trace_id,
+                             phases)
 
     def _dispatch(self, conn, method: str, req_path: str,
                   headers: dict, query: dict, body, fn, args,
-                  keep: bool) -> bool:
+                  keep: bool, queue_wait: float = 0.0) -> bool:
         """Run the routed handler and write its response — the back
         half of _serve_one, split out so the admission gate can wrap
         it in one try/finally slot release."""
@@ -933,15 +978,36 @@ class JsonHttpServer:
             tspan = _tracer.begin_server_span(
                 self.trace_service, method, req_path,
                 headers.get("traceparent", ""))
+        # Phase ledger (stats/phases.py): opened on this thread for
+        # the handler's lifetime; instrumentation anywhere below
+        # (metered locks, disk wrappers, EC device timers, outbound
+        # rpc) accumulates into it.  Seeded with the admission wait.
+        ledger = _phases.start(queue_wait)
 
         def _observe(status: int) -> None:
             # Status is known at every exit (unlike the pre-SLO finally
             # block, which observed before the handler's tuple was
             # parsed) — that is what makes the status-class label and
-            # the exemplar's trace id possible.
+            # the exemplar's trace id possible.  The ledger closes
+            # FIRST (computing the `handler` residual) and rides the
+            # span — phases must land before end_server_span snapshots
+            # the span into the trace ring — then the SLO observation.
+            # Materialization is LAZY: the budget dict is built only
+            # for spans that will actually be recorded (sampled, or
+            # slow enough for the always-sample trigger); fast
+            # unsampled requests never pay it here, and the SLO layer
+            # materializes on its own only for exemplars/sketch
+            # samples.
+            seconds = time.perf_counter() - t0
+            ph = _phases.finish(ledger) if ledger is not None else None
+            if tspan is not None and ph is not None and (
+                    tspan.sampled
+                    or seconds >= _tracer.slow_threshold_seconds()):
+                tspan.attrs["phases"] = ph.to_dict()
+            _tracer.end_server_span(tspan, status)
             self._observe_request(
-                method, req_path, status, time.perf_counter() - t0,
-                tspan.trace_id if tspan is not None else "")
+                method, req_path, status, seconds,
+                tspan.trace_id if tspan is not None else "", ph)
 
         try:
             result = fn(*args)
@@ -949,11 +1015,9 @@ class JsonHttpServer:
             # Injected mid-exchange disconnect (fault `drop` kind): no
             # response bytes, just a dead connection — the client sees
             # EOF exactly as if the process was killed.
-            _tracer.end_server_span(tspan, 500)
             _observe(500)
             return False
         except RpcError as e:
-            _tracer.end_server_span(tspan, e.status)
             _observe(e.status)
             if not self._finish_stream_body(body):
                 keep = False
@@ -961,7 +1025,6 @@ class JsonHttpServer:
                           e.headers or None, close=not keep)
             return keep
         except ConnectionError as e:
-            _tracer.end_server_span(tspan, 500)
             _observe(500)
             if isinstance(body, BodyReader) and body.truncated:
                 # Truncated streaming body: the wire framing is gone,
@@ -977,7 +1040,6 @@ class JsonHttpServer:
                           None, close=not keep)
             return keep
         except Exception as e:  # noqa: BLE001
-            _tracer.end_server_span(tspan, 500)
             _observe(500)
             if not self._finish_stream_body(body):
                 keep = False
@@ -999,7 +1061,6 @@ class JsonHttpServer:
         # Span end covers handler execution, not the response write (a
         # slow reader streaming a 30GB body is not server time) — and
         # the histogram/SLO observation matches that boundary.
-        _tracer.end_server_span(tspan, status)
         _observe(status)
         self._respond(conn, method, status, payload, extra,
                       close=not keep)
@@ -1251,7 +1312,12 @@ class _ConnPool:
     def __init__(self, max_idle_per_host: int = 32):
         self.max_idle = max_idle_per_host
         self._idle: dict[tuple, list[_Conn]] = {}
-        self._lock = threading.Lock()
+        # Metered (stats/contention.py): every outbound RPC takes this
+        # lock at least once; a convoy here serializes the whole
+        # client plane, so it must show up in the wait histogram.
+        # Holds are dict pushes/pops — histogram only the pathological.
+        self._lock = _contention.MeteredLock("rpc.pool",
+                                             hold_observe_min=1e-3)
         # Bumped on TLS-plane changes: connections from an older
         # generation are never re-pooled, so a rotated client identity
         # can't keep riding sessions negotiated under the old one.
@@ -1488,18 +1554,22 @@ def _raise_rpc_error(resp: _Resp, data: bytes) -> None:
 def call(url: str, method: str = "GET", body: bytes | None = None,
          timeout: float = 10.0, headers: dict | None = None):
     """HTTP call returning parsed JSON (dict) or raw bytes."""
-    resp, conn = _request(url, method, body, timeout,
-                          req_headers=headers)
-    try:
-        if method == "HEAD":
-            data = b""         # no body follows a HEAD response even
-            resp._done = True  # when Content-Length advertises one
-        else:
-            data = resp.read()
-    except Exception:
-        conn.close()
-        raise
-    _finish(conn, resp)
+    # Phase attribution: a handler blocked here is waiting on a
+    # downstream server, not burning its own CPU — the whole
+    # round-trip (send + response body) lands in `rpc_downstream`.
+    with _phases.phase("rpc_downstream"):
+        resp, conn = _request(url, method, body, timeout,
+                              req_headers=headers)
+        try:
+            if method == "HEAD":
+                data = b""        # no body follows a HEAD response
+                resp._done = True  # even when Content-Length says so
+            else:
+                data = resp.read()
+        except Exception:
+            conn.close()
+            raise
+        _finish(conn, resp)
     if resp.status >= 400:
         _raise_rpc_error(resp, data)
     if (resp.getheader("content-type") or "").startswith(
@@ -1515,14 +1585,15 @@ def call_status(url: str, method: str = "GET",
     HTTP errors — for endpoints whose status code IS the answer and
     whose error responses carry a full JSON document
     (/cluster/healthz)."""
-    resp, conn = _request(url, method, body, timeout,
-                          req_headers=headers)
-    try:
-        data = resp.read()
-    except Exception:
-        conn.close()
-        raise
-    _finish(conn, resp)
+    with _phases.phase("rpc_downstream"):
+        resp, conn = _request(url, method, body, timeout,
+                              req_headers=headers)
+        try:
+            data = resp.read()
+        except Exception:
+            conn.close()
+            raise
+        _finish(conn, resp)
     if (resp.getheader("content-type") or "").startswith(
             "application/json"):
         try:
@@ -1540,40 +1611,41 @@ def call_to_file(url: str, path: str, timeout: float = 600.0,
     land in a `.dl.tmp` sibling renamed into place only on a complete
     transfer, so a truncated download never masquerades as a valid
     shard/volume file at the destination path."""
-    resp, conn = _request(url, "GET", None, timeout,
-                          req_headers=headers)
-    if resp.status >= 400:
+    with _phases.phase("rpc_downstream"):
+        resp, conn = _request(url, "GET", None, timeout,
+                              req_headers=headers)
+        if resp.status >= 400:
+            try:
+                data = resp.read()
+            except Exception:
+                conn.close()
+                raise
+            _finish(conn, resp)
+            _raise_rpc_error(resp, data)
+        tmp = path + ".dl.tmp"
         try:
-            data = resp.read()
+            with open(tmp, "wb") as f:
+                total = 0
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    total += len(chunk)
+            clen = resp.getheader("content-length")
+            if clen is not None and total != int(clen):
+                raise ConnectionError(
+                    f"incomplete download: got {total} of {clen} bytes")
         except Exception:
             conn.close()
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
             raise
+        os.replace(tmp, path)
         _finish(conn, resp)
-        _raise_rpc_error(resp, data)
-    tmp = path + ".dl.tmp"
-    try:
-        with open(tmp, "wb") as f:
-            total = 0
-            while True:
-                chunk = resp.read(1 << 20)
-                if not chunk:
-                    break
-                f.write(chunk)
-                total += len(chunk)
-        clen = resp.getheader("content-length")
-        if clen is not None and total != int(clen):
-            raise ConnectionError(
-                f"incomplete download: got {total} of {clen} bytes")
-    except Exception:
-        conn.close()
-        try:
-            os.remove(tmp)
-        except OSError:
-            pass
-        raise
-    os.replace(tmp, path)
-    _finish(conn, resp)
-    return total
+        return total
 
 
 class StreamHandle:

@@ -207,6 +207,11 @@ class VolumeServer:
         from ..stats.slo import setup_slo_routes
         setup_slo_routes(s)
         self.server.slo.set_objectives(slo_read_p99, slo_availability)
+        # Lock-contention surface: /debug/locks — the volume write
+        # lock, ecc sidecar lock, and admission-lane locks all report
+        # here with their current holders/waiters.
+        from ..stats.contention import setup_contention_routes
+        setup_contention_routes(s)
         # Heavy hitters (stats/hotkeys.py): hot volumes / needles /
         # client IPs on the read+write data paths, for /debug/hot and
         # the shell's cluster.hot — the cache/packing target list.

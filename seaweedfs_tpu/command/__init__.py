@@ -112,6 +112,12 @@ def usage() -> str:
         "-events=false unmounts /debug/events + /cluster/events",
         "  -debug.traces / -debug.faults   mount /debug/traces and "
         "/debug/faults",
+        "  -pprof                mount /debug/pprof + start the "
+        "always-on continuous profiler",
+        "  -pprof.hz / -pprof.window       sampler rate (default 19) "
+        "and ring-window seconds (default 60)",
+        "  -lock.meter=false / -phases=false   disarm lock-contention "
+        "metering / the request phase ledger",
     ]
     return "\n".join(lines)
 
@@ -162,6 +168,32 @@ def main(argv: list[str] | None = None) -> int:
         os.environ["SEAWEEDFS_TPU_TRACE_SAMPLE"] = flags.get("trace.sample")
     if flags.get("trace.slowMs"):
         os.environ["SEAWEEDFS_TPU_TRACE_SLOW_MS"] = flags.get("trace.slowMs")
+    # Time-attribution plane knobs (utils/pprof.py, stats/contention,
+    # stats/phases read these when servers construct): -pprof mounts
+    # the /debug/pprof surface AND starts the always-on continuous
+    # profiler; -pprof.hz / -pprof.window tune its sample rate and
+    # ring-window size; -pprof.continuous=false keeps the routes but
+    # not the sampler; -lock.meter=false and -phases=false disarm
+    # lock metering / the per-request phase ledger (the overhead-bench
+    # toggles — both default on).
+    if flags.get_bool("pprof", False):
+        os.environ["SEAWEEDFS_TPU_PPROF"] = "1"
+    if flags.get("pprof.hz"):
+        os.environ["SEAWEEDFS_TPU_PPROF_HZ"] = flags.get("pprof.hz")
+    if flags.get("pprof.window"):
+        os.environ["SEAWEEDFS_TPU_PPROF_WINDOW"] = \
+            flags.get("pprof.window")
+    if "pprof.continuous" in flags and \
+            not flags.get_bool("pprof.continuous", True):
+        os.environ["SEAWEEDFS_TPU_PPROF_CONTINUOUS"] = "0"
+    if "lock.meter" in flags and not flags.get_bool("lock.meter", True):
+        os.environ["SEAWEEDFS_TPU_LOCK_METER"] = "0"
+        from ..stats import contention
+        contention.ENABLED = False
+    if "phases" in flags and not flags.get_bool("phases", True):
+        os.environ["SEAWEEDFS_TPU_PHASES"] = "0"
+        from ..stats import phases
+        phases.ENABLED = False
     # Fault-injection / resilience knobs (fault/registry.py and
     # cluster/resilience.py read these env vars when the first server
     # constructs — after this block):  -faults "point=spec;..." arms

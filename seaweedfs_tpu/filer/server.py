@@ -169,6 +169,10 @@ class FilerServer:
         from ..stats.slo import setup_slo_routes
         setup_slo_routes(s)
         s.slo.set_objectives(slo_read_p99, slo_availability)
+        # Lock-contention surface, same literal-route-wins stance as
+        # the /debug surfaces above.
+        from ..stats.contention import setup_contention_routes
+        setup_contention_routes(s)
         self.metrics_server = None
         if metrics_port is not None:
             self.metrics_server = rpc.JsonHttpServer(host, metrics_port)

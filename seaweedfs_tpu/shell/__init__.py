@@ -17,3 +17,4 @@ from . import command_misc  # noqa: F401,E402
 from . import command_trace  # noqa: F401,E402
 from . import command_fault  # noqa: F401,E402
 from . import command_cluster  # noqa: F401,E402
+from . import command_profile  # noqa: F401,E402

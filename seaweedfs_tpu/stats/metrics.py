@@ -184,6 +184,37 @@ class Histogram(_Metric):
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
 
+    def count(self, **labels) -> int:
+        """Observation count of one series (0 when never observed)."""
+        with self._lock:
+            return self._totals.get(self._key(labels), 0)
+
+    def series(self, **labels):
+        """Pre-resolved single-series observe handle for hot paths
+        (per-request lock metering): label validation, key building,
+        and slot allocation happen ONCE here; each observe() is then
+        a bisect + one locked list/float update — ~3x cheaper than
+        the labeled observe().  Exposition reads the same storage."""
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.buckets) + 1))
+            self._sums.setdefault(k, 0.0)
+            self._totals.setdefault(k, 0)
+        hist = self
+
+        class _Series:
+            __slots__ = ()
+
+            @staticmethod
+            def observe(value: float) -> None:
+                i = bisect.bisect_left(hist.buckets, value)
+                with hist._lock:
+                    counts[i] += 1
+                    hist._sums[k] += value
+                    hist._totals[k] += 1
+        return _Series()
+
     def time(self, **labels):
         """Context manager: observe elapsed seconds."""
         hist = self
@@ -303,6 +334,13 @@ def observe_ec_stage(stage: str, seconds: float, nbytes: int = 0) -> None:
     ec_stage_seconds.observe(seconds, stage=stage)
     if nbytes:
         ec_stage_bytes.inc(nbytes, stage=stage)
+    # Time-attribution: execution-fenced device legs observed while a
+    # request ledger is active (a degraded read's EC reconstruction,
+    # an inline repair's decode) land in that request's `device`
+    # phase; host staging / fan-out stages stay in `handler`/`rpc`.
+    if "kernel" in stage or "device" in stage:
+        from . import phases as _phases
+        _phases.note("device", seconds)
 
 
 # -- data-integrity instruments ---------------------------------------------

@@ -53,6 +53,10 @@ SLOW_BURN = 6.0
 
 QUANTILES = (0.5, 0.95, 0.99)
 
+# Phase-sketch subsampling: every K-th request feeds the per-phase
+# windowed sketches (slow requests always do) — see observe().
+PHASE_SAMPLE_EVERY = 8
+
 # Families that are cluster control/introspection traffic, excluded
 # from the aggregate read/write sketches and the burn windows: a
 # failing admin call is an operator's problem, not an SLO violation,
@@ -191,6 +195,15 @@ class SloTracker:
         self._lock = threading.Lock()
         # (family, status_class) -> WindowedSketch over the short window
         self._sketches: dict[tuple[str, str], WindowedSketch] = {}
+        # Time-attribution plane (stats/phases.py): (family, phase) ->
+        # WindowedSketch of that phase's per-request seconds.  Bounded:
+        # families are bounded by the route table, phases by
+        # phases.PHASES.  Fed by a 1-in-PHASE_SAMPLE_EVERY subsample
+        # (slow requests always included), so the sketches skew toward
+        # the tail they exist to explain while the per-request cost
+        # stays flat.
+        self._phase_sketches: dict[tuple[str, str], WindowedSketch] = {}
+        self._phase_tick = 0
         # Aggregate data-plane sketches by op class — what heartbeats
         # ship and healthz merges.
         self._agg = {op: WindowedSketch(alpha=alpha, window=short_window,
@@ -215,7 +228,8 @@ class SloTracker:
     # -- observation (rpc middleware hot path) -------------------------------
 
     def observe(self, family: str, method: str, status: int,
-                seconds: float, trace_id: str = "") -> None:
+                seconds: float, trace_id: str = "",
+                phases: dict | None = None) -> None:
         sc = f"{status // 100}xx"
         key = (family, sc)
         sk = self._sketches.get(key)
@@ -227,6 +241,41 @@ class SloTracker:
                                         slices=self.slices,
                                         clock=self.clock))
         sk.observe(seconds)
+        # Hoisted once: the threshold feeds both the phase-sketch
+        # sample condition and the exemplar branch below.  (Distinct
+        # from the burn engine's read-SLO `slow` flag computed in the
+        # data-plane block.)
+        exemplar_slow = seconds > (self.objectives.read_p99
+                                   or DEFAULT_EXEMPLAR_THRESHOLD)
+        phase_dict = None
+        if phases is not None:
+            # `phases` is a stats.phases.Ledger (rpc middleware) or a
+            # plain dict (tests / direct callers); the Ledger is
+            # materialized LAZILY — only for the consumers below.
+            # Phase sketches are fed from a deterministic 1-in-K
+            # uniform subsample: quantiles of a uniform subsample are
+            # unbiased, and at per-request rates the 3-4 extra sketch
+            # observes would be the plane's single biggest tax.  Slow
+            # exemplars and trace spans carry FULL budgets regardless
+            # — only the aggregate quantile feed is thinned.
+            self._phase_tick += 1
+            if exemplar_slow or \
+                    self._phase_tick >= PHASE_SAMPLE_EVERY:
+                self._phase_tick = 0
+                phase_dict = phases.to_dict() \
+                    if hasattr(phases, "to_dict") else phases
+                for phase, p_seconds in phase_dict.items():
+                    pkey = (family, phase)
+                    psk = self._phase_sketches.get(pkey)
+                    if psk is None:
+                        with self._lock:
+                            psk = self._phase_sketches.setdefault(
+                                pkey, WindowedSketch(
+                                    alpha=self.alpha,
+                                    window=self.short_window,
+                                    slices=self.slices,
+                                    clock=self.clock))
+                    psk.observe(p_seconds)
         if data_plane(family):
             read = method in ("GET", "HEAD")
             if status == 429:
@@ -244,12 +293,22 @@ class SloTracker:
                         and seconds > self.objectives.read_p99)
                 self._burn_short.add(bad, slow, read)
                 self._burn_long.add(bad, slow, read)
-        if seconds > self.exemplar_threshold():
+        if exemplar_slow:
             self.exemplars_recorded += 1
-            self._exemplars.append({
+            doc = {
                 "ts": time.time(), "family": family, "method": method,
                 "status": status, "seconds": round(seconds, 6),
-                "trace_id": trace_id})
+                "trace_id": trace_id}
+            if phases is not None:
+                # The slow request's time budget rides the exemplar:
+                # /debug/slow answers "slow doing WHAT" inline instead
+                # of sending the operator to cross-reference a trace.
+                if phase_dict is None:
+                    phase_dict = phases.to_dict() \
+                        if hasattr(phases, "to_dict") else phases
+                doc["phases"] = {k: round(v, 6)
+                                 for k, v in phase_dict.items()}
+            self._exemplars.append(doc)
 
     # -- burn-rate engine ----------------------------------------------------
 
@@ -357,6 +416,39 @@ class SloTracker:
                     merged.quantile(q)
         return out
 
+    def phase_gauge_values(self) -> dict:
+        """Gauge callback for SeaweedFS_request_phase_seconds
+        {role, family, phase, q} — live windowed phase-time quantiles
+        (the per-role answer to "where does request time go")."""
+        out: dict[tuple, float] = {}
+        with self._lock:
+            items = list(self._phase_sketches.items())
+        for (family, phase), wsk in items:
+            merged = wsk.merged()
+            if merged.count == 0:
+                continue
+            for q in QUANTILES:
+                out[(self.role, family, phase, f"{q:g}")] = \
+                    merged.quantile(q)
+        return out
+
+    def phase_quantiles(self) -> dict:
+        """JSON view of the live phase sketches, grouped by family —
+        the /debug/slo `phases` section and the bench's p99 breakdown
+        source."""
+        with self._lock:
+            items = list(self._phase_sketches.items())
+        out: dict[str, dict] = {}
+        for (family, phase), wsk in items:
+            merged = wsk.merged()
+            if merged.count == 0:
+                continue
+            out.setdefault(family, {})[phase] = {
+                "count": merged.count,
+                **{f"p{int(q * 100)}": merged.quantile(q)
+                   for q in QUANTILES}}
+        return out
+
     def burn_gauge_values(self) -> dict:
         """Gauge callback for SeaweedFS_slo_burn_rate{role, slo,
         window}; empty when no objective is declared."""
@@ -412,6 +504,7 @@ class SloTracker:
                 "exemplars_recorded": self.exemplars_recorded,
                 "burn": self.burn_state(),
                 "families": families,
+                "phases": self.phase_quantiles(),
                 "read": {"quantiles": self.agg_quantiles("read"),
                          "sketch": self._agg["read"].to_dict()},
                 "write": {"quantiles": self.agg_quantiles("write"),

@@ -27,6 +27,8 @@ from ..core.replica_placement import ReplicaPlacement
 from ..core.super_block import SUPER_BLOCK_SIZE, SuperBlock
 from ..core.ttl import TTL
 from ..fault import registry as _fault
+from ..stats import contention as _contention
+from ..stats import phases as _phases
 from ..utils.rwlock import RWLock
 from .needle_map import new_needle_map
 
@@ -178,11 +180,17 @@ class Volume:
         self.collection = collection
         self.vid = vid
         self.readonly = False
-        self._lock = threading.RLock()
+        # Metered (stats/contention.py): the append lock is THE
+        # serialization point of the write path — its wait histogram
+        # is where a write convoy becomes visible, and waits land in
+        # the blocked request's `lock` phase.
+        self._lock = _contention.MeteredLock("volume.write",
+                                             threading.RLock())
         # Readers-writer discipline like the reference's dataFileAccessLock:
         # concurrent preads; exclusive for write batches and the vacuum
-        # file swap.
-        self._file_lock = RWLock()
+        # file swap.  Write-side waits/holds are metered as
+        # "volume.file" (read side stays free).
+        self._file_lock = RWLock(name="volume.file")
         # Vacuum staging state lives on the Volume (volume_vacuum.go
         # keeps it on the Volume struct) so the in-process planes —
         # gRPC facade and JSON admin — serialize on the same guard and
@@ -426,15 +434,16 @@ class Volume:
             # (which synchronize on _file_lock.write() only), _lock
             # orders appends.
             with self._file_lock.write(), self._lock:
-                off, size = self._write_record_locked(n)
-                self._dat.flush()
-                if fsync:
-                    os.fsync(self._dat.fileno())
-                self.nm.put(n.id, off, n.size)
-                if fsync:
-                    self.nm.sync()
-                else:
-                    self.nm.flush()
+                with _phases.phase("disk"):
+                    off, size = self._write_record_locked(n)
+                    self._dat.flush()
+                    if fsync:
+                        os.fsync(self._dat.fileno())
+                    self.nm.put(n.id, off, n.size)
+                    if fsync:
+                        self.nm.sync()
+                    else:
+                        self.nm.flush()
                 self.last_modified = time.time()
                 return off, size
         req = _WriteReq(needle=n, done=threading.Event())
@@ -445,7 +454,11 @@ class Volume:
             req.error = req.error or VolumeError(
                 f"volume {self.vid} is closed")
             req.done.set()
-        req.done.wait()
+        # The batch worker appends + fsyncs on its own thread; this
+        # handler's wall time spent waiting on it IS the request's
+        # disk time (write + shared group fsync).
+        with _phases.phase("disk"):
+            req.done.wait()
         if req.error:
             raise req.error
         return req.offset, req.size
@@ -600,10 +613,19 @@ class Volume:
                 # exact failure mode of a dying sector.
                 _fault.hit("disk.read", vid=self.vid,
                            key=f"{needle_id:x}")
+            # Inline disk-phase accounting (not the phases.phase ctx):
+            # this is THE hot read path — the context manager's object
+            # allocation and two method calls are measurable at
+            # thousands of reads/sec, the inline form is not.
+            _led = _phases.active()
+            _t = time.perf_counter() if _led is not None else 0.0
             if self.remote_file is not None:
                 blob = self.remote_file.pread(total, offset)
             else:
                 blob = os.pread(self._dat.fileno(), total, offset)
+            if _led is not None:
+                _led.arr[_phases.IDX_DISK] += \
+                    time.perf_counter() - _t
         try:
             n = Needle.from_bytes(blob, self.version)
         except ValueError as e:
@@ -623,9 +645,10 @@ class Volume:
         with self._file_lock.read():
             if _fault.ARMED:
                 _fault.hit("disk.read", vid=self.vid)
-            if self.remote_file is not None:
-                return self.remote_file.pread(size, offset)
-            return os.pread(self._dat.fileno(), size, offset)
+            with _phases.phase("disk"):
+                if self.remote_file is not None:
+                    return self.remote_file.pread(size, offset)
+                return os.pread(self._dat.fileno(), size, offset)
 
     def read_needle_slice(self, needle_id: int,
                           cookie: int | None = None,
@@ -691,14 +714,18 @@ class Volume:
                 fd, 4, offset + t.NEEDLE_HEADER_SIZE + size))
             crc = 0
             pos, remaining = data_off, data_size
-            while remaining:
-                chunk = os.pread(fd, min(remaining, 4 << 20), pos)
-                if not chunk:
-                    raise VolumeError(
-                        f"needle {needle_id:x} truncated")
-                crc = crc_mod.crc32c(chunk, crc)
-                pos += len(chunk)
-                remaining -= len(chunk)
+            # Attributed to `disk`: the streaming CRC pass is the read
+            # path's per-byte payload verification — its cost scales
+            # with the bytes pread, not with handler logic.
+            with _phases.phase("disk"):
+                while remaining:
+                    chunk = os.pread(fd, min(remaining, 4 << 20), pos)
+                    if not chunk:
+                        raise VolumeError(
+                            f"needle {needle_id:x} truncated")
+                    crc = crc_mod.crc32c(chunk, crc)
+                    pos += len(chunk)
+                    remaining -= len(chunk)
             if crc_mod.masked_value(crc) != stored:
                 raise CorruptNeedleError(
                     f"CRC error on needle {needle_id:x}")

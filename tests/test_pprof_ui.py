@@ -12,6 +12,17 @@ from seaweedfs_tpu.cluster.volume_server import VolumeServer
 from seaweedfs_tpu.filer.server import FilerServer
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _stop_continuous_profiler():
+    """Mounting pprof routes starts the process-wide continuous
+    profiler; stop it on module exit so its 19Hz sampling (and its
+    traced allocations) can't skew later test modules."""
+    yield
+    from seaweedfs_tpu.utils.pprof import PROFILER
+    if PROFILER is not None:
+        PROFILER.stop()
+
+
 @pytest.fixture(scope="module")
 def stack(tmp_path_factory):
     import os
@@ -139,6 +150,88 @@ def test_pprof_routes_absent_without_optin(tmp_path):
 
 
 import urllib.error  # noqa: E402
+
+
+def _get_status(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_profile_seconds_validation(stack):
+    """Satellite: ?seconds= must 400 on unparseable/NaN/inf and clamp
+    negative/zero/huge into [0.1, 30] instead of looping oddly."""
+    master, _vs, _filer = stack
+    base = f"{master.url()}/debug/pprof/profile"
+    for bad in ("abc", "NaN", "nan", "inf", "-inf", "1e999"):
+        st, body = _get_status(f"{base}?seconds={bad}")
+        assert st == 400, (bad, st, body)
+    # Clamped low: returns fast with a tiny live sample.
+    t0 = time.time()
+    st, body = _get_status(f"{base}?seconds=-5")
+    assert st == 200 and time.time() - t0 < 2.0
+    st, body = _get_status(f"{base}?seconds=0")
+    assert st == 200
+    # Measured rate is reported in the header line, not the nominal.
+    assert b"Hz measured" in body
+    st, body = _get_status(f"{master.url()}/debug/pprof/heap?top=xyz")
+    assert st == 400
+
+
+def test_heap_start_stop_race_serialized(stack):
+    """Satellite: concurrent /debug/pprof/heap start/snapshot/stop
+    calls race tracemalloc's process-global world switch — they must
+    serialize behind the handler lock, never 500."""
+    import tracemalloc
+    master, _vs, _filer = stack
+    base = f"{master.url()}/debug/pprof/heap"
+    statuses = []
+    lock = threading.Lock()
+
+    def hammer(i):
+        for j in range(6):
+            url = base + ("?stop=true" if (i + j) % 3 == 0 else "")
+            st, _ = _get_status(url)
+            with lock:
+                statuses.append(st)
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    try:
+        assert all(st == 200 for st in statuses), statuses
+    finally:
+        _get_status(base + "?stop=true")
+        assert not tracemalloc.is_tracing()
+
+
+def test_sample_stacks_rate_drift_compensated():
+    """Satellite: the sampler schedules ticks on an absolute grid, so
+    collection cost no longer erodes the delivered rate; callers get
+    the measured elapsed to report real Hz."""
+    from seaweedfs_tpu.utils.pprof import sample_stacks
+    stop = threading.Event()
+    threads = [threading.Thread(
+        target=lambda: stop.wait(5.0), daemon=True)
+        for _ in range(24)]  # many threads = real collection cost
+    for t in threads:
+        t.start()
+    try:
+        counts, samples, elapsed = sample_stacks(0.5, hz=80.0)
+    finally:
+        stop.set()
+    assert counts and samples > 0
+    measured = samples / elapsed
+    # Old behavior: sleep(interval) AFTER collecting -> delivered rate
+    # = 1/(interval + cost), well under 80 with 24 threads.  The grid
+    # scheduler holds it near nominal (CI-tolerant band).
+    assert measured > 55.0, f"measured only {measured:.1f}Hz"
+    assert elapsed == pytest.approx(0.5, abs=0.1)
 
 
 def test_cpuprofile_flag_writes_collapsed_stacks(tmp_path):

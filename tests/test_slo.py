@@ -743,3 +743,10 @@ def test_bench_load_quick_mode(tmp_path):
     fc = doc["fault_checks"]
     assert fc["exemplar_recorded"] and fc["trace_resolved"]
     assert fc["healthz_degraded"] and fc["slo_burn_emitted"]
+    # Round 2: the time-attribution acceptance rows.
+    pb = doc["phase_budget"]
+    assert pb["exemplars_with_phases"] > 0
+    assert pb["budget_ok"], pb
+    assert doc["cluster_profile"]["merged_ok"], doc["cluster_profile"]
+    ov = doc["attribution_overhead"]
+    assert ov["on"]["median_rps"] > 0 and ov["off"]["median_rps"] > 0
