@@ -71,7 +71,8 @@ class MasterServer:
                  max_concurrent: int = 0,
                  idle_timeout: float = 120.0,
                  slo_read_p99: float | None = None,
-                 slo_availability: float | None = None):
+                 slo_availability: float | None = None,
+                 replication_lag_slo: float | None = None):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
@@ -107,6 +108,11 @@ class MasterServer:
         self.vg = VolumeGrowth()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        # Cross-cluster mirroring lag SLO (-replicate.lag.slo,
+        # seconds): healthz degrades (503) while any mirrored volume's
+        # oldest unacked change-log record is older than this, and
+        # recovers when the standby catches up.
+        self.replication_lag_slo = replication_lag_slo
         # Overload protection (-max.concurrent): bounded assignment/
         # lookup concurrency with 429 sheds; /heartbeat, healthz, and
         # the watch streams are admission-exempt.
@@ -137,6 +143,7 @@ class MasterServer:
         from ..events import events_enabled, setup_event_routes
         setup_event_routes(s)
         s.route("GET", "/cluster/healthz", self._healthz)
+        s.route("GET", "/cluster/mirror", self._cluster_mirror)
         if events_enabled():
             # The aggregation endpoint honors the same kill switch as
             # /debug/events — -events=false unmounts both surfaces.
@@ -490,6 +497,11 @@ class MasterServer:
                 # health rollup degrades on fast burn and folds every
                 # node's sketch into the cluster-wide tail.
                 dn.slo_state = hb["slo"]
+            if "replication" in hb:
+                # Per-volume mirroring lag (seq delta + seconds) and
+                # pairing config from the node's shipper — the health
+                # rollup's lag-SLO input and /cluster/mirror's rows.
+                dn.replication = hb["replication"]
             seq = hb.get("seq")
             if seq is not None:
                 # The epoch changes when the volume server restarts, so
@@ -934,6 +946,7 @@ class MasterServer:
         problems: list[str] = []
         nodes = []
         volumes = []
+        replication_rows = []
         with self.topo._lock:
             leaves = list(self.topo.leaves())
             ec_map = {vid: ({sid: [dn.url() for dn in dns]
@@ -995,6 +1008,27 @@ class MasterServer:
                 problems.append(
                     f"ec volume {vid}: {cnt} corrupt shard block(s) "
                     f"on {dn.url()} unrepaired")
+            repl = getattr(dn, "replication", None)
+            if alive and repl:
+                for vid, rrow in sorted(
+                        (repl.get("volumes") or {}).items()):
+                    replication_rows.append(dict(
+                        rrow, volume=int(vid), node=dn.url(),
+                        peer=repl.get("peer", ""),
+                        paused=repl.get("paused", False)))
+                    lag = float(rrow.get("lag_seconds", 0) or 0)
+                    if self.replication_lag_slo is not None and \
+                            lag > self.replication_lag_slo:
+                        # Mirror lag SLO breach: the standby would
+                        # lose up to `lag` seconds of acked writes if
+                        # the primary died now — degrade until it
+                        # catches back up to the watermark.
+                        problems.append(
+                            f"volume {vid} on {dn.url()}: replication "
+                            f"lag {lag:.1f}s exceeds SLO "
+                            f"{self.replication_lag_slo:g}s "
+                            f"({rrow.get('lag_seq', 0)} records "
+                            f"unacked by {repl.get('peer', '?')})")
             for v in list(dn.volumes.values()):
                 ratio = (v.deleted_byte_count / v.size) if v.size else 0.0
                 volumes.append({"id": v.id, "node": dn.url(),
@@ -1069,8 +1103,44 @@ class MasterServer:
         doc = {"healthy": not problems, "problems": problems,
                "leader": self.leader_url(), "is_leader": self.is_leader(),
                "nodes": nodes, "volumes": volumes,
-               "ec_volumes": ec_volumes, "slo": slo_doc}
+               "ec_volumes": ec_volumes, "slo": slo_doc,
+               "replication": {"lag_slo": self.replication_lag_slo,
+                               "volumes": replication_rows}}
         return not problems, doc
+
+    def _cluster_mirror(self, query: dict, body: bytes) -> dict:
+        """GET /cluster/mirror — the pairing status rollup: which
+        nodes ship to which standby master, per-volume watermarks and
+        lag, the configured lag SLO, and a cluster-level verdict
+        (`caught_up` = every mirrored volume's lag is zero) — the
+        cutover gate the shell polls."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/cluster/mirror", query,
+                                         body, "GET")
+        rows = []
+        peers = set()
+        paused = []
+        with self.topo._lock:
+            leaves = list(self.topo.leaves())
+        for dn in leaves:
+            repl = getattr(dn, "replication", None)
+            if not repl:
+                continue
+            peers.add(repl.get("peer", ""))
+            if repl.get("paused"):
+                paused.append(dn.url())
+            for vid, rrow in sorted(
+                    (repl.get("volumes") or {}).items()):
+                rows.append(dict(rrow, volume=int(vid),
+                                 node=dn.url(),
+                                 peer=repl.get("peer", "")))
+        return {"paired": bool(rows or peers),
+                "peers": sorted(p for p in peers if p),
+                "paused_nodes": paused,
+                "lag_slo": self.replication_lag_slo,
+                "caught_up": bool(rows) and all(
+                    not r.get("lag_seq") for r in rows),
+                "volumes": rows}
 
     def _healthz(self, query: dict, body: bytes):
         """GET /cluster/healthz — 200/503 for load balancers, JSON

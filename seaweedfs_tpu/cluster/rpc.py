@@ -592,6 +592,12 @@ class JsonHttpServer:
         self._metrics_route = False
         self._sock: socket.socket | None = None
         self._running = False
+        # Live accepted connections, severed on stop(): closing only
+        # the listener leaves idle keep-alive threads free to serve
+        # one more request each, and a thread blocked in accept()
+        # keeps the kernel listener itself alive past close().
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
 
     def serve_metrics_route(self, registry) -> None:
         """Route GET /metrics -> the registry's text exposition."""
@@ -714,12 +720,30 @@ class JsonHttpServer:
 
     def stop(self) -> None:
         self._running = False
-        if self._sock is not None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            # shutdown() wakes a thread blocked in accept(); a bare
+            # close() does not, and the in-progress syscall then pins
+            # the kernel listener open — the "stopped" server keeps
+            # accepting, and a pinned-port restart gets EADDRINUSE.
             try:
-                self._sock.close()
+                sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-            self._sock = None
+            try:
+                sock.close()
+            except OSError:
+                pass
+        # Sever live keep-alive connections too: their threads sit in
+        # readline() and would otherwise serve one more request each
+        # after "stop" (standby-death chaos relies on stop = stopped).
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         while self._running:
@@ -735,6 +759,9 @@ class JsonHttpServer:
     # -- connection loop -----------------------------------------------------
 
     def _serve_conn(self, conn: socket.socket, peer_ip: str = "") -> None:
+        raw = conn  # pre-TLS socket: shutdown() severs either way
+        with self._conns_lock:
+            self._conns.add(raw)
         try:
             if self.ssl_context is not None:
                 # Handshake in the connection thread so a slow/bogus
@@ -763,6 +790,8 @@ class JsonHttpServer:
         except Exception:  # noqa: BLE001 — peer reset / TLS failure / ...
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(raw)
             try:
                 conn.close()
             except OSError:

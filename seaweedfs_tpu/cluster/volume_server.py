@@ -72,7 +72,10 @@ class VolumeServer:
                  idle_timeout: float = 120.0,
                  ec_codec: str = "rs",
                  slo_read_p99: float | None = None,
-                 slo_availability: float | None = None):
+                 slo_availability: float | None = None,
+                 replicate_peer: str | None = None,
+                 replicate_collections: str = "",
+                 replicate_interval: float = 0.5):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -159,6 +162,20 @@ class VolumeServer:
             repair_needle=self._repair_needle_from_replica,
             repair_ec_block=self._repair_ec_block,
             on_change=lambda: self._send_heartbeat(full=True))
+        # Cross-cluster mirroring (-replicate.peer names the STANDBY
+        # cluster's master): a background shipper tails every local
+        # volume's durable change log and streams batches to the peer;
+        # the receive side (the standby's _replication_apply) applies
+        # idempotently against per-volume applied-seq watermarks.
+        self.shipper = None
+        if replicate_peer:
+            from ..replication.shipper import ReplicationShipper
+            self.shipper = ReplicationShipper(
+                self.store, replicate_peer, node=self.url(),
+                collections=replicate_collections,
+                interval=replicate_interval)
+        self._replication_applied: dict[int, object] = {}
+        self._replication_apply_lock = threading.Lock()
         s = self.server
         s.route("GET", "/admin/status", self._admin_status)
         s.route("POST", "/admin/status", self._admin_status)
@@ -197,6 +214,13 @@ class VolumeServer:
         s.route("GET", "/admin/volume_tail", self._volume_tail)
         s.route("POST", "/admin/leave", self._admin_leave)
         s.route("POST", "/admin/drain", self._admin_drain)
+        s.route("POST", "/admin/replication/apply",
+                self._replication_apply)
+        s.route("POST", "/admin/replication/pause",
+                self._replication_pause)
+        s.route("POST", "/admin/replication/resume",
+                self._replication_resume)
+        s.route("GET", "/debug/replication", self._debug_replication)
         s.route("POST", "/admin/tier_upload", self._tier_upload)
         s.route("POST", "/admin/tier_download", self._tier_download)
         self._setup_metrics()
@@ -239,9 +263,13 @@ class VolumeServer:
         self._send_heartbeat(full=True)
         self._hb_thread.start()
         self.scrub.start()
+        if self.shipper is not None:
+            self.shipper.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self.shipper is not None:
+            self.shipper.stop()
         self.scrub.stop()
         self.server.stop()
         with self._ec_pool_lock:
@@ -345,6 +373,17 @@ class VolumeServer:
                   scrub_corrupt_total, scrub_sweeps_total,
                   needle_repairs_total, ec_repair_read_bytes_total):
             reg.register_once(m)
+        # Cross-cluster replication instruments (process-global
+        # singletons the shipper observes into, replication/shipper.py).
+        from ..stats.metrics import (replication_lag_seconds,
+                                     replication_lag_seconds_total,
+                                     replication_resends_total,
+                                     replication_shipped_bytes_total)
+        for m in (replication_shipped_bytes_total,
+                  replication_resends_total,
+                  replication_lag_seconds_total,
+                  replication_lag_seconds):
+            reg.register_once(m)
 
     # -- heartbeats ---------------------------------------------------------
 
@@ -418,6 +457,11 @@ class VolumeServer:
                 # /cluster/healthz and degrades on fast burn.
                 "slo": self.server.slo.heartbeat_view(),
             }
+            if self.shipper is not None:
+                # Per-volume replication lag (seq delta + seconds) +
+                # pairing config: the master folds this into
+                # /cluster/healthz and its lag-SLO verdict.
+                hb["replication"] = self.shipper.lag_view()
             if full:
                 hb["volumes"] = [
                     vinfo_to_dict(v) for v in
@@ -1190,6 +1234,118 @@ class VolumeServer:
         return (200, blob,
                 {"Content-Type": "application/octet-stream",
                  "X-Volume-Version": str(v.version)})
+
+    # -- cross-cluster replication (standby receive + surfaces) --------------
+
+    def _replication_watermark(self, v):
+        """The volume's durable applied-seq watermark (standby side)."""
+        from ..replication.rlog import Watermark
+        with self._replication_apply_lock:
+            wm = self._replication_applied.get(v.vid)
+            if wm is None:
+                wm = Watermark(v.file_name() + ".rap")
+                self._replication_applied[v.vid] = wm
+        return wm
+
+    def _replication_apply(self, query: dict, body: bytes) -> dict:
+        """POST /admin/replication/apply — one shipped change-log
+        batch from the primary.  Idempotent by (needle id, cookie,
+        seq): records at or below the durable applied watermark are
+        skipped, so duplicated delivery and replayed batches are
+        no-ops; records apply in seq order, so a WRITE followed by its
+        DELETE converges to the tombstone (a delete never resurrects).
+        The ack `{"acked_seq": N}` goes out only after the watermark
+        is persisted — the primary advancing on it can never skip a
+        record this side might not remember applying.
+
+        Accepted while draining: like ?type=replicate traffic, an
+        inbound mirror batch is the tail of writes the PRIMARY already
+        committed and acked."""
+        import base64
+        req = json.loads(body)
+        vid = int(req["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            # First batch for a volume the standby doesn't host yet:
+            # create it (the assign_volume path) and heartbeat so the
+            # peer master's /dir/lookup resolves it from now on.  No
+            # rlog here — standby mutations arrive FROM a mirror and
+            # must not ship back.
+            try:
+                v = self.store.add_volume(
+                    vid, req.get("collection", ""),
+                    req.get("replication", "000"), req.get("ttl", ""),
+                    version=int(req.get("version", CURRENT_VERSION)))
+            except VolumeError:
+                v = self.store.find_volume(vid)
+                if v is None:
+                    raise rpc.RpcError(
+                        500, f"cannot host mirrored volume {vid}") \
+                        from None
+            try:
+                self._send_heartbeat(full=True)
+            except Exception:  # noqa: BLE001 — master down: lookup
+                pass           # resolves after the next pulse
+        wm = self._replication_watermark(v)
+        applied = skipped = 0
+        last = wm.value
+        for rec in sorted(req.get("records", []),
+                          key=lambda r: r["seq"]):
+            seq = int(rec["seq"])
+            if seq <= last:
+                skipped += 1
+                continue
+            op = int(rec["op"])
+            if op == 1 and rec.get("blob"):  # OP_WRITE
+                blob = base64.b64decode(rec["blob"])
+                try:
+                    n = Needle.from_bytes(blob, v.version)  # CRC gate
+                except ValueError as e:
+                    raise rpc.RpcError(
+                        400, f"volume {vid} seq {seq}: {e}") from None
+                v.write_needle(n, journal=False)
+            elif op == 2:  # OP_DELETE — tombstones ALWAYS apply
+                v.delete_needle(int(rec["needle_id"]), journal=False)
+            # OP_VACUUM and blobless WRITEs advance the watermark only.
+            last = seq
+            applied += 1
+        wm.set(last)
+        return {"acked_seq": last, "applied": applied,
+                "skipped": skipped}
+
+    def _replication_pause(self, query: dict, body: bytes) -> dict:
+        if self.shipper is None:
+            raise rpc.RpcError(400, "no -replicate.peer configured")
+        self.shipper.paused = True
+        return {"paused": True}
+
+    def _replication_resume(self, query: dict, body: bytes) -> dict:
+        if self.shipper is None:
+            raise rpc.RpcError(400, "no -replicate.peer configured")
+        self.shipper.paused = False
+        self.shipper.kick()
+        return {"paused": False}
+
+    def _debug_replication(self, query: dict, body: bytes) -> dict:
+        """GET /debug/replication — both sides of the mirror on one
+        surface: the shipper's per-volume watermarks/lag (primary
+        role) and the per-volume applied seqs (standby role)."""
+        doc: dict = {"node": self.url(), "role": []}
+        if self.shipper is not None:
+            doc["role"].append("primary")
+            doc["shipper"] = self.shipper.status()
+            doc["rlog"] = {}
+            for loc in self.store.locations:
+                for v in list(loc.volumes.values()):
+                    if v.rlog is not None:
+                        doc["rlog"][str(v.vid)] = v.rlog.status()
+        with self._replication_apply_lock:
+            applied = {str(vid): wm.value for vid, wm in
+                       self._replication_applied.items()}
+        if applied:
+            doc["role"].append("standby")
+        doc["applied"] = applied
+        return doc
 
     def _debug_hot(self, query: dict, body: bytes) -> dict:
         """GET /debug/hot — heavy-hitter snapshot: top-k hot volumes,

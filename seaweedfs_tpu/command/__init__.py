@@ -108,6 +108,8 @@ def usage() -> str:
         "env WEED_V)",
         "  -events.file <path>   append cluster events as JSONL "
         "(journal persistence)",
+        "  -events.file.max_mb <mb> / -events.file.keep <n>   rotate "
+        "the JSONL sink by size, keeping n rotated files",
         "  -events.buffer <n>    event ring capacity (default 2048); "
         "-events=false unmounts /debug/events + /cluster/events",
         "  -debug.traces / -debug.faults   mount /debug/traces and "
@@ -226,6 +228,14 @@ def main(argv: list[str] | None = None) -> int:
     if flags.get("events.buffer"):
         os.environ["SEAWEEDFS_TPU_EVENTS_BUFFER"] = \
             flags.get("events.buffer")
+    # -events.file.max_mb / -events.file.keep: size-based rotation of
+    # the JSONL sink (path -> path.1 -> ... -> path.N, keep N).
+    if flags.get("events.file.max_mb"):
+        os.environ["SEAWEEDFS_TPU_EVENTS_FILE_MAX_MB"] = \
+            flags.get("events.file.max_mb")
+    if flags.get("events.file.keep"):
+        os.environ["SEAWEEDFS_TPU_EVENTS_FILE_KEEP"] = \
+            flags.get("events.file.keep")
     if "events" in flags and not flags.get_bool("events", True):
         os.environ["SEAWEEDFS_TPU_EVENTS"] = "0"
     # Every cluster-dialing command — servers AND clients (upload,

@@ -1,8 +1,13 @@
-"""Replication subcommands: filer.copy / filer.sync / filer.replicate.
+"""Replication subcommands: filer.copy / filer.replicate.
 
-Reference: weed/command/filer_copy.go (local tree -> filer upload),
-filer_sync.go:81-320 (active-active two-filer sync daemon),
+Reference: weed/command/filer_copy.go (local tree -> filer upload) and
 filer_replication.go (notification queue -> Replicator -> sink).
+
+The old `filer.sync` polling daemon was removed: cross-cluster
+mirroring is now the volume-level change-log shipper (-replicate.peer
+on the volume server, replication/rlog.py + shipper.py), which is
+durable, idempotent, and cutover-verified — properties the mtime-diff
+walk never had.
 """
 
 from __future__ import annotations
@@ -67,25 +72,6 @@ def _copy_one(proxy, local: str, remote: str) -> int:
         proxy.put(remote, f, mime,
                   length=os.fstat(f.fileno()).st_size)
     return 1
-
-
-def run_filer_sync(flags: Flags, args: list[str]) -> int:
-    """filer.sync -a=hostA:8888 -b=hostB:8888 [-a.path=/ -b.path=/]"""
-    from ..replication.sync import FilerSyncWorker
-    a = _filer_url(flags, "a")
-    b = _filer_url(flags, "b")
-    worker = FilerSyncWorker(a, b,
-                             dir_a=flags.get("a.path", "/"),
-                             dir_b=flags.get("b.path", "/"),
-                             interval=flags.get_float("interval", 1.0))
-    worker.start()
-    print(f"syncing {a} <-> {b} (ctrl-c to stop)")
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        worker.stop()
-    return 0
 
 
 def run_filer_replicate(flags: Flags, args: list[str]) -> int:
@@ -155,9 +141,6 @@ def run_filer_replicate(flags: Flags, args: list[str]) -> int:
 register(Command(
     "filer.copy", "filer.copy [-filer=host:8888] src... /dest/dir/",
     "copy local files or directories into the filer", run_filer_copy))
-register(Command(
-    "filer.sync", "filer.sync -a=hostA:8888 -b=hostB:8888",
-    "continuous active-active sync between two filers", run_filer_sync))
 register(Command(
     "filer.replicate",
     "filer.replicate -filer=host:8888 -sink=local:///backup",

@@ -165,6 +165,10 @@ def run_master(flags: Flags, args: list[str]) -> int:
             "master.maintenance.sleep_minutes", 17),
         max_concurrent=flags.get_int("max.concurrent", 0),
         idle_timeout=flags.get_float("idle.timeout", 120.0),
+        # -replicate.lag.slo (seconds): cross-cluster mirror lag above
+        # which /cluster/healthz degrades (0/absent = no SLO).
+        replication_lag_slo=flags.get_float("replicate.lag.slo",
+                                            0.0) or None,
         **_slo_flags(flags))
     m.start()
     glog.infof("master serving at %s", m.server.url())
@@ -212,6 +216,16 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         # "rs" (reference-compatible RS(10,4)) or "lrc" (LRC(10,2,2),
         # 5-read single-shard repair).
         ec_codec=flags.get("ec.codec", "rs"),
+        # Cross-cluster async mirroring: -replicate.peer names the
+        # STANDBY cluster's master; every local write/delete journals
+        # to a per-volume change log and a background shipper tails it
+        # to the peer.  -replicate.collections opts specific
+        # collections in ("" or `default` = the default collection);
+        # empty = mirror everything.
+        replicate_peer=(_norm_master(flags.get("replicate.peer"))
+                        if flags.get("replicate.peer") else None),
+        replicate_collections=flags.get("replicate.collections", ""),
+        replicate_interval=flags.get_float("replicate.interval", 0.5),
         # -slo.read.p99 / -slo.availability: declared objectives for
         # the burn engine; exemplars + quantiles run regardless.
         **_slo_flags(flags))
@@ -386,14 +400,17 @@ def _norm_master(addr: str) -> str:
     return addr if addr.startswith("http") else f"http://{addr}"
 
 
-register(Command("master", "master -port=9333 -mdir=/tmp/meta",
+register(Command("master", "master -port=9333 -mdir=/tmp/meta"
+                 " [-replicate.lag.slo=30(s)]",
                  "start a master server", run_master))
 register(Command("volume",
                  "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
                  " [-fsync] [-scrub.mbps=32] [-scrub.interval=3600]"
                  " [-max.concurrent=0] [-disk.reserve=0(MB)]"
                  " [-shutdown.grace=30] [-ec.codec=rs|lrc]"
-                 " [-slo.read.p99=0.05] [-slo.availability=99.9]",
+                 " [-slo.read.p99=0.05] [-slo.availability=99.9]"
+                 " [-replicate.peer=standby-master:9333]"
+                 " [-replicate.collections=a,b] [-replicate.interval=0.5]",
                  "start a volume server", run_volume))
 register(Command("filer", "filer -port=8888 -master=host:9333",
                  "start a filer server", run_filer))

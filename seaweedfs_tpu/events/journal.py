@@ -104,6 +104,18 @@ TYPES: dict[str, str] = {
                 "is burning its error budget at the fast-burn rate "
                 "over both the 5m and 1h windows; /cluster/healthz "
                 "reports the role degraded until the burn subsides",
+    "replication.ship": "the mirror shipper sent one change-log batch "
+                        "(records, bytes, seq range) to the standby "
+                        "cluster",
+    "replication.ack": "the standby acknowledged a shipped batch; the "
+                       "volume's durable acked watermark advanced",
+    "replication.lag": "a mirrored volume fell behind its standby "
+                       "(unacked change-log records accumulated); "
+                       "healthz degrades when the lag SLO is breached",
+    "replication.cutover": "an operator cutover flipped the mirror "
+                           "roles: the primary drained, the standby "
+                           "caught up to the watermark and became "
+                           "writable",
 }
 
 SEVERITIES = ("info", "warn", "error")
@@ -148,6 +160,10 @@ class EventJournal:
         # before servers construct) wins over import order.
         self._sink_path: str | None | type(...) = ...
         self._sink_lock = threading.Lock()
+        # Size-based rotation (-events.file.max_mb / -events.file.keep):
+        # resolved lazily alongside the path, reset by set_sink.
+        self._sink_max_bytes: int | type(...) = ...
+        self._sink_keep = 3
 
     # -- emission ------------------------------------------------------------
 
@@ -182,18 +198,53 @@ class EventJournal:
         return ev
 
     def _write_sink(self, ev: dict) -> None:
-        """Append one JSONL line; a broken sink must never fail the
-        operation that emitted the event."""
+        """Append one JSONL line, rotating by size first; a broken sink
+        must never fail the operation that emitted the event."""
         try:
-            with self._sink_lock, open(self._sink_path, "a") as f:
-                f.write(json.dumps(ev) + "\n")
+            with self._sink_lock:
+                self._maybe_rotate()
+                with open(self._sink_path, "a") as f:
+                    f.write(json.dumps(ev) + "\n")
         except OSError:
             pass
 
+    def _maybe_rotate(self) -> None:
+        """Shift path -> path.1 -> ... -> path.N (keep N) when the live
+        file exceeds -events.file.max_mb.  Caller holds _sink_lock."""
+        if self._sink_max_bytes is ...:
+            try:
+                mb = float(os.environ.get(
+                    "SEAWEEDFS_TPU_EVENTS_FILE_MAX_MB", "") or 0)
+            except ValueError:
+                mb = 0.0
+            self._sink_max_bytes = int(mb * 1024 * 1024)
+            try:
+                self._sink_keep = max(1, int(os.environ.get(
+                    "SEAWEEDFS_TPU_EVENTS_FILE_KEEP", "") or 3))
+            except ValueError:
+                self._sink_keep = 3
+        if not self._sink_max_bytes:
+            return  # rotation not enabled
+        try:
+            if os.path.getsize(self._sink_path) < self._sink_max_bytes:
+                return
+        except OSError:
+            return  # sink doesn't exist yet: nothing to rotate
+        path = self._sink_path
+        oldest = f"{path}.{self._sink_keep}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self._sink_keep - 1, 0, -1):
+            if os.path.exists(f"{path}.{i}"):
+                os.replace(f"{path}.{i}", f"{path}.{i + 1}")
+        os.replace(path, f"{path}.1")
+
     def set_sink(self, path: str | None) -> None:
-        """Override the JSONL sink (tests; runtime reconfiguration)."""
+        """Override the JSONL sink (tests; runtime reconfiguration).
+        Rotation config re-resolves from the env on the next write."""
         with self._sink_lock:
             self._sink_path = path
+            self._sink_max_bytes = ...
 
     # -- queries -------------------------------------------------------------
 

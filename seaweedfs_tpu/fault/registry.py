@@ -86,6 +86,17 @@ POINTS: dict[str, str] = {
                        "mid-request after half the bytes, like a "
                        "slow-loris client; the server's idle timeout "
                        "should reap the connection",
+    "wan.partition": "cross-cluster ship-path batch POST — an armed "
+                     "fail is a WAN partition: the batch never "
+                     "reaches the standby, the acked watermark holds, "
+                     "and shipping resumes from it after heal",
+    "wan.delay": "cross-cluster ship-path batch POST — an armed "
+                 "delay:S models WAN round-trip latency, growing the "
+                 "replication lag healthz watches",
+    "wan.duplicate": "cross-cluster ship path, after a successful "
+                     "send — an armed fail makes the shipper deliver "
+                     "the SAME batch twice; the receiver's applied-seq "
+                     "watermark must no-op the replay",
 }
 
 KINDS = ("fail", "delay", "status", "drop")

@@ -1,13 +1,25 @@
-"""Cross-cluster replication: sinks, the replicator pump, and sync.
+"""Cross-cluster replication: the change-log mirror, sinks, and the
+replicator pump.
 
-Reference: weed/replication/ (replicator.go:17-72 routing meta events to
-pluggable sinks, sink/{filersink,s3sink,localsink,...}, sub/ notification
-inputs) and command/filer_sync.go:81-320 (active-active two-way sync with
-per-signature offset checkpoints).
+Two planes live here:
+
+- Volume-level async mirroring (rlog.py + shipper.py): every committed
+  write/delete journals to a durable per-volume change log
+  (`<volume>.rlog`) and a background shipper tails it to a standby
+  cluster (`-replicate.peer`), idempotently applied and watermarked on
+  both sides so kill -9 anywhere loses nothing acked.  This is the
+  disaster-recovery plane (README "Disaster recovery").
+- Filer-event replication (replicator.py + sink.py): routes filer meta
+  events to pluggable sinks (filer/local/s3/gcs/b2/azure), reference
+  weed/replication/replicator.go:17-72 and sink/.
+
+The old mtime-diff `filer.sync` walker was superseded by the change-log
+shipper and removed.
 """
 
 from .notification import (FileQueue, MemoryQueue,  # noqa: F401
                            NotificationQueue, queue_for_spec)
 from .replicator import Replicator  # noqa: F401
+from .rlog import ReplicationLog, Watermark  # noqa: F401
+from .shipper import ReplicationShipper  # noqa: F401
 from .sink import FilerSink, LocalSink, ReplicationSink, S3Sink  # noqa: F401
-from .sync import FilerSyncWorker, sync_once  # noqa: F401

@@ -13,6 +13,14 @@ no consumer groups — the `NotificationQueue.consume` contract is
 poll-drain from checkpointed per-partition offsets, which maps to plain
 Fetch (the reference's kafka consumer also tracks its own offsets in a
 progress file rather than committing group offsets).
+
+QUARANTINED: nothing in the tree constructs this queue outside
+`queue_for_spec("kafka://...")` — cross-cluster disaster recovery now
+rides the volume-level change-log shipper (rlog.py + shipper.py), not
+a broker.  Kept (with its wire-protocol tests) for operators who feed
+filer events into an existing Kafka estate; the public surface is
+pinned by `__all__` below and everything else is implementation detail
+that may change or be removed.
 """
 
 from __future__ import annotations
@@ -26,6 +34,8 @@ import time
 
 from ..core.crc import crc32c
 from .notification import NotificationQueue
+
+__all__ = ["KafkaQueue", "encode_record_batch", "decode_record_batches"]
 
 _CLIENT_ID = "seaweedfs-tpu"
 

@@ -10,6 +10,13 @@ token (RFC 7523) — with the RSA-SHA256 primitive from libcrypto
 publish  -> POST v1/projects/{p}/topics/{t}:publish
 consume  -> POST v1/projects/{p}/subscriptions/{s}:pull, then
             :acknowledge after delivery (at-least-once)
+
+QUARANTINED: nothing in the tree constructs this queue outside
+`queue_for_spec("pubsub://...")` — cross-cluster disaster recovery now
+rides the volume-level change-log shipper (rlog.py + shipper.py), not
+a cloud queue.  Kept (with its auth/wire tests) for operators who feed
+filer events into Pub/Sub; the public surface is pinned by `__all__`
+below and everything else may change or be removed.
 """
 
 from __future__ import annotations
@@ -21,6 +28,8 @@ import time
 import urllib.request
 
 from .notification import NotificationQueue
+
+__all__ = ["PubSubQueue", "make_service_account_jwt"]
 
 _SCOPE = "https://www.googleapis.com/auth/pubsub"
 

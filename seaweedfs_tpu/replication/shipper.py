@@ -1,0 +1,320 @@
+"""Cross-cluster async replication shipper: tail `.rlog`s, ship batches.
+
+The volume server owns one ReplicationShipper when `-replicate.peer`
+names a standby cluster's master.  Each tick it walks the local
+volumes (optionally filtered to `-replicate.collections`), enables the
+durable change log on any that lack one, and ships the unacked tail of
+each log as one batch:
+
+- WRITE records carry the raw CRC-gated needle record bytes
+  (Volume.read_needle_blob — the same blob `/admin/needle_raw` serves
+  to the self-healing plane), so the standby stores byte-identical
+  records.  A needle vacuumed or superseded since its log record was
+  written ships blobless; the receiver no-ops it and a later record
+  for the same needle converges the pair.
+- DELETE records always ship: tombstones must propagate (a delete must
+  never resurrect — the PR 4 repair rule, now cross-cluster).
+- The batch POSTs to the standby volume server resolved through the
+  peer master's `/dir/lookup` (falling back to any live peer node for
+  a volume the standby doesn't host yet), on the low-priority internal
+  lane, breaker-guarded and retry-policied like every other WAN-shaped
+  path (cluster/resilience.py).  Safe to retry: the receiver applies
+  idempotently by seq against its own durable applied watermark.
+- Only after the standby acks `{"acked_seq": N}` does the local `.rwm`
+  watermark advance — a kill -9 anywhere re-ships at most one batch,
+  which the receiver no-ops.
+
+WAN fault points on the ship path (`wan.partition`, `wan.delay`,
+`wan.duplicate` — fault/registry.py) shape the chaos suite; the
+`wan.duplicate` hook makes the shipper send the SAME batch twice, so
+duplicate delivery is a first-class tested scenario, not an accident.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+
+from ..cluster import resilience, rpc
+from ..events import emit as emit_event
+from ..fault import registry as _fault
+from ..stats.metrics import (replication_lag_seconds,
+                             replication_lag_seconds_total,
+                             replication_resends_total,
+                             replication_shipped_bytes_total)
+from ..storage.volume import VolumeError
+from ..trace import root_span
+from .rlog import OP_WRITE
+
+_TARGET_TTL = 60.0
+
+
+class ReplicationShipper:
+    """Background daemon tailing every mirrored volume's `.rlog`."""
+
+    def __init__(self, store, peer: str, node: str = "",
+                 collections: str = "", interval: float = 0.5,
+                 batch_records: int = 128):
+        self.store = store
+        self.peer = peer if peer.startswith("http") else f"http://{peer}"
+        self.node = node
+        # Per-collection opt-in: empty = mirror everything; the
+        # default collection opts in as "" (spelled `default` too).
+        names = {c.strip() for c in collections.split(",") if c.strip()}
+        self.collections = {("" if c == "default" else c)
+                            for c in names} or None
+        self.interval = interval
+        self.batch_records = batch_records
+        self.paused = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+        # vid -> (expires_at, "host:port") standby target cache,
+        # invalidated on send failure so a rebalanced standby re-resolves.
+        self._targets: dict[int, tuple[float, str]] = {}
+        self._lag: dict[int, dict] = {}
+        self._lag_lock = threading.Lock()
+        self._policy = resilience.RetryPolicy(
+            max_attempts=3, per_attempt_timeout=10.0,
+            total_deadline=20.0)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="replication-shipper")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def kick(self) -> None:
+        """Ship now instead of waiting out the tick (tests, cutover)."""
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            # root_span: ship/ack/lag events journaled from this
+            # daemon must carry the trace of the tick that caused them.
+            with root_span("replication.tick", "replication"):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — peer down: the
+                    pass           # watermark holds; next tick resumes
+
+    # -- shipping ------------------------------------------------------------
+
+    def _volumes(self):
+        for loc in self.store.locations:
+            for v in list(loc.volumes.values()):
+                if v.remote_file is not None:
+                    continue  # tiered: readonly, nothing journals
+                if self.collections is not None and \
+                        (v.collection or "") not in self.collections:
+                    continue
+                yield v
+
+    def tick(self) -> None:
+        for v in self._volumes():
+            if v.rlog is None:
+                v.enable_rlog()
+            try:
+                self._ship_volume(v)
+            except (OSError, rpc.RpcError, VolumeError):
+                continue  # per-volume isolation: one sick pair must
+                #           not starve the others' shipping
+
+    def _ship_volume(self, v) -> None:
+        rlog = v.rlog
+        self._observe_lag(v.vid, rlog)
+        if self.paused:
+            return
+        while rlog.pending() > 0 and not self._stop.is_set():
+            recs = rlog.read_from(rlog.acked_seq + 1, self.batch_records)
+            if not recs:
+                return
+            body, nbytes = self._encode_batch(v, recs)
+            target = self._resolve_target(v.vid)
+            if target is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                out = self._post(target, v.vid, body)
+            except Exception:
+                self._targets.pop(v.vid, None)  # re-resolve next tick
+                raise
+            acked = int(out.get("acked_seq", 0))
+            if acked > rlog.acked_seq:
+                rlog.set_acked(acked)
+            replication_shipped_bytes_total.inc(nbytes)
+            emit_event("replication.ship", node=self.node, vid=v.vid,
+                       peer=target, records=len(recs), bytes=nbytes,
+                       first_seq=recs[0].seq, last_seq=recs[-1].seq,
+                       seconds=round(time.perf_counter() - t0, 6))
+            emit_event("replication.ack", node=self.node, vid=v.vid,
+                       peer=target, acked_seq=acked,
+                       applied=out.get("applied", 0),
+                       skipped=out.get("skipped", 0))
+            self._observe_lag(v.vid, rlog)
+
+    def _encode_batch(self, v, recs) -> tuple[dict, int]:
+        out = []
+        nbytes = 0
+        for r in recs:
+            rec = {"seq": r.seq, "op": r.op, "needle_id": r.needle_id,
+                   "cookie": r.cookie, "size": r.size, "ts_ns": r.ts_ns}
+            if r.op == OP_WRITE:
+                try:
+                    blob = v.read_needle_blob(r.needle_id)
+                    rec["blob"] = base64.b64encode(blob).decode()
+                    nbytes += len(blob)
+                except VolumeError:
+                    # Vacuumed, superseded, or locally rotten: nothing
+                    # shippable for THIS seq; a later record for the
+                    # needle (or the repair plane) converges the pair.
+                    rec["blob"] = None
+            out.append(rec)
+        return ({"volume": v.vid, "collection": v.collection,
+                 "version": v.version,
+                 "replication": str(v.super_block.replica_placement),
+                 "ttl": str(v.super_block.ttl),
+                 "records": out}, nbytes)
+
+    def _post(self, target: str, vid: int, body: dict) -> dict:
+        import json
+        payload = json.dumps(body).encode()
+        breaker = resilience.breaker_for(target)
+
+        def send(attempt: int, timeout: float) -> dict:
+            if attempt:
+                replication_resends_total.inc(reason="retry")
+            if not breaker.allow():
+                raise resilience.BreakerOpen(target)
+            try:
+                if _fault.ARMED:
+                    # WAN shaping on the ship path: delay models
+                    # latency, partition fails the send (the batch
+                    # never arrives; the watermark holds).
+                    _fault.hit("wan.delay", peer=target, vid=vid)
+                    _fault.hit("wan.partition", peer=target, vid=vid)
+                out = rpc.call(
+                    f"http://{target}/admin/replication/apply", "POST",
+                    payload, timeout=timeout, headers=rpc.PRIORITY_LOW)
+            except Exception as e:  # noqa: BLE001 — classified below
+                status = getattr(e, "status", None)
+                if status is None or status >= 500:
+                    breaker.record_failure()
+                raise
+            breaker.record_success()
+            if _fault.ARMED:
+                try:
+                    _fault.hit("wan.duplicate", peer=target, vid=vid)
+                except _fault.FaultInjected:
+                    # Duplicate delivery, on purpose: the same batch
+                    # lands twice and the receiver's applied watermark
+                    # must no-op the replay.
+                    replication_resends_total.inc(reason="duplicate")
+                    rpc.call(f"http://{target}"
+                             f"/admin/replication/apply", "POST",
+                             payload, timeout=timeout,
+                             headers=rpc.PRIORITY_LOW)
+            assert isinstance(out, dict)
+            return out
+
+        # idempotent=True: the receiver's seq watermark makes a resend
+        # of bytes-that-maybe-landed a no-op, the one property plain
+        # needle POSTs don't have.
+        return self._policy.run(send, idempotent=True)
+
+    # -- standby resolution --------------------------------------------------
+
+    def _resolve_target(self, vid: int) -> str | None:
+        hit = self._targets.get(vid)
+        if hit and time.monotonic() < hit[0]:
+            return hit[1]
+        url = None
+        try:
+            out = rpc.call(f"{self.peer}/dir/lookup?volumeId={vid}")
+            locs = out.get("locations") or []
+            if locs:
+                url = locs[0].get("url") or locs[0].get("publicUrl")
+        except rpc.RpcError:
+            pass  # standby doesn't host it yet: pick any live node
+        except Exception:  # noqa: BLE001 — peer master unreachable
+            return None
+        if not url:
+            try:
+                out = rpc.call(f"{self.peer}/vol/list")
+                nodes = [n["url"]
+                         for dc in out.get("topology", {})
+                                      .get("data_centers", [])
+                         for rack in dc.get("racks", [])
+                         for n in rack.get("nodes", [])
+                         if n.get("url")]
+                if nodes:
+                    # Stable spread of new volumes across the standby;
+                    # the receiver creates + heartbeats the volume, so
+                    # the next resolve goes through /dir/lookup.
+                    url = sorted(nodes)[vid % len(nodes)]
+            except Exception:  # noqa: BLE001
+                return None
+        if not url:
+            return None
+        self._targets[vid] = (time.monotonic() + _TARGET_TTL, url)
+        return url
+
+    # -- lag accounting ------------------------------------------------------
+
+    def _observe_lag(self, vid: int, rlog) -> None:
+        lag_seq = rlog.pending()
+        lag_seconds = 0.0
+        if lag_seq:
+            head = rlog.read_from(rlog.acked_seq + 1, 1)
+            if head:
+                lag_seconds = max(0.0, time.time()
+                                  - head[0].ts_ns / 1e9)
+        prev = self._lag.get(vid) or {}
+        with self._lag_lock:
+            self._lag[vid] = {
+                "lag_seq": lag_seq,
+                "lag_seconds": round(lag_seconds, 3),
+                "last_seq": rlog.last_seq,
+                "acked_seq": rlog.acked_seq,
+                "paused": self.paused,
+            }
+        replication_lag_seconds.set(lag_seconds, volume=str(vid))
+        if lag_seconds:
+            replication_lag_seconds_total.inc(lag_seconds)
+        # One journal row per lag episode (threshold-crossing, not
+        # per-tick): the timeline shows WHEN a pair fell behind.
+        if lag_seq and not prev.get("lag_seq"):
+            emit_event("replication.lag", node=self.node, severity="warn",
+                       vid=vid, lag_seq=lag_seq,
+                       lag_seconds=round(lag_seconds, 3), peer=self.peer)
+
+    # -- surfaces ------------------------------------------------------------
+
+    def lag_view(self) -> dict:
+        """Heartbeat payload: per-volume lag + the pairing config."""
+        with self._lag_lock:
+            vols = {str(vid): dict(row) for vid, row in
+                    self._lag.items()}
+        return {"peer": self.peer, "paused": self.paused,
+                "volumes": vols}
+
+    def status(self) -> dict:
+        doc = self.lag_view()
+        doc["interval"] = self.interval
+        doc["batch_records"] = self.batch_records
+        doc["collections"] = (sorted(c or "default"
+                                     for c in self.collections)
+                              if self.collections is not None else [])
+        return doc

@@ -18,3 +18,4 @@ from . import command_trace  # noqa: F401,E402
 from . import command_fault  # noqa: F401,E402
 from . import command_cluster  # noqa: F401,E402
 from . import command_profile  # noqa: F401,E402
+from . import command_mirror  # noqa: F401,E402

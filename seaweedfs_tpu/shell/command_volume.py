@@ -190,12 +190,16 @@ class VolumeUnmount(Command):
 @register
 class VolumeFsck(Command):
     name = "volume.fsck"
-    help = ("volume.fsck [-v] [-crc] — verify every filer chunk "
-            "resolves to a live needle (command_volume_fsck.go's "
+    help = ("volume.fsck [-v] [-crc] [-json] — verify every filer "
+            "chunk resolves to a live needle (command_volume_fsck.go's "
             "findMissingChunksInVolumeServers direction); -crc HEADs "
             "EVERY replica and compares the stored needle CRC "
             "(X-Needle-Checksum) so divergent copies are caught "
-            "without transferring bodies")
+            "without transferring bodies; -json emits a machine-"
+            "readable report (per-volume per-needle checksum sets + "
+            "verdict) whose `volumes` map is node-address-free, so two "
+            "mirrored clusters converged exactly when their reports' "
+            "`volumes` maps are equal")
 
     @staticmethod
     def _head_checksum(url: str, fid: str) -> str:
@@ -209,8 +213,12 @@ class VolumeFsck(Command):
     def do(self, args: list[str], env: CommandEnv) -> str:
         flags, _ = self.parse_flags(args)
         crc_mode = "crc" in flags
+        json_mode = "json" in flags
         proxy = env.filer()
         checked, missing, diverged = 0, [], []
+        # vid -> fid -> sorted distinct replica checksums.  Keyed by
+        # needle, not node, so two clusters' reports compare directly.
+        vols: dict[str, dict[str, list[str]]] = {}
         stack = ["/"]
         while stack:
             d = stack.pop()
@@ -227,12 +235,14 @@ class VolumeFsck(Command):
                         locs = env.volume_locations(vid)
                         if not locs:
                             raise LookupError("no locations")
-                        if not crc_mode:
+                        if not crc_mode and not json_mode:
                             self._head_checksum(locs[0], fid)
                             continue
                         crcs = {}
-                        for url in locs:
+                        for url in locs if crc_mode else locs[:1]:
                             crcs[url] = self._head_checksum(url, fid)
+                        vols.setdefault(str(vid), {})[fid] = \
+                            sorted(set(crcs.values()))
                         if len(set(crcs.values())) > 1:
                             diverged.append(
                                 (e["FullPath"], fid,
@@ -241,6 +251,19 @@ class VolumeFsck(Command):
                                            sorted(crcs.items()))))
                     except Exception as err:  # noqa: BLE001
                         missing.append((e["FullPath"], fid, str(err)))
+        if json_mode:
+            import json as _json
+            verdict = "missing" if missing else \
+                "divergent" if diverged else "ok"
+            return _json.dumps(
+                {"verdict": verdict, "checked": checked,
+                 "missing": [{"path": p, "fid": f, "error": err}
+                             for p, f, err in missing],
+                 "diverged": [{"path": p, "fid": f, "detail": d}
+                              for p, f, d in diverged],
+                 "volumes": {vid: dict(sorted(fids.items()))
+                             for vid, fids in sorted(vols.items())}},
+                indent=1, sort_keys=True)
         lines = [f"checked {checked} chunks, {len(missing)} missing"
                  + (f", {len(diverged)} replica CRC mismatches"
                     if crc_mode else "")]

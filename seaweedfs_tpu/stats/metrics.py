@@ -377,6 +377,32 @@ ec_repair_read_bytes_total = Counter(
     "shard bytes read to repair or reconstruct EC data", ("codec",))
 
 
+# -- cross-cluster replication instruments -----------------------------------
+# Process-global singletons the rlog shipper observes into
+# (replication/shipper.py); the volume server registers the same
+# objects on its /metrics scrape (promcheck-gated in tests).
+
+replication_shipped_bytes_total = Counter(
+    "SeaweedFS_replication_shipped_bytes_total",
+    "change-log payload bytes acked by the standby cluster")
+
+replication_resends_total = Counter(
+    "SeaweedFS_replication_resends_total",
+    "replication batches re-sent (WAN retries + injected duplicate "
+    "delivery) — every resend is a no-op at the receiver's watermark",
+    ("reason",))  # retry|duplicate
+
+replication_lag_seconds_total = Counter(
+    "SeaweedFS_replication_lag_seconds_total",
+    "observed replication lag integrated over shipper ticks (a "
+    "burn-style counter: its rate IS the average lag in seconds)")
+
+replication_lag_seconds = Gauge(
+    "SeaweedFS_replication_lag_seconds",
+    "age of the oldest unacked change-log record, per volume",
+    ("volume",))
+
+
 def observe_batch_stage(stages: dict, stage: str, seconds: float,
                         nbytes: int) -> None:
     """observe_ec_stage plus a per-batch accumulator: the batched EC

@@ -249,7 +249,8 @@ class Store:
                     info = self._volume_info(v)
                     v.close()
                     base = v.file_name()
-                    for ext in (".dat", ".idx", ".qrt"):
+                    for ext in (".dat", ".idx", ".qrt",
+                                ".rlog", ".rwm", ".rap"):
                         try:
                             os.remove(base + ext)
                         except FileNotFoundError:

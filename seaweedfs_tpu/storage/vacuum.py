@@ -125,6 +125,12 @@ def commit_compact(volume: Volume, snapshot_size: int | None = None) -> None:
                 base + ".idx")
             volume._dat.seek(0, os.SEEK_END)
             volume._append_at = volume._dat.tell()
+            # The replication change log is compacted with the volume:
+            # the acked prefix can never need re-shipping, and the
+            # appended vacuum record keeps the seq chain alive (and
+            # documents the rewrite to the standby).
+            if volume.rlog is not None:
+                volume.rlog.compact()
         volume.vacuum_staged = None
 
 

@@ -65,6 +65,11 @@ class _WriteReq:
     offset: int = 0
     size: int = 0
     error: Exception | None = None
+    # journal=False marks mutations that must NOT land in the
+    # replication change log: the standby's apply path (or the mirror
+    # would ship its own inputs back) and quarantine tombstones (which
+    # must never propagate as user deletes — PR 4's repair rule).
+    journal: bool = True
 
 
 def _parse_needle_extras(tail: bytes) -> dict:
@@ -206,6 +211,11 @@ class Volume:
         # healthy) nor let its tombstone masquerade as a user delete.
         self.repair_tickets: dict[int, float] = self._load_tickets()
         self.last_scrub = 0.0
+        # Replication change log (replication/rlog.py): None until
+        # mirroring is configured for this volume.  Auto-reopened below
+        # when the sidecar already exists, so a restarted primary keeps
+        # journaling without waiting for the shipper to re-enable it.
+        self.rlog = None
         base = self.file_name()
         # Tiered volume: the .dat lives on a remote BackendStorage
         # (storage/volume_tier.go); reads proxy through remote_file,
@@ -259,6 +269,8 @@ class Volume:
         self._dat.seek(0, os.SEEK_END)
         self._append_at = self._dat.tell()
         self.last_modified = time.time()
+        if os.path.exists(base + ".rlog"):
+            self.enable_rlog()
 
         self._closed = False
         self._use_worker = use_worker
@@ -279,6 +291,22 @@ class Volume:
     @property
     def version(self) -> int:
         return self.super_block.version
+
+    def enable_rlog(self):
+        """Switch on the durable replication change log for this
+        volume (idempotent).  From here every committed write/delete
+        journals a fixed-size record into the `.rlog` sidecar at the
+        same commit point as the needle itself, so the shipper can
+        resume exactly after a kill -9.  Standby volumes never call
+        this — their mutations arrive FROM a mirror and shipping them
+        back would loop."""
+        with self._lock:
+            if self.rlog is None:
+                # Lazy import: storage must not pull the replication
+                # package (and its filer-client deps) at module import.
+                from ..replication.rlog import ReplicationLog
+                self.rlog = ReplicationLog(self.file_name())
+        return self.rlog
 
     # -- write path --------------------------------------------------------
 
@@ -332,6 +360,18 @@ class Volume:
                     # data (recovery re-journals it, but an fsync ack
                     # should never depend on recovery).
                     self.nm.sync()
+                    if self.rlog is not None:
+                        # Change-log records land AFTER the data is
+                        # durable and BEFORE the waiters are released:
+                        # a crash here loses only un-acked writes, and
+                        # every acked write has its log record.
+                        for req in written:
+                            if req.journal:
+                                self.rlog.append(self.rlog.OP_WRITE,
+                                                 req.needle.id,
+                                                 req.needle.cookie,
+                                                 req.needle.size)
+                        self.rlog.sync()
                 except Exception as e:  # noqa: BLE001
                     for req in batch:
                         req.error = req.error or e
@@ -412,8 +452,8 @@ class Volume:
         self._append_at = offset + len(blob)
         return offset, n.size
 
-    def write_needle(self, n: Needle,
-                     fsync: bool = False) -> tuple[int, int]:
+    def write_needle(self, n: Needle, fsync: bool = False,
+                     journal: bool = True) -> tuple[int, int]:
         """Append an object. Returns (offset, stored size).
 
         Like the reference, writes reach the OS page cache (flush) but
@@ -444,9 +484,15 @@ class Volume:
                         self.nm.sync()
                     else:
                         self.nm.flush()
+                    if journal and self.rlog is not None:
+                        self.rlog.append(self.rlog.OP_WRITE, n.id,
+                                         n.cookie, n.size)
+                        if fsync:
+                            self.rlog.sync()
                 self.last_modified = time.time()
                 return off, size
-        req = _WriteReq(needle=n, done=threading.Event())
+        req = _WriteReq(needle=n, done=threading.Event(),
+                        journal=journal)
         self._queue.put(req)
         if self._closed:
             # close() may already have drained the queue; fail fast instead
@@ -463,12 +509,14 @@ class Volume:
             raise req.error
         return req.offset, req.size
 
-    def delete_needle(self, needle_id: int) -> int:
+    def delete_needle(self, needle_id: int, journal: bool = True) -> int:
         """Tombstone an object. Returns bytes freed (0 if absent).
 
         Appends a zero-data needle (so the .dat replays the delete) and a
         tombstone idx entry, mirroring doDeleteRequest
-        (volume_read_write.go).
+        (volume_read_write.go).  journal=False suppresses the
+        replication change-log record: quarantine tombstones (and the
+        standby's own apply path) must never propagate as user deletes.
         """
         with self._file_lock.write(), self._lock:
             if self.readonly:
@@ -487,6 +535,8 @@ class Volume:
             # Publish the tombstone only after the marker bytes are flushed.
             freed = self.nm.delete(needle_id)
             self.nm.flush()
+            if journal and self.rlog is not None:
+                self.rlog.append(self.rlog.OP_DELETE, needle_id, 0, 0)
             self.last_modified = time.time()
             return freed
 
@@ -539,7 +589,10 @@ class Volume:
         if self.nm.get(key) is None:
             return False
         try:
-            self.delete_needle(key)
+            # journal=False: a quarantine tombstone is NOT a user
+            # delete — shipping it would delete the standby's healthy
+            # copy of data this cluster merely failed to keep.
+            self.delete_needle(key, journal=False)
         except VolumeError:
             pass  # readonly volume: the ticket still flags it degraded
         self.repair_tickets[key] = time.time()
@@ -566,6 +619,13 @@ class Volume:
                     self.nm.sync()  # both files durable, like write
                 else:
                     self.nm.flush()
+                if self.rlog is not None:
+                    # A repair is journaled as a WRITE: the standby
+                    # either already holds these bytes (same id+cookie,
+                    # idempotent) or its copy is what this repair
+                    # restored — re-shipping converges both cases.
+                    self.rlog.append(self.rlog.OP_WRITE, n.id,
+                                     n.cookie, n.size)
                 self.last_modified = time.time()
             finally:
                 self.readonly = ro
@@ -816,3 +876,5 @@ class Volume:
             except ValueError:
                 pass
             self.nm.close()
+            if self.rlog is not None:
+                self.rlog.close()

@@ -18,6 +18,7 @@ from seaweedfs_tpu.cluster.client import WeedClient
 from seaweedfs_tpu.cluster.master import MasterServer
 from seaweedfs_tpu.cluster.volume_server import VolumeServer
 from seaweedfs_tpu.events import JOURNAL, TYPES, EventJournal
+from seaweedfs_tpu.replication import ReplicationShipper
 from seaweedfs_tpu.shell import CommandEnv, run_command
 from seaweedfs_tpu.stats.promcheck import validate_exposition
 from seaweedfs_tpu.trace import root_span
@@ -93,6 +94,32 @@ def test_jsonl_sink(tmp_path):
              open(path).read().strip().split("\n")]
     assert [ev["type"] for ev in lines] == ["volume.grow", "tier.move"]
     assert lines[1]["attrs"]["vid"] == 9
+
+
+def test_jsonl_sink_size_rotation(tmp_path, monkeypatch):
+    """-events.file.max_mb rotates the sink (path -> path.1 -> ...)
+    keeping -events.file.keep rotated generations; the live file always
+    holds the newest events."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_EVENTS_FILE_MAX_MB", "0.0002")
+    monkeypatch.setenv("SEAWEEDFS_TPU_EVENTS_FILE_KEEP", "2")
+    j = EventJournal(capacity=8)
+    path = str(tmp_path / "events.jsonl")
+    j.set_sink(path)  # re-resolves the rotation env on next write
+    for i in range(40):
+        j.emit("volume.grow", count=i, pad="x" * 64)
+    assert os.path.exists(path + ".1")
+    assert os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3"), "keep=2 must bound the chain"
+    last = json.loads(open(path).read().strip().split("\n")[-1])
+    assert last["attrs"]["count"] == 39
+    # Rotation disabled (no max): one ever-growing file, no .1 sibling.
+    monkeypatch.delenv("SEAWEEDFS_TPU_EVENTS_FILE_MAX_MB")
+    plain = str(tmp_path / "plain.jsonl")
+    j.set_sink(plain)
+    for i in range(40):
+        j.emit("volume.grow", count=i, pad="x" * 64)
+    assert len(open(plain).read().strip().split("\n")) == 40
+    assert not os.path.exists(plain + ".1")
 
 
 def test_event_carries_active_trace_id():
@@ -590,6 +617,52 @@ def _drive_slo_burn(cl):
     assert events.events_total.value(type="slo.burn") == before
 
 
+def _drive_replication_ship(cl):
+    """Ship/ack/lag through the real shipper: a self-mirror (shipper on
+    the holding server pointed at its OWN master — safe, because the
+    receive side applies with journal=False so nothing ships back)
+    tails the volume's change log, observes the lag episode, posts the
+    batch to /admin/replication/apply, and advances the watermark on
+    the ack."""
+    master, servers, _st, _c, _t = cl
+    vid, url, fid = _new_volume(cl, "mirrorcol")
+    vs = next(s for s in servers if s.url() == url)
+    v = vs.store.find_volume(vid)
+    v.enable_rlog()
+    # Journaled write: the _new_volume write predates the change log.
+    rpc.call(f"http://{url}/{fid}", "POST", b"mirrored payload " * 16)
+    sh = ReplicationShipper(vs.store, master.url(), node=url,
+                            collections=v.collection)
+    with root_span("drive.replication_ship", "test"):
+        sh.tick()
+    assert v.rlog.pending() == 0, v.rlog.status()
+
+
+def _drive_replication_cutover(cl):
+    """Verified failover through the real shell command: a throwaway
+    volume server with a shipper (self-paired; zero volumes, so it is
+    trivially caught up) is drained, waited on, and paused by
+    cluster.mirror.cutover under the shell lock."""
+    master, _s, _st, _c, tmp = cl
+    _COLLECTION_N[0] += 1
+    d = tmp / f"cutvs{_COLLECTION_N[0]}"
+    d.mkdir()
+    vs = VolumeServer(master.url(), [str(d)], max_volume_counts=[5],
+                      pulse_seconds=60, replicate_peer=master.url())
+    vs.start()
+    env = CommandEnv(master.url())
+    try:
+        env.lock()
+        with root_span("drive.replication_cutover", "test"):
+            out = run_command(
+                env, "cluster.mirror.cutover -grace 1 -timeout 15")
+        assert "cutover complete" in out
+        assert vs.shipper.paused
+    finally:
+        env.close()
+        vs.stop()
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -622,6 +695,10 @@ DRIVERS = {
     "disk.full": _drive_disk_full,
     "server.shed": _drive_server_shed,
     "slo.burn": _drive_slo_burn,
+    "replication.ship": _drive_replication_ship,
+    "replication.ack": _drive_replication_ship,
+    "replication.lag": _drive_replication_ship,
+    "replication.cutover": _drive_replication_cutover,
 }
 
 
@@ -633,8 +710,9 @@ def test_driver_catalog_matches_registry():
     # the diff shows the new types were consciously added (18 from the
     # journal's introduction + 6 data-integrity types + 5 overload/
     # lifecycle types + 1 codec type: ec.repair.local + 1 SLO type:
-    # slo.burn).
-    assert len(TYPES) == 31
+    # slo.burn + 4 cross-cluster mirror types: replication.ship/ack/
+    # lag/cutover).
+    assert len(TYPES) == 35
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))

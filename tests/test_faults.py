@@ -14,6 +14,7 @@ from seaweedfs_tpu.cluster.master import MasterServer
 from seaweedfs_tpu.cluster.volume_server import VolumeServer
 from seaweedfs_tpu.fault import registry
 from seaweedfs_tpu.parallel import cluster_rebuild
+from seaweedfs_tpu.replication import ReplicationShipper
 
 pytestmark = pytest.mark.faults
 
@@ -214,6 +215,16 @@ def test_shell_fault_ls_and_set(monkeypatch):
 
 # -- every fault point is reachable (the anti-rot smoke test) ----------------
 
+_APPLY_CALLS = [0]
+
+
+def _stub_replication_apply(q, b):
+    """Standby-shaped apply endpoint for the wan.* drivers: acks
+    everything, counts deliveries (the wan.duplicate proof)."""
+    _APPLY_CALLS[0] += 1
+    return {"acked_seq": 0, "applied": 0, "skipped": 0}
+
+
 @pytest.fixture(scope="module")
 def smoke_cluster(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("faultsmoke")
@@ -232,6 +243,8 @@ def smoke_cluster(tmp_path_factory):
     stub.route("GET", "/admin/ec/shard_file",
                lambda q, b: b"\x07" * 64)
     stub.route("POST", "/admin/ec/receive_shard", lambda q, b: {})
+    stub.route("POST", "/admin/replication/apply",
+               _stub_replication_apply)
     stub.start()
     client = WeedClient(master.url())
     yield master, servers, stub, client
@@ -375,6 +388,48 @@ def _drive_net_slow_client(cl):
     rpc.call(f"http://127.0.0.1:{stub.port}/admin/ec/shard_file")
 
 
+def _drive_wan_partition(cl):
+    """The shipped batch never arrives (WAN partition): the first send
+    dies at the wire, the retry policy re-sends — safe, because the
+    receiver applies idempotently by seq — and the batch lands once."""
+    _master, servers, stub, _client = cl
+    sh = ReplicationShipper(servers[0].store, "127.0.0.1:1")
+    n0 = _APPLY_CALLS[0]
+    fault.arm("wan.partition", "fail*1")
+    out = sh._post(f"127.0.0.1:{stub.port}", 1,
+                   {"volume": 1, "records": []})
+    assert out["acked_seq"] == 0
+    assert _APPLY_CALLS[0] - n0 == 1  # failed send never reached the wire
+
+
+def _drive_wan_delay(cl):
+    """WAN latency shaping on the ship path: the send is delayed, not
+    failed, and completes."""
+    _master, servers, stub, _client = cl
+    sh = ReplicationShipper(servers[0].store, "127.0.0.1:1")
+    fault.arm("wan.delay", "delay:0.01*1")
+    out = sh._post(f"127.0.0.1:{stub.port}", 1,
+                   {"volume": 1, "records": []})
+    assert out["acked_seq"] == 0
+
+
+def _drive_wan_duplicate(cl):
+    """Duplicate delivery on purpose: the shipper sends the SAME batch
+    twice and counts the resend — the receiver's applied watermark
+    must make the replay a no-op (proven end-to-end in test_dr.py)."""
+    from seaweedfs_tpu.stats.metrics import replication_resends_total
+    _master, servers, stub, _client = cl
+    sh = ReplicationShipper(servers[0].store, "127.0.0.1:1")
+    before = replication_resends_total.value(reason="duplicate")
+    n0 = _APPLY_CALLS[0]
+    fault.arm("wan.duplicate", "fail*1")
+    sh._post(f"127.0.0.1:{stub.port}", 1,
+             {"volume": 1, "records": []})
+    assert _APPLY_CALLS[0] - n0 == 2, "the same batch must land twice"
+    assert replication_resends_total.value(
+        reason="duplicate") == before + 1
+
+
 DRIVERS = {
     "rpc.connect": _drive_rpc_connect,
     "rpc.send": _drive_rpc_send,
@@ -389,6 +444,9 @@ DRIVERS = {
     "disk.read": _drive_disk_read,
     "disk.full": _drive_disk_full,
     "net.slow_client": _drive_net_slow_client,
+    "wan.partition": _drive_wan_partition,
+    "wan.delay": _drive_wan_delay,
+    "wan.duplicate": _drive_wan_duplicate,
 }
 
 

@@ -1,15 +1,9 @@
-"""Replication: sinks, notification queues, replicator, filer.sync.
+"""Replication: sinks, notification queues, and the replicator pump.
 
 Reference behaviors: weed/replication/replicator.go (event -> sink),
-sink/localsink + filersink + s3sink, notification queues, and
-command/filer_sync.go (active-active sync with loop prevention and
-offset checkpoints).
+sink/localsink + filersink + s3sink, and notification queues.  The
+cross-cluster mirror (change-log shipper) is covered by tests/test_dr.py.
 """
-
-import json
-import os
-import time
-import urllib.request
 
 import pytest
 
@@ -17,9 +11,8 @@ from seaweedfs_tpu.cluster.master import MasterServer
 from seaweedfs_tpu.cluster.volume_server import VolumeServer
 from seaweedfs_tpu.filer.client import FilerProxy
 from seaweedfs_tpu.filer.server import FilerServer
-from seaweedfs_tpu.replication import (FileQueue, FilerSyncWorker,
-                                       LocalSink, MemoryQueue, Replicator,
-                                       sync_once)
+from seaweedfs_tpu.replication import (FileQueue, LocalSink, MemoryQueue,
+                                       Replicator)
 from seaweedfs_tpu.replication.sink import sink_for_spec
 
 
@@ -122,51 +115,6 @@ def test_filer_sink_spec(cluster):
         repl.replicate(ev)
     with pb.get("/fsink-mirror/data.bin") as resp:
         assert resp.read() == bytes(range(100))
-
-
-# -- filer.sync ------------------------------------------------------------
-
-def test_sync_once_and_loop_prevention(cluster):
-    _m, fa, fb = cluster
-    pa, pb = FilerProxy(fa.url()), FilerProxy(fb.url())
-    pa.put("/sync/a-file.txt", b"from-a")
-    n1 = sync_once(fa.url(), fb.url(), "/sync", "/sync")
-    assert n1 >= 1
-    with pb.get("/sync/a-file.txt") as resp:
-        assert resp.read() == b"from-a"
-    # Replayed events on B carry A's signature; syncing B->A must skip
-    # them (loop breaker) and a-file must not bounce back as a new event.
-    n2 = sync_once(fb.url(), fa.url(), "/sync", "/sync")
-    n3 = sync_once(fa.url(), fb.url(), "/sync", "/sync")
-    assert n3 == 0  # steady state: nothing new to apply
-    # Offset checkpoint persisted in target KV.
-    sig_a = pa.meta_info()["signature"]
-    assert pb.kv_get(f"sync.offset.{sig_a:x}") is not None
-
-
-def test_bidirectional_sync_worker(cluster):
-    _m, fa, fb = cluster
-    pa, pb = FilerProxy(fa.url()), FilerProxy(fb.url())
-    worker = FilerSyncWorker(fa.url(), fb.url(), "/bidi", "/bidi",
-                             interval=0.1)
-    worker.start()
-    try:
-        pa.put("/bidi/from-a.txt", b"AAA")
-        pb.put("/bidi/from-b.txt", b"BBB")
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
-            try:
-                with pb.get("/bidi/from-a.txt") as r1, \
-                        pa.get("/bidi/from-b.txt") as r2:
-                    assert r1.read() == b"AAA"
-                    assert r2.read() == b"BBB"
-                break
-            except Exception:
-                time.sleep(0.2)
-        else:
-            pytest.fail("bidirectional sync did not converge")
-    finally:
-        worker.stop()
 
 
 # -- filer.copy CLI --------------------------------------------------------
