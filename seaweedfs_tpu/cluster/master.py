@@ -39,7 +39,9 @@ def _vinfo_from_dict(d: dict) -> VolumeInfo:
         ttl=d.get("ttl", 0), compact_revision=d.get("compact_revision", 0),
         max_file_key=d.get("max_file_key", 0),
         version=d.get("version", 3),
-        corrupt_count=d.get("corrupt_count", 0))
+        corrupt_count=d.get("corrupt_count", 0),
+        modified_at=d.get("modified_at", 0),
+        tiered=d.get("tiered", False))
 
 
 def vinfo_to_dict(v: VolumeInfo) -> dict:
@@ -52,6 +54,7 @@ def vinfo_to_dict(v: VolumeInfo) -> dict:
         "compact_revision": v.compact_revision,
         "max_file_key": v.max_file_key, "version": v.version,
         "corrupt_count": v.corrupt_count,
+        "modified_at": v.modified_at, "tiered": v.tiered,
     }
 
 
@@ -72,7 +75,10 @@ class MasterServer:
                  idle_timeout: float = 120.0,
                  slo_read_p99: float | None = None,
                  slo_availability: float | None = None,
-                 replication_lag_slo: float | None = None):
+                 replication_lag_slo: float | None = None,
+                 lifecycle_rules: str = "",
+                 lifecycle_interval: float = 60.0,
+                 lifecycle_mbps: float = 32.0):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
@@ -156,6 +162,9 @@ class MasterServer:
         s.route("GET", "/vol/list", self._vol_list)
         s.route("POST", "/admin/lease", self._admin_lease)
         s.route("POST", "/admin/release", self._admin_release)
+        s.route("GET", "/cluster/lifecycle", self._cluster_lifecycle)
+        s.route("POST", "/cluster/lifecycle/run",
+                self._cluster_lifecycle_run)
         reg = s.enable_metrics("master")
         # SLO plane: declared objectives drive the burn engine behind
         # /cluster/healthz; /debug/slow + /debug/slo expose exemplars
@@ -206,6 +215,17 @@ class MasterServer:
         self._stop = threading.Event()
         self._sweeper = threading.Thread(target=self._sweep_loop,
                                          daemon=True, name="master-sweep")
+        # Data-lifecycle plane (-lifecycle.rules): the policy daemon
+        # scans heartbeat stats + /debug/hot coldness and drives
+        # tiering/expiry.  Always constructed (healthz and the shell
+        # verb report a disabled plane); the loop only starts with
+        # rules loaded.
+        from ..lifecycle import LifecycleDaemon, Policy, load_rules
+        policy = load_rules(lifecycle_rules) if lifecycle_rules \
+            else Policy([])
+        self.lifecycle = LifecycleDaemon(self, policy,
+                                         interval=lifecycle_interval,
+                                         mbps=lifecycle_mbps)
         # Multi-master HA: a raft node rides on this HTTP server; the
         # leader owns id issuance, followers proxy mutating requests
         # (server/raft_server.go, master_server.go:155).
@@ -370,9 +390,12 @@ class MasterServer:
         if self.admin_scripts:
             threading.Thread(target=self._admin_script_loop,
                              daemon=True, name="master-cron").start()
+        if self.lifecycle.policy.rules:
+            self.lifecycle.start()
 
     def stop(self) -> None:
         self._stop.set()
+        self.lifecycle.stop()
         if self.raft is not None:
             self.raft.stop()
         self.server.stop()
@@ -1105,7 +1128,8 @@ class MasterServer:
                "nodes": nodes, "volumes": volumes,
                "ec_volumes": ec_volumes, "slo": slo_doc,
                "replication": {"lag_slo": self.replication_lag_slo,
-                               "volumes": replication_rows}}
+                               "volumes": replication_rows},
+               "lifecycle": self.lifecycle.status()}
         return not problems, doc
 
     def _cluster_mirror(self, query: dict, body: bytes) -> dict:
@@ -1141,6 +1165,23 @@ class MasterServer:
                 "caught_up": bool(rows) and all(
                     not r.get("lag_seq") for r in rows),
                 "volumes": rows}
+
+    def _cluster_lifecycle(self, query: dict, body: bytes) -> dict:
+        """GET /cluster/lifecycle — the daemon's rules, scan history,
+        and recent actions (the shell's cluster.lifecycle)."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/cluster/lifecycle", query,
+                                         body, "GET")
+        return self.lifecycle.status()
+
+    def _cluster_lifecycle_run(self, query: dict, body: bytes) -> dict:
+        """POST /cluster/lifecycle/run — one synchronous policy scan
+        (the shell's `cluster.lifecycle run`; tests drive the daemon
+        through this instead of waiting out -lifecycle.interval)."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/cluster/lifecycle/run",
+                                         query, body, "POST")
+        return self.lifecycle.scan_once()
 
     def _healthz(self, query: dict, body: bytes):
         """GET /cluster/healthz — 200/503 for load balancers, JSON

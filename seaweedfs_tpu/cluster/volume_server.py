@@ -40,7 +40,7 @@ from ..storage.scrub import ScrubDaemon
 from ..storage.store import Store
 from ..storage.vacuum import vacuum as vacuum_volume
 from ..storage.volume import (CorruptNeedleError, DiskFullError,
-                              NotFoundError, VolumeError)
+                              NotFoundError, TierReadError, VolumeError)
 from ..trace import span as trace_span
 from . import rpc
 
@@ -75,7 +75,10 @@ class VolumeServer:
                  slo_availability: float | None = None,
                  replicate_peer: str | None = None,
                  replicate_collections: str = "",
-                 replicate_interval: float = 0.5):
+                 replicate_interval: float = 0.5,
+                 tier_cache_mb: float = 64.0,
+                 tier_promote_hits: int = 0,
+                 tier_promote_window: float = 60.0):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -223,6 +226,17 @@ class VolumeServer:
         s.route("GET", "/debug/replication", self._debug_replication)
         s.route("POST", "/admin/tier_upload", self._tier_upload)
         s.route("POST", "/admin/tier_download", self._tier_download)
+        s.route("GET", "/debug/tier", self._debug_tier)
+        # Tier plane (-tier.cache.mb / -tier.promote.*): the shared
+        # remote block cache budget, and the auto-promotion policy —
+        # `hits` tiered reads inside `window` seconds schedule a
+        # tier_download back to local disk (0 hits = disabled).
+        from ..storage.remote_cache import CACHE as _tier_cache
+        _tier_cache.configure(int(tier_cache_mb * (1 << 20)))
+        self.tier_promote_hits = tier_promote_hits
+        self.tier_promote_window = tier_promote_window
+        self._promoting: set[int] = set()
+        self._promote_lock = threading.Lock()
         self._setup_metrics()
         # SLO plane: /debug/slow exemplars + /debug/slo state, declared
         # objectives (-slo.read.p99 / -slo.availability) feeding the
@@ -384,6 +398,40 @@ class VolumeServer:
                   replication_lag_seconds_total,
                   replication_lag_seconds):
             reg.register_once(m)
+        # Tiering instruments: the shared remote block cache's
+        # served-byte counters + mover/expiry totals (process-global
+        # singletons), plus live gauges over the cache itself —
+        # occupancy against the -tier.cache.mb budget and the remote
+        # fetch latency quantiles (a WindowedSketch, so the gauges
+        # track the last five minutes, not process lifetime).
+        from ..stats.metrics import (lifecycle_actions_total,
+                                     tier_cache_hit_bytes_total,
+                                     tier_cache_miss_bytes_total,
+                                     tier_moved_bytes_total,
+                                     ttl_expired_bytes_total)
+        for m in (tier_cache_hit_bytes_total,
+                  tier_cache_miss_bytes_total, tier_moved_bytes_total,
+                  ttl_expired_bytes_total, lifecycle_actions_total):
+            reg.register_once(m)
+        from ..storage.remote_cache import CACHE as _tier_cache
+        reg.gauge("SeaweedFS_tier_cache_used_bytes",
+                  "remote block cache occupancy",
+                  callback=lambda: float(_tier_cache.used_bytes()))
+        reg.gauge("SeaweedFS_tier_cache_max_bytes",
+                  "remote block cache budget (-tier.cache.mb)",
+                  callback=lambda: float(_tier_cache.max_bytes))
+
+        def tier_fetch_quantiles() -> dict:
+            out = {}
+            for q, lbl in ((0.5, "0.5"), (0.99, "0.99")):
+                v = _tier_cache.fetch_latency.quantile(q)
+                out[(lbl,)] = v if v is not None else 0.0
+            return out
+
+        reg.gauge("SeaweedFS_tier_read_seconds",
+                  "remote backend block-fetch latency quantiles "
+                  "(5-minute window)", ("quantile",),
+                  callback=tier_fetch_quantiles)
 
     # -- heartbeats ---------------------------------------------------------
 
@@ -512,6 +560,137 @@ class VolumeServer:
             ticks += 1
             # Periodic full sync like the reference's EC beat (17x pulse).
             self._send_heartbeat(full=(ticks % 17 == 0))
+            try:
+                self._lifecycle_tick()
+            except Exception:  # noqa: BLE001 — never kill the heartbeat
+                pass
+
+    # -- holder-side lifecycle (TTL retirement + auto-promotion) -------------
+
+    def _lifecycle_tick(self) -> None:
+        """Piggybacks on the heartbeat cadence: retire TTL volumes whose
+        newest write is past expiry (every needle inside is already a
+        404 — the files are pure garbage), and promote tiered volumes
+        the block cache says turned hot again."""
+        from ..storage import expiry as _expiry
+        from ..storage.remote_cache import CACHE
+        for loc in self.store.locations:
+            for v in list(loc.volumes.values()):
+                ttl = v.super_block.ttl
+                if ttl.minutes() > 0 and _expiry.volume_expired(
+                        ttl, getattr(v, "modified_at", 0),
+                        # Grace past nominal expiry: clock skew between
+                        # writers plus a couple of pulses so the master
+                        # steers away first.
+                        grace=max(0.1 * ttl.minutes() * 60,
+                                  2.0 * self.pulse_seconds)):
+                    self._retire_expired_volume(v)
+                    continue
+                if v.remote_file is not None and \
+                        self.tier_promote_hits > 0:
+                    hits = CACHE.hits_in_window(
+                        v.remote_file.backend.spec, v.remote_file.key,
+                        self.tier_promote_window)
+                    if hits >= self.tier_promote_hits:
+                        self._schedule_promotion(v.vid)
+
+    def _retire_expired_volume(self, v) -> None:
+        """Whole-volume TTL retirement (the reference's volume-level
+        TTL vacuum): drop the remote object if tiered, delete the local
+        files, tell the master via a full heartbeat."""
+        size = v.dat_size()
+        tiered = v.remote_file is not None
+        if tiered:
+            # Best-effort remote delete BEFORE the local unmount: the
+            # .vif (removed by delete_volume) is the only pointer to
+            # the object, and a leaked remote .dat is paid-for garbage.
+            from ..storage.tier import _tier_credentials, load_vif
+            info = load_vif(v.file_name())
+            if info and info.get("files"):
+                fdesc = info["files"][0]
+                try:
+                    from ..storage.backend import backend_for_spec
+                    ak, sk = _tier_credentials()
+                    backend_for_spec(fdesc["backend_spec"], ak,
+                                     sk).delete(fdesc["key"])
+                except Exception:  # noqa: BLE001 — retirement proceeds
+                    pass
+        try:
+            self.store.delete_volume(v.vid)
+        except VolumeError:
+            return
+        from ..stats.metrics import ttl_expired_bytes_total
+        ttl_expired_bytes_total.inc(size, via="volume_retire")
+        emit_event("volume.expired", node=self.url(), vid=v.vid,
+                   collection=v.collection, bytes=size, tiered=tiered,
+                   ttl=str(v.super_block.ttl))
+        try:
+            self._send_heartbeat(full=True)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _schedule_promotion(self, vid: int) -> None:
+        """Sustained cache hits inside the window: bring the .dat back
+        local in the background (one promotion per volume at a time)."""
+        with self._promote_lock:
+            if vid in self._promoting:
+                return
+            self._promoting.add(vid)
+        threading.Thread(target=self._promote_volume, args=(vid,),
+                         name=f"promote:{vid}", daemon=True).start()
+
+    def _promote_volume(self, vid: int) -> None:
+        from ..stats.metrics import lifecycle_actions_total
+        from ..storage.tier import _tier_credentials, \
+            move_dat_from_remote
+        try:
+            v = self.store.find_volume(vid)
+            if v is None or v.remote_file is None:
+                return
+            ak, sk = _tier_credentials()
+            try:
+                move_dat_from_remote(v, access_key=ak, secret_key=sk)
+            except Exception:  # noqa: BLE001 — retried next window
+                lifecycle_actions_total.inc(action="promote",
+                                            outcome="error")
+                return
+            lifecycle_actions_total.inc(action="promote", outcome="ok")
+            emit_event("lifecycle.promote", node=self.url(), vid=vid,
+                       collection=v.collection, bytes=v.dat_size())
+            try:
+                self._send_heartbeat(full=True)
+            except Exception:  # noqa: BLE001
+                pass
+        finally:
+            with self._promote_lock:
+                self._promoting.discard(vid)
+
+    def _debug_tier(self, query: dict, body: bytes) -> dict:
+        """Tier state of every volume here + the shared cache's live
+        numbers — the data behind `volume.tier.status`."""
+        from ..storage.remote_cache import CACHE
+        from ..storage.tier import load_vif
+        vols = []
+        for loc in self.store.locations:
+            for v in list(loc.volumes.values()):
+                ent = {"volume": v.vid, "collection": v.collection,
+                       "tiered": v.remote_file is not None,
+                       "ttl": str(v.super_block.ttl),
+                       "modified_at": getattr(v, "modified_at", 0)}
+                if v.remote_file is not None:
+                    info = load_vif(v.file_name()) or {}
+                    files = info.get("files") or [{}]
+                    ent["remote"] = {
+                        "backend_spec": files[0].get("backend_spec"),
+                        "key": files[0].get("key"),
+                        "file_size": files[0].get("file_size")}
+                    ent["hits_in_window"] = CACHE.hits_in_window(
+                        v.remote_file.backend.spec, v.remote_file.key,
+                        self.tier_promote_window)
+                vols.append(ent)
+        return {"volumes": vols, "cache": CACHE.stats(),
+                "promote": {"hits": self.tier_promote_hits,
+                            "window": self.tier_promote_window}}
 
     # -- public needle handlers ---------------------------------------------
 
@@ -586,6 +765,10 @@ class VolumeServer:
                 n = self.store.read_needle(vid, key, cookie)
             except NotFoundError as e:
                 raise rpc.RpcError(404, str(e)) from None
+            except TierReadError as e:
+                raise rpc.RpcError(503, str(e),
+                                   headers={"Retry-After": "1"}) \
+                    from None
             except CorruptNeedleError as e:
                 # A probe must answer what IS here: 503 flags a rotten
                 # local copy so fsck/replica-repair treat this holder
@@ -712,6 +895,14 @@ class VolumeServer:
                     n = self._degraded_read(v, vid, key, cookie, e)
                 else:
                     raise rpc.RpcError(404, str(e)) from None
+            except TierReadError as e:
+                # Remote tier unreachable (WAN partition / backend
+                # down): the local bytes are gone BY DESIGN, so
+                # degraded-read repair has nothing to heal — answer a
+                # bounded, retryable 503 with a pacing hint.
+                raise rpc.RpcError(503, str(e),
+                                   headers={"Retry-After": "1"}) \
+                    from None
             except (CorruptNeedleError, OSError) as e:
                 # CRC failure or a dying sector on the read path: the
                 # same self-healing repair the scrub uses, in line —
@@ -1454,6 +1645,15 @@ class VolumeServer:
             n.set_name(query["name"].encode())
         if "mime" in query:
             n.set_mime(query["mime"].encode())
+        if query.get("ttl"):
+            # Stamp the assign-time ?ttl on the needle itself
+            # (needle_parse_upload.go): expiry then survives a copy
+            # into a volume whose superblock says something else.
+            from ..core.ttl import TTL as _TTL
+            try:
+                n.set_ttl(_TTL.parse(query["ttl"]))
+            except ValueError:
+                pass
         n.set_last_modified(int(time.time()))
         # Rollback applies only to a BRAND-NEW needle: for an overwrite
         # of an existing fid, deleting would tombstone the prior

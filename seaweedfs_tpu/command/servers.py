@@ -169,6 +169,13 @@ def run_master(flags: Flags, args: list[str]) -> int:
         # which /cluster/healthz degrades (0/absent = no SLO).
         replication_lag_slo=flags.get_float("replicate.lag.slo",
                                             0.0) or None,
+        # Data-lifecycle plane: -lifecycle.rules names a policy file
+        # (line grammar or TOML) and turns on the leader-side daemon
+        # that tiers cold volumes and vacuums expired TTL data;
+        # -lifecycle.mbps throttles its tier-upload bandwidth.
+        lifecycle_rules=flags.get("lifecycle.rules", ""),
+        lifecycle_interval=flags.get_float("lifecycle.interval", 60.0),
+        lifecycle_mbps=flags.get_float("lifecycle.mbps", 32.0),
         **_slo_flags(flags))
     m.start()
     glog.infof("master serving at %s", m.server.url())
@@ -226,6 +233,14 @@ def run_volume(flags: Flags, args: list[str]) -> int:
                         if flags.get("replicate.peer") else None),
         replicate_collections=flags.get("replicate.collections", ""),
         replicate_interval=flags.get_float("replicate.interval", 0.5),
+        # Remote-tier knobs: -tier.cache.mb bounds the read-through
+        # block cache for tiered volumes; -tier.promote.hits (>0) turns
+        # on auto-promotion — a tiered volume whose cache sees that
+        # many distinct reads inside -tier.promote.window seconds is
+        # downloaded back local.
+        tier_cache_mb=flags.get_float("tier.cache.mb", 64.0),
+        tier_promote_hits=flags.get_int("tier.promote.hits", 0),
+        tier_promote_window=flags.get_float("tier.promote.window", 60.0),
         # -slo.read.p99 / -slo.availability: declared objectives for
         # the burn engine; exemplars + quantiles run regardless.
         **_slo_flags(flags))
@@ -325,6 +340,10 @@ def run_server(flags: Flags, args: list[str]) -> int:
                    "volumeSizeLimitMB", 30 * 1024),
                default_replication=flags.get("defaultReplication", "000"),
                ssl_context=_security("master"),
+               lifecycle_rules=flags.get("lifecycle.rules", ""),
+               lifecycle_interval=flags.get_float("lifecycle.interval",
+                                                  60.0),
+               lifecycle_mbps=flags.get_float("lifecycle.mbps", 32.0),
                # -slo.* applies to EVERY embedded role, same as the
                # standalone commands — half-declared objectives would
                # silently disable master-side burn.
@@ -352,6 +371,12 @@ def run_server(flags: Flags, args: list[str]) -> int:
                       disk_reserve_mb=flags.get_float("disk.reserve",
                                                       0.0),
                       ec_codec=flags.get("ec.codec", "rs"),
+                      tier_cache_mb=flags.get_float("tier.cache.mb",
+                                                    64.0),
+                      tier_promote_hits=flags.get_int(
+                          "tier.promote.hits", 0),
+                      tier_promote_window=flags.get_float(
+                          "tier.promote.window", 60.0),
                       **_slo_flags(flags))
     vs.start()
     servers.append(vs)
@@ -381,6 +406,8 @@ def run_server(flags: Flags, args: list[str]) -> int:
             from ..s3api.server import S3ApiServer
             s3 = S3ApiServer(filer_url=fs.server.url(), host=ip,
                              port=flags.get_int("s3.port", 8333),
+                             identities=_s3_identities(
+                                 flags.get("s3.config")),
                              ssl_context=_security("s3"))
             s3.start()
             servers.append(s3)
@@ -401,7 +428,9 @@ def _norm_master(addr: str) -> str:
 
 
 register(Command("master", "master -port=9333 -mdir=/tmp/meta"
-                 " [-replicate.lag.slo=30(s)]",
+                 " [-replicate.lag.slo=30(s)]"
+                 " [-lifecycle.rules=rules.txt]"
+                 " [-lifecycle.interval=60] [-lifecycle.mbps=32]",
                  "start a master server", run_master))
 register(Command("volume",
                  "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
@@ -410,7 +439,9 @@ register(Command("volume",
                  " [-shutdown.grace=30] [-ec.codec=rs|lrc]"
                  " [-slo.read.p99=0.05] [-slo.availability=99.9]"
                  " [-replicate.peer=standby-master:9333]"
-                 " [-replicate.collections=a,b] [-replicate.interval=0.5]",
+                 " [-replicate.collections=a,b] [-replicate.interval=0.5]"
+                 " [-tier.cache.mb=64] [-tier.promote.hits=0]"
+                 " [-tier.promote.window=60]",
                  "start a volume server", run_volume))
 register(Command("filer", "filer -port=8888 -master=host:9333",
                  "start a filer server", run_filer))
@@ -421,6 +452,9 @@ register(Command("s3", "s3 -port=8333 -filer=host:8888",
 register(Command("webdav", "webdav -port=7333 -filer=host:8888",
                  "start a WebDAV gateway", run_webdav))
 register(Command("server",
-                 "server -dir=/data -filer=true -s3=true",
+                 "server -dir=/data -filer=true -s3=true"
+                 " [-s3.config=identities.json]"
+                 " [-lifecycle.rules=rules.txt]"
+                 " [-tier.cache.mb=64] [-tier.promote.hits=0]",
                  "start master+volume(+filer+s3) in one process",
                  run_server))

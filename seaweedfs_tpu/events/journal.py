@@ -116,6 +116,18 @@ TYPES: dict[str, str] = {
                            "roles: the primary drained, the standby "
                            "caught up to the watermark and became "
                            "writable",
+    "lifecycle.tier": "the lifecycle daemon moved a cold volume to its "
+                      "rule's remote backend (readonly -> tier_upload "
+                      "on the holder, throttled over the low-priority "
+                      "lane)",
+    "lifecycle.promote": "a tiered volume turned hot again (sustained "
+                         "block-cache hits inside the promotion "
+                         "window) and was downloaded back to local "
+                         "disk",
+    "volume.expired": "a TTL volume whose newest write is past expiry "
+                      "was retired whole: remote copy deleted if "
+                      "tiered, local files dropped, master unregisters "
+                      "it on the next heartbeat",
 }
 
 SEVERITIES = ("info", "warn", "error")

@@ -97,6 +97,10 @@ POINTS: dict[str, str] = {
                      "send — an armed fail makes the shipper deliver "
                      "the SAME batch twice; the receiver's applied-seq "
                      "watermark must no-op the replay",
+    "tier.read": "remote-tier ranged GET (the block-cache fetch leg) "
+                 "— an armed fail is a WAN-partitioned backend; the "
+                 "needle read path must answer a bounded 503, never "
+                 "hang",
 }
 
 KINDS = ("fail", "delay", "status", "drop")

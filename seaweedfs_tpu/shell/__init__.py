@@ -19,3 +19,4 @@ from . import command_fault  # noqa: F401,E402
 from . import command_cluster  # noqa: F401,E402
 from . import command_profile  # noqa: F401,E402
 from . import command_mirror  # noqa: F401,E402
+from . import command_lifecycle  # noqa: F401,E402

@@ -403,6 +403,37 @@ replication_lag_seconds = Gauge(
     ("volume",))
 
 
+# -- tiering / lifecycle instruments -----------------------------------------
+# Process-global singletons the tier plane observes into: the shared
+# remote block cache (storage/remote_cache.py, served-byte accounting
+# at pread granularity), the tier movers (storage/tier.py), vacuum's
+# TTL reclaim, and the master's lifecycle daemon.  The volume server
+# and master register the same objects on their /metrics scrape.
+
+tier_cache_hit_bytes_total = Counter(
+    "SeaweedFS_tier_cache_hit_bytes_total",
+    "tiered-read bytes served from the remote block cache")
+
+tier_cache_miss_bytes_total = Counter(
+    "SeaweedFS_tier_cache_miss_bytes_total",
+    "tiered-read bytes that had to be fetched from the remote backend")
+
+tier_moved_bytes_total = Counter(
+    "SeaweedFS_tier_moved_bytes_total",
+    "volume .dat bytes moved across the tier boundary",
+    ("direction",))  # upload|download
+
+ttl_expired_bytes_total = Counter(
+    "SeaweedFS_ttl_expired_bytes_total",
+    "bytes reclaimed from TTL-expired needles",
+    ("via",))  # vacuum|volume_retire
+
+lifecycle_actions_total = Counter(
+    "SeaweedFS_lifecycle_actions_total",
+    "lifecycle daemon actions by kind and outcome",
+    ("action", "outcome"))  # tier|expire|promote x ok|error
+
+
 def observe_batch_stage(stages: dict, stage: str, seconds: float,
                         nbytes: int) -> None:
     """observe_ec_stage plus a per-batch accumulator: the batched EC

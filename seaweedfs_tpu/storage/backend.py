@@ -16,12 +16,16 @@ from __future__ import annotations
 
 import os
 import shutil
-import threading
 import urllib.parse
 import urllib.request
-from collections import OrderedDict
 
 REMOTE_BLOCK = 1 << 20  # ranged-GET granularity for remote preads
+
+# Needle-read ranged GETs must be bounded: a WAN-partitioned backend
+# has to surface as a fast, retryable error (the volume server maps it
+# to 503), never a 60s-per-block hang that wedges every reader queued
+# behind the singleflight.
+REMOTE_READ_TIMEOUT = 20.0
 
 
 class BackendStorageFile:
@@ -53,47 +57,49 @@ class DiskFile(BackendStorageFile):
 
 
 class RemoteFile(BackendStorageFile):
-    """Read-only view of a remote object with block-aligned range reads
-    and a small LRU cache (the reference proxies reads through its
-    backend the same way)."""
+    """Read-only view of a remote object: block-aligned range reads
+    through the process-global read-through cache (storage/remote_cache
+    — bounded bytes, singleflight per block), plus the per-read
+    accounting the promotion policy consumes."""
 
     def __init__(self, backend: "BackendStorage", key: str,
                  file_size: int, cache_blocks: int = 32):
+        # cache_blocks is accepted for signature compatibility; the
+        # budget is the process-wide byte bound now.
         self.backend = backend
         self.key = key
         self._size = file_size
-        self._cache: OrderedDict[int, bytes] = OrderedDict()
-        self._cache_blocks = cache_blocks
-        self._lock = threading.Lock()
 
-    def _block(self, idx: int) -> bytes:
-        with self._lock:
-            blk = self._cache.get(idx)
-            if blk is not None:
-                self._cache.move_to_end(idx)
-                return blk
+    def _block(self, idx: int) -> tuple[bytes, bool]:
+        from .remote_cache import CACHE
         lo = idx * REMOTE_BLOCK
         n = min(REMOTE_BLOCK, self._size - lo)
-        blk = self.backend.read_range(self.key, lo, n)
-        with self._lock:
-            self._cache[idx] = blk
-            while len(self._cache) > self._cache_blocks:
-                self._cache.popitem(last=False)
-        return blk
+        return CACHE.get_block(self.backend, self.key, idx, lo, n)
 
     def pread(self, size: int, offset: int) -> bytes:
+        from .remote_cache import CACHE
         if offset >= self._size:
             return b""
         size = min(size, self._size - offset)
         out = bytearray()
         pos = offset
+        hit_b = miss_b = 0
         while pos < offset + size:
             idx = pos // REMOTE_BLOCK
-            blk = self._block(idx)
+            blk, hit = self._block(idx)
             lo = pos - idx * REMOTE_BLOCK
             take = min(len(blk) - lo, offset + size - pos)
             out += blk[lo:lo + take]
             pos += take
+            if hit:
+                hit_b += take
+            else:
+                miss_b += take
+        if hit_b:
+            CACHE.record_served(hit_b, hit=True)
+        if miss_b:
+            CACHE.record_served(miss_b, hit=False)
+        CACHE.record_read(self.backend.spec, self.key)
         return bytes(out)
 
     def size(self) -> int:
@@ -241,7 +247,8 @@ class S3Backend(BackendStorage):
             hdrs = sign_request("GET", self._url(key), hdrs, b"",
                                 self.access_key, self.secret_key)
         req = urllib.request.Request(self._url(key), headers=hdrs)
-        with urllib.request.urlopen(req, timeout=60) as resp:
+        with urllib.request.urlopen(
+                req, timeout=REMOTE_READ_TIMEOUT) as resp:
             return resp.read()
 
     def delete(self, key: str) -> None:

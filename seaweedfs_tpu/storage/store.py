@@ -42,6 +42,11 @@ class VolumeInfo:
     # Unrepaired corrupt needles (open repair tickets, storage/scrub):
     # nonzero degrades the volume on /cluster/healthz.
     corrupt_count: int = 0
+    # Newest-write wall time (epoch sec) and tier state, the signals
+    # the master's lifecycle daemon plans from (idle/age rules, TTL
+    # retirement, don't-re-tier).
+    modified_at: int = 0
+    tiered: bool = False
 
 
 class DiskLocation:
@@ -247,10 +252,19 @@ class Store:
                 v = loc.volumes.pop(vid, None)
                 if v is not None:
                     info = self._volume_info(v)
+                    tiered = v.remote_file is not None
                     v.close()
                     base = v.file_name()
-                    for ext in (".dat", ".idx", ".qrt",
-                                ".rlog", ".rwm", ".rap"):
+                    exts = [".dat", ".idx", ".qrt",
+                            ".rlog", ".rwm", ".rap"]
+                    if tiered:
+                        # Only a tiered volume owns the .vif it mounts
+                        # from.  A local volume's sidecar (if any)
+                        # belongs to EC shards sharing the base name —
+                        # ec.generate's version record must survive
+                        # deleting the source replica.
+                        exts.append(".vif")
+                    for ext in exts:
                         try:
                             os.remove(base + ext)
                         except FileNotFoundError:
@@ -355,7 +369,9 @@ class Store:
             ttl=v.super_block.ttl.to_uint32(),
             compact_revision=v.super_block.compaction_revision,
             max_file_key=v.max_file_key(), version=v.version,
-            corrupt_count=v.corrupt_count())
+            corrupt_count=v.corrupt_count(),
+            modified_at=int(getattr(v, "modified_at", 0) or 0),
+            tiered=v.remote_file is not None)
 
     def collect_heartbeat(self) -> dict:
         """Full heartbeat payload (CollectHeartbeat, store.go:198)."""

@@ -57,16 +57,42 @@ def move_dat_to_remote(volume: Volume, dest_spec: str,
     base = volume.file_name()
     key = tier_key(volume.collection, volume.vid)
     volume.sync()
-    size = backend.upload_file(key, base + ".dat")
+    want = os.path.getsize(base + ".dat")
+    try:
+        size = backend.upload_file(key, base + ".dat")
+    except Exception:  # noqa: BLE001
+        # A previously-crashed upload can leave a partial/stale object
+        # at the key and some backends refuse the overwrite.  Clear it
+        # and re-upload once; a second failure propagates with the
+        # volume still fully local (.vif not yet written).
+        try:
+            backend.delete(key)
+        except Exception:  # noqa: BLE001
+            pass
+        size = backend.upload_file(key, base + ".dat")
+    if size != want:
+        # Never publish a .vif pointing at a short object; the local
+        # .dat is still authoritative.
+        try:
+            backend.delete(key)
+        except Exception:  # noqa: BLE001
+            pass
+        raise VolumeError(
+            f"tier upload of volume {volume.vid} wrote {size} bytes, "
+            f"local .dat has {want}")
     # No credentials in the sidecar: the .vif sits on the data dir and
     # must never leak keys (the reference keeps backend credentials in
     # centrally-distributed config) — they come from server config/env
     # at open time.
+    # modified_at is the volume's newest-WRITE time, not the upload
+    # time: TTL expiry decisions must survive the round-trip through
+    # the remote tier.
     info = {"volume_id": volume.vid, "version": volume.version,
             "collection": volume.collection,
             "files": [{"backend_spec": dest_spec, "key": key,
                        "file_size": size,
-                       "modified_at": int(time.time())}]}
+                       "modified_at": int(getattr(
+                           volume, "modified_at", 0) or time.time())}]}
     save_vif(base, info)
     # The fd swap rides the same write lock vacuum uses, so a reader
     # mid-pread can never observe a closed fd.
@@ -79,6 +105,8 @@ def move_dat_to_remote(volume: Volume, dest_spec: str,
     if not keep_local:
         os.remove(base + ".dat")
     from ..events import emit as emit_event
+    from ..stats import metrics as _metrics
+    _metrics.tier_moved_bytes_total.inc(size, direction="upload")
     emit_event("tier.move", vid=volume.vid, direction="upload",
                dest=dest_spec, bytes=size, keep_local=keep_local)
     return info
@@ -105,16 +133,41 @@ def move_dat_from_remote(volume: Volume, keep_remote: bool = False,
         access_key, secret_key = _tier_credentials()
     backend = backend_for_spec(fdesc["backend_spec"],
                                access_key, secret_key)
-    backend.download_file(fdesc["key"], base + ".dat")
+    # Download to a temp name and os.replace only after verifying the
+    # byte count: a crash mid-download must never leave a truncated
+    # .dat beside a live .vif (the remount would prefer the torn local
+    # copy over the intact remote one).
+    tmp = base + ".dat.tmpdl"
+    try:
+        os.remove(tmp)
+    except FileNotFoundError:
+        pass
+    got = backend.download_file(fdesc["key"], tmp)
+    want = fdesc.get("file_size")
+    if want is not None and got != want:
+        try:
+            os.remove(tmp)
+        except FileNotFoundError:
+            pass
+        raise VolumeError(
+            f"tier download of volume {volume.vid} got {got} bytes, "
+            f".vif records {want}")
+    os.replace(tmp, base + ".dat")
     with volume._file_lock.write():
         remote = volume.remote_file
         volume._dat = open(base + ".dat", "r+b")
         volume.remote_file = None
     remote.close()
+    # The remote copy may be deleted next; stale cached blocks must not
+    # outlive it (a re-tier to the same key would serve old bytes).
+    from .remote_cache import CACHE
+    CACHE.drop_file(fdesc["backend_spec"], fdesc["key"])
     os.remove(vif_path(base))
     if not keep_remote:
         backend.delete(fdesc["key"])
     from ..events import emit as emit_event
+    from ..stats import metrics as _metrics
+    _metrics.tier_moved_bytes_total.inc(got, direction="download")
     emit_event("tier.move", vid=volume.vid, direction="download",
                source=fdesc["backend_spec"],
                bytes=fdesc.get("file_size", 0),
@@ -135,5 +188,7 @@ def open_remote_volume(dir_: str, collection: str, vid: int) -> Volume:
     ak, sk = _tier_credentials()
     backend = backend_for_spec(fdesc["backend_spec"], ak, sk)
     remote = backend.open_file(fdesc["key"], fdesc["file_size"])
-    return Volume(dir_, collection, vid, create=False,
-                  remote_file=remote)
+    v = Volume(dir_, collection, vid, create=False,
+               remote_file=remote)
+    v.modified_at = float(fdesc.get("modified_at", 0) or 0)
+    return v

@@ -24,6 +24,7 @@ from ..core import idx as idx_mod
 from ..core import types as t
 from ..core.needle import Needle
 from ..core.super_block import SuperBlock
+from . import expiry as _expiry
 from .volume import Volume
 from .volume_scanner import scan_volume_file
 
@@ -56,6 +57,8 @@ def compact(volume: Volume) -> int:
             compaction_revision=volume.super_block.compaction_revision + 1,
             extra=volume.super_block.extra)
 
+        expired_count = 0
+        expired_bytes = 0
         with open(base + ".cpd", "wb") as cpd, \
                 open(base + ".cpx", "wb") as cpx:
             cpd.write(sb.to_bytes())
@@ -68,11 +71,21 @@ def compact(volume: Volume) -> int:
                 live = volume.nm.get(needle.id)
                 if live is None or live[0] != offset:
                     continue  # deleted or superseded
+                # TTL-expired == dead: the read path already 404s these
+                # (volume.read_needle), so dropping the record is the
+                # reclaim step, not a behavior change.  The map entry
+                # vanishes with the .cpx swap.
+                if _expiry.needle_expired(needle, volume.super_block.ttl):
+                    expired_count += 1
+                    expired_bytes += total
+                    continue
                 blob = needle.to_bytes(volume.version)
                 cpd.write(blob)
                 idx_mod.append_entry(cpx, needle.id, new_offset, needle.size)
                 new_offset += len(blob)
         volume.vacuum_staged = snapshot_size
+        volume.vacuum_expired_count = expired_count
+        volume.vacuum_expired_bytes = expired_bytes
     return snapshot_size
 
 
@@ -164,8 +177,16 @@ def vacuum(volume: Volume) -> None:
         t0 = _time.perf_counter()
         compact(volume)
         commit_compact(volume)
+        expired_count = getattr(volume, "vacuum_expired_count", 0)
+        expired_bytes = getattr(volume, "vacuum_expired_bytes", 0)
+        if expired_bytes:
+            from ..stats import metrics as _metrics
+            _metrics.ttl_expired_bytes_total.inc(expired_bytes,
+                                                 via="vacuum")
         emit_event("volume.vacuum", vid=volume.vid,
                    seconds=round(_time.perf_counter() - t0, 6),
                    reclaimed_bytes=before_bytes - volume.dat_size(),
+                   expired_needles=expired_count,
+                   expired_bytes=expired_bytes,
                    garbage_before=round(before_ratio, 4),
                    garbage_after=round(volume.garbage_ratio(), 4))

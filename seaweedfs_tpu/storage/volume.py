@@ -30,6 +30,7 @@ from ..fault import registry as _fault
 from ..stats import contention as _contention
 from ..stats import phases as _phases
 from ..utils.rwlock import RWLock
+from . import expiry as _expiry
 from .needle_map import new_needle_map
 
 MAX_BATCH_REQUESTS = 128
@@ -56,6 +57,14 @@ class DiskFullError(VolumeError):
     volume flipped readonly; the volume server re-checks its disk
     reserve and heartbeats the state so the master steers assignment
     away."""
+
+
+class TierReadError(VolumeError):
+    """A remote-tier ranged read failed (WAN partition, backend down,
+    timeout).  Distinct from CorruptNeedleError/OSError so the read
+    path answers a bounded, retryable 503 instead of routing into
+    degraded-read repair — the local bytes are gone by design, not
+    rotten."""
 
 
 @dataclass
@@ -231,6 +240,10 @@ class Volume:
             self.nm = new_needle_map(needle_map_kind, base + ".idx")
             self._append_at = remote_file.size()
             self.last_modified = time.time()
+            # Newest-write wall time; open_remote_volume restores the
+            # real value from the .vif (a tiered volume is readonly, so
+            # it can't advance).
+            self.modified_at = 0.0
             self._closed = False
             self._use_worker = False
             self._queue = queue.Queue(maxsize=1)
@@ -269,6 +282,11 @@ class Volume:
         self._dat.seek(0, os.SEEK_END)
         self._append_at = self._dat.tell()
         self.last_modified = time.time()
+        # Newest-write wall time, the TTL-expiry anchor: seeded from
+        # the .dat mtime across restarts (close enough — the mtime IS
+        # the last append), advanced by every committed write.
+        self.modified_at = os.path.getmtime(base + ".dat") if exists \
+            else 0.0
         if os.path.exists(base + ".rlog"):
             self.enable_rlog()
 
@@ -387,6 +405,7 @@ class Volume:
         """Append the record bytes (no map publication, no sync)."""
         if self.readonly:
             raise VolumeError(f"volume {self.vid} is read only")
+        self.modified_at = _expiry.now()
         offset = self._append_at
         if offset % t.NEEDLE_PADDING_SIZE != 0:
             # Self-heal like the reference: realign to the padding grid.
@@ -680,7 +699,16 @@ class Volume:
             _led = _phases.active()
             _t = time.perf_counter() if _led is not None else 0.0
             if self.remote_file is not None:
-                blob = self.remote_file.pread(total, offset)
+                # Any remote failure — FaultInjected (an OSError!),
+                # URLError, timeout — becomes TierReadError so the
+                # server maps it to a retryable 503 instead of routing
+                # it into degraded-read repair.
+                try:
+                    blob = self.remote_file.pread(total, offset)
+                except Exception as e:
+                    raise TierReadError(
+                        f"volume {self.vid}: remote read failed: "
+                        f"{e}") from e
             else:
                 blob = os.pread(self._dat.fileno(), total, offset)
             if _led is not None:
@@ -694,9 +722,11 @@ class Volume:
         if cookie is not None and n.cookie != cookie:
             raise VolumeError(
                 f"cookie mismatch for needle {needle_id:x}")
-        if n.has_ttl() and n.ttl.minutes() > 0 and n.has_last_modified_date():
-            if time.time() > n.last_modified + n.ttl.minutes() * 60:
-                raise NotFoundError(f"needle {needle_id:x} expired")
+        # Expiry honors the per-needle TTL first, then the volume
+        # superblock's (the assign-time ?ttl) — storage/expiry.py is
+        # the single decision point.
+        if _expiry.needle_expired(n, self.super_block.ttl):
+            raise NotFoundError(f"needle {needle_id:x} expired")
         return n
 
     def pread(self, size: int, offset: int) -> bytes:
@@ -707,7 +737,12 @@ class Volume:
                 _fault.hit("disk.read", vid=self.vid)
             with _phases.phase("disk"):
                 if self.remote_file is not None:
-                    return self.remote_file.pread(size, offset)
+                    try:
+                        return self.remote_file.pread(size, offset)
+                    except Exception as e:
+                        raise TierReadError(
+                            f"volume {self.vid}: remote read "
+                            f"failed: {e}") from e
                 return os.pread(self._dat.fileno(), size, offset)
 
     def read_needle_slice(self, needle_id: int,

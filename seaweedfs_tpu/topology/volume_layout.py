@@ -47,6 +47,16 @@ class VolumeLayout:
             else:
                 self._remove_writable(v.id)
 
+    def _is_near_expiry(self, v) -> bool:
+        """TTL layout steering: past half the TTL since the volume's
+        newest write, new assignments go to a fresher volume so this
+        one drains toward whole-volume retirement (the holder-side
+        sweeper deletes it once fully expired) instead of being kept
+        alive by a trickle of writes."""
+        from ..storage import expiry as _expiry
+        return _expiry.volume_near_expiry(
+            self.ttl, getattr(v, "modified_at", 0))
+
     def unregister_volume(self, v, dn: DataNode) -> None:
         with self._lock:
             locs = self.vid2location.get(v.id, [])
@@ -68,7 +78,8 @@ class VolumeLayout:
         return v.size >= self.volume_size_limit
 
     def _is_writable(self, v) -> bool:
-        return not self._is_oversized(v) and not v.read_only
+        return not self._is_oversized(v) and not v.read_only \
+            and not self._is_near_expiry(v)
 
     def _set_writable(self, vid: int) -> None:
         if vid not in self.writables:

@@ -663,6 +663,68 @@ def _drive_replication_cutover(cl):
         vs.stop()
 
 
+def _drive_lifecycle_tier(cl):
+    """Policy-driven tiering through the real daemon: a min-age rule
+    matches the fresh single-copy volume on the next scan and the
+    daemon drives readonly + tier_upload on its holder."""
+    from seaweedfs_tpu.lifecycle import LifecycleDaemon, Rule
+    from seaweedfs_tpu.lifecycle.policy import Policy
+    master, servers, _st, _c, tmp = cl
+    vid, url, _fid = _new_volume(cl, "lccol")
+    # The daemon reads modified_at from heartbeat state; push one.
+    next(s for s in servers
+         if s.url() == url)._send_heartbeat(full=True)
+    time.sleep(0.05)  # the int modified_at must be strictly in the past
+    col = next(dn.volumes[vid].collection
+               for dn in master.topo.leaves() if vid in dn.volumes)
+    policy = Policy([Rule(collection=col, action="tier",
+                          dest=f"local://{tmp}/lctier", min_age=0.001)])
+    daemon = LifecycleDaemon(master, policy, interval=3600)
+    with root_span("drive.lifecycle_tier", "test"):
+        out = daemon.scan_once()
+    assert vid in out["tiered"], out
+
+
+def _drive_lifecycle_promote(cl):
+    """Auto-promotion through the real holder-side path: tier a volume,
+    then run the promotion worker directly (the scheduler just wraps it
+    in a thread + dedup guard)."""
+    _m, servers, _st, _c, tmp = cl
+    vid, url, _fid = _new_volume(cl, "promcol")
+    rpc.call_json(f"http://{url}/admin/readonly", "POST",
+                  {"volume": vid, "readonly": True})
+    rpc.call_json(f"http://{url}/admin/tier_upload", "POST",
+                  {"volume": vid, "dest": f"local://{tmp}/promtier"})
+    vs = next(s for s in servers if s.url() == url)
+    with root_span("drive.lifecycle_promote", "test"):
+        vs._promote_volume(vid)
+    assert vs.store.find_volume(vid).remote_file is None
+
+
+def _drive_volume_expired(cl):
+    """Whole-volume TTL retirement through the real sweeper: a 1-minute
+    TTL volume, the expiry clock pushed past TTL + grace, one
+    _lifecycle_tick on the holder."""
+    from seaweedfs_tpu.storage import expiry
+    master, servers, _st, _c, _t = cl
+    _COLLECTION_N[0] += 1
+    col = f"expcol{_COLLECTION_N[0]}"
+    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}"
+             f"&ttl=1m", "POST")
+    a = rpc.call(f"{master.url()}/dir/assign?collection={col}&ttl=1m")
+    rpc.call(f"http://{a['url']}/{a['fid']}", "POST",
+             b"short-lived payload " * 8)
+    vid = int(a["fid"].split(",")[0])
+    vs = next(s for s in servers if s.url() == a["url"])
+    expiry.set_clock(lambda: time.time() + 600.0)
+    try:
+        with root_span("drive.volume_expired", "test"):
+            vs._lifecycle_tick()
+    finally:
+        expiry.reset_clock()
+    assert vs.store.find_volume(vid) is None
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -699,6 +761,9 @@ DRIVERS = {
     "replication.ack": _drive_replication_ship,
     "replication.lag": _drive_replication_ship,
     "replication.cutover": _drive_replication_cutover,
+    "lifecycle.tier": _drive_lifecycle_tier,
+    "lifecycle.promote": _drive_lifecycle_promote,
+    "volume.expired": _drive_volume_expired,
 }
 
 
@@ -711,8 +776,9 @@ def test_driver_catalog_matches_registry():
     # journal's introduction + 6 data-integrity types + 5 overload/
     # lifecycle types + 1 codec type: ec.repair.local + 1 SLO type:
     # slo.burn + 4 cross-cluster mirror types: replication.ship/ack/
-    # lag/cutover).
-    assert len(TYPES) == 35
+    # lag/cutover + 3 data-lifecycle types: lifecycle.tier/promote +
+    # volume.expired).
+    assert len(TYPES) == 38
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))

@@ -430,6 +430,35 @@ def _drive_wan_duplicate(cl):
         reason="duplicate") == before + 1
 
 
+def _drive_tier_read(cl):
+    """A WAN-partitioned tier backend: the armed fetch makes the needle
+    read answer a bounded 503 (+ Retry-After) — never a hang, never a
+    degraded-read repair attempt — and the next read recovers."""
+    import os
+    _master, servers, _stub, client = cl
+    fid = client.upload_data(b"tiered needle " * 8)
+    vid = int(fid.split(",")[0])
+    url = client.lookup(vid)[0]["url"]
+    vs = next(s for s in servers
+              if s.url().replace("http://", "") == url)
+    dest = os.path.join(vs.store.locations[0].directory, "..",
+                        "tierfault")
+    rpc.call_json(f"http://{url}/admin/readonly", "POST",
+                  {"volume": vid, "readonly": True})
+    rpc.call_json(f"http://{url}/admin/tier_upload", "POST",
+                  {"volume": vid, "dest": f"local://{dest}"})
+    fault.arm("tier.read", "fail*1")
+    t0 = time.monotonic()
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.call(f"http://{url}/{fid}")
+    assert ei.value.status == 503
+    assert ei.value.retry_after  # the 503 carries a pacing hint
+    assert time.monotonic() - t0 < 10.0  # bounded, not a hang
+    assert client.download(fid) == b"tiered needle " * 8
+    rpc.call_json(f"http://{url}/admin/tier_download", "POST",
+                  {"volume": vid})
+
+
 DRIVERS = {
     "rpc.connect": _drive_rpc_connect,
     "rpc.send": _drive_rpc_send,
@@ -447,6 +476,7 @@ DRIVERS = {
     "wan.partition": _drive_wan_partition,
     "wan.delay": _drive_wan_delay,
     "wan.duplicate": _drive_wan_duplicate,
+    "tier.read": _drive_tier_read,
 }
 
 

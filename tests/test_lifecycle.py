@@ -1,0 +1,758 @@
+"""Data-lifecycle plane: the declarative policy engine, the read-through
+remote block cache (bounded bytes + singleflight), the master-side
+lifecycle daemon (idle-cold tiering), auto-promotion of hot tiered
+volumes, TTL expiry that actually deletes data (vacuum + whole-volume
+retirement + near-expiry layout steering), and the kill -9 crash
+windows around tier upload/download (a volume is always fully local or
+fully remote on remount)."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.core.needle import Needle
+from seaweedfs_tpu.core.ttl import TTL
+from seaweedfs_tpu.lifecycle import (LifecycleDaemon, Policy, PolicyError,
+                                     Rule, load_rules, parse_duration,
+                                     parse_rules_text)
+from seaweedfs_tpu.storage import expiry
+from seaweedfs_tpu.storage.backend import LocalDirBackend
+from seaweedfs_tpu.storage.remote_cache import CACHE, RemoteBlockCache
+from seaweedfs_tpu.storage.tier import (load_vif, move_dat_to_remote,
+                                        open_remote_volume)
+from seaweedfs_tpu.storage.vacuum import vacuum
+from seaweedfs_tpu.storage.volume import (NotFoundError, Volume,
+                                          VolumeError)
+
+pytestmark = pytest.mark.lifecycle
+
+
+@pytest.fixture(autouse=True)
+def _clean_lifecycle_state():
+    yield
+    expiry.reset_clock()
+    CACHE.reset()
+
+
+# -- policy engine -----------------------------------------------------------
+
+def test_parse_duration_units():
+    assert parse_duration("90s") == 90.0
+    assert parse_duration("10m") == 600.0
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration("30d") == 30 * 86400.0
+    assert parse_duration("1w") == 604800.0
+    assert parse_duration("45") == 45.0           # bare seconds
+    assert parse_duration("1.5m") == 90.0
+    for bad in ("", "10x", "m", "-5s", "1 0m"):
+        with pytest.raises(PolicyError):
+            parse_duration(bad)
+
+
+def test_line_grammar_and_first_match_wins():
+    p = parse_rules_text(textwrap.dedent("""\
+        # comments and blank lines are fine
+
+        logs    tier   dest=local:///cold  idle=10m
+        logs    tier   dest=local:///never  age=99d   # shadowed
+        pics    tier   dest=s3://h:1/b/frozen  age=30d  fullness=0.8
+        scratch expire
+        *       expire
+    """))
+    assert len(p) == 5
+    r = p.tier_rule_for("logs")
+    assert (r.dest, r.idle_for) == ("local:///cold", 600.0)
+    r = p.tier_rule_for("pics")
+    assert (r.min_age, r.fullness) == (30 * 86400.0, 0.8)
+    assert p.tier_rule_for("other") is None
+    # expire: the exact rule wins over the wildcard, both match.
+    assert p.expire_rule_for("scratch").collection == "scratch"
+    assert p.expire_rule_for("anything").collection == "*"
+
+
+def test_toml_rules_and_load_dispatch(tmp_path):
+    toml = tmp_path / "rules.toml"
+    toml.write_text(textwrap.dedent("""\
+        [[rule]]
+        collection = "logs"
+        action = "tier"
+        dest = "local:///cold"
+        idle = "10m"
+
+        [[rule]]
+        collection = "*"
+        action = "expire"
+    """))
+    p = load_rules(str(toml))
+    assert p.tier_rule_for("logs").idle_for == 600.0
+    assert p.expire_rule_for("x") is not None
+    txt = tmp_path / "rules.txt"
+    txt.write_text("logs tier dest=local:///cold idle=10m\n")
+    assert len(load_rules(str(txt))) == 1
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ("logs tier idle=10m", "dest"),                     # no destination
+    ("logs tier dest=local:///c", "at least one"),      # unconditional
+    ("logs tier dest=local:///c fullness=1.5", "fullness"),
+    ("logs expire idle=10m", "no conditions"),
+    ("logs tier dest=local:///c shade=1", "unknown rule keys"),
+    ("logs freeze", "unknown lifecycle action"),
+    ("logs", "want"),
+    ("logs tier dest", "bad token"),
+])
+def test_rule_validation_errors(bad, msg):
+    with pytest.raises(PolicyError, match=msg):
+        parse_rules_text(bad)
+
+
+# -- remote block cache ------------------------------------------------------
+
+def _backend_with_object(tmp_path, name: str, nbytes: int):
+    b = LocalDirBackend(str(tmp_path / name))
+    payload = os.urandom(nbytes)
+    src = tmp_path / f"{name}.src"
+    src.write_bytes(payload)
+    b.upload_file("obj", str(src))
+    return b, payload
+
+
+def test_cache_bounded_bytes_lru(tmp_path):
+    b, payload = _backend_with_object(tmp_path, "lru", 5 << 20)
+    c = RemoteBlockCache(max_bytes=2 << 20)  # room for 2 blocks
+    for idx in range(5):
+        blk, hit = c.get_block(b, "obj", idx, idx << 20,
+                               min(1 << 20, len(payload) - (idx << 20)))
+        assert not hit
+        assert blk == payload[idx << 20:(idx + 1) << 20]
+    assert c.used_bytes() <= 2 << 20
+    assert c.evictions == 3
+    # Newest block cached, oldest evicted.
+    _, hit = c.get_block(b, "obj", 4, 4 << 20, 1 << 20)
+    assert hit
+    _, hit = c.get_block(b, "obj", 0, 0, 1 << 20)
+    assert not hit
+
+
+def test_cache_singleflight_one_backend_fetch(tmp_path):
+    b, payload = _backend_with_object(tmp_path, "sf", 1 << 20)
+    c = RemoteBlockCache(max_bytes=8 << 20)
+    fetches = [0]
+    gate = threading.Event()
+    real = b.read_range
+
+    def slow_read(key, offset, size):
+        fetches[0] += 1
+        gate.wait(5.0)
+        return real(key, offset, size)
+
+    b.read_range = slow_read
+    results = []
+
+    def reader():
+        results.append(c.get_block(b, "obj", 0, 0, 1 << 20))
+
+    threads = [threading.Thread(target=reader) for _ in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)   # let every follower queue up behind the leader
+    gate.set()
+    for t in threads:
+        t.join(10.0)
+    assert fetches[0] == 1, "singleflight must collapse to ONE fetch"
+    assert len(results) == 6
+    assert all(blk == payload for blk, _hit in results)
+    assert sum(1 for _b, hit in results if not hit) == 1
+
+
+def test_cache_leader_failure_elects_new_leader(tmp_path):
+    b, payload = _backend_with_object(tmp_path, "fail", 1 << 20)
+    c = RemoteBlockCache(max_bytes=8 << 20)
+    real = b.read_range
+    calls = [0]
+
+    def flaky(key, offset, size):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise ConnectionResetError("wan died")
+        return real(key, offset, size)
+
+    b.read_range = flaky
+    with pytest.raises(ConnectionResetError):
+        c.get_block(b, "obj", 0, 0, 1 << 20)
+    # The failed leader must not poison the block: the next reader
+    # becomes the leader and succeeds.
+    blk, hit = c.get_block(b, "obj", 0, 0, 1 << 20)
+    assert blk == payload and not hit
+
+
+def test_cache_drop_file_and_hits_window(tmp_path):
+    b, _ = _backend_with_object(tmp_path, "drop", 1 << 20)
+    c = RemoteBlockCache(max_bytes=8 << 20)
+    c.get_block(b, "obj", 0, 0, 1 << 20)
+    c.record_read(b.spec, "obj", now=100.0)
+    c.record_read(b.spec, "obj", now=130.0)
+    c.record_read(b.spec, "obj", now=159.0)
+    assert c.hits_in_window(b.spec, "obj", 60.0, now=160.0) == 3
+    assert c.hits_in_window(b.spec, "obj", 25.0, now=160.0) == 1
+    assert c.hits_in_window(b.spec, "other", 60.0, now=160.0) == 0
+    c.drop_file(b.spec, "obj")
+    assert c.used_bytes() == 0
+    assert c.hits_in_window(b.spec, "obj", 60.0, now=160.0) == 0
+    _, hit = c.get_block(b, "obj", 0, 0, 1 << 20)
+    assert not hit  # invalidated
+
+
+# -- expiry decisions --------------------------------------------------------
+
+def _ttl_needle(nid: int, ttl: str | None, written_at: int) -> Needle:
+    n = Needle(id=nid, cookie=1, data=b"payload " * 8)
+    if ttl:
+        n.set_ttl(TTL.parse(ttl))
+    n.set_last_modified(written_at)
+    return n
+
+
+def test_needle_expiry_per_needle_and_superblock():
+    t0 = 1_000_000
+    n = _ttl_needle(1, "1m", t0)
+    assert not expiry.needle_expired(n, None, at=t0 + 59)
+    assert expiry.needle_expired(n, None, at=t0 + 61)
+    # Superblock TTL applies when the needle has none of its own.
+    bare = _ttl_needle(2, None, t0)
+    assert not expiry.needle_expired(bare, None, at=t0 + 10**9)
+    assert expiry.needle_expired(bare, TTL.parse("1m"), at=t0 + 61)
+    # Per-needle TTL wins over a longer superblock TTL.
+    assert expiry.needle_expired(n, TTL.parse("1h"), at=t0 + 61)
+
+
+def test_volume_expiry_and_near_expiry():
+    ttl = TTL.parse("10m")
+    t0 = 1_000_000.0
+    assert not expiry.volume_expired(ttl, t0, at=t0 + 599)
+    assert expiry.volume_expired(ttl, t0, at=t0 + 601)
+    assert not expiry.volume_expired(ttl, t0, grace=60, at=t0 + 650)
+    assert expiry.volume_expired(ttl, t0, grace=60, at=t0 + 661)
+    assert not expiry.volume_expired(ttl, 0, at=t0)  # never written
+    assert not expiry.volume_near_expiry(ttl, t0, at=t0 + 299)
+    assert expiry.volume_near_expiry(ttl, t0, at=t0 + 301)
+    assert not expiry.volume_near_expiry(TTL.parse(""), t0, at=t0 + 1e9)
+
+
+def test_read_expired_needle_is_404_and_vacuum_reclaims(tmp_path):
+    v = Volume(str(tmp_path), "", 11, ttl=TTL.parse("1m"),
+               use_worker=False)
+    now = int(time.time())
+    for i in range(8):
+        v.write_needle(_ttl_needle(i + 1, "1m", now))
+    keeper = Needle(id=99, cookie=1, data=b"no ttl flag " * 4)
+    keeper.set_last_modified(now)
+    v.write_needle(keeper)
+    assert v.read_needle(1).data == b"payload " * 8
+    before_dat = v.dat_size()
+    expiry.set_clock(lambda: now + 120.0)
+    # Expired needle: 404 with an expiry reason, not data.
+    with pytest.raises(NotFoundError, match="expired"):
+        v.read_needle(1)
+    # Vacuum treats expired needles as dead and reclaims the bytes.
+    vacuum(v)
+    assert v.vacuum_expired_count == 9  # superblock TTL covers id=99
+    assert v.dat_size() < before_dat
+    assert v.file_count() == 0
+    v.close()
+
+
+def test_vacuum_keeps_unexpired_ttl_needles(tmp_path):
+    v = Volume(str(tmp_path), "", 12, use_worker=False)
+    now = int(time.time())
+    v.write_needle(_ttl_needle(1, "1m", now))       # will expire
+    v.write_needle(_ttl_needle(2, "1h", now))       # still live
+    expiry.set_clock(lambda: now + 120.0)
+    vacuum(v)
+    assert v.vacuum_expired_count == 1
+    with pytest.raises(NotFoundError):
+        v.read_needle(1)
+    assert v.read_needle(2).data == b"payload " * 8
+    v.close()
+
+
+def test_layout_steers_writes_off_near_expiry_volumes():
+    from seaweedfs_tpu.core.replica_placement import ReplicaPlacement
+    from seaweedfs_tpu.storage.store import VolumeInfo
+    from seaweedfs_tpu.topology.node import DataNode
+    from seaweedfs_tpu.topology.volume_layout import VolumeLayout
+    layout = VolumeLayout(ReplicaPlacement.parse("000"),
+                          TTL.parse("10m"), 1 << 30)
+    dn = DataNode("n1", "127.0.0.1", 8080)
+    now = int(time.time())
+    fresh = VolumeInfo(id=1, collection="c", size=0, file_count=0,
+                       delete_count=0, deleted_byte_count=0,
+                       read_only=False, replica_placement=0,
+                       ttl=TTL.parse("10m").to_uint32(),
+                       compact_revision=0, modified_at=now)
+    layout.register_volume(fresh, dn)
+    assert 1 in layout.writables
+    # Past half the TTL since the newest write: no new assignments.
+    stale = VolumeInfo(id=1, collection="c", size=0, file_count=0,
+                       delete_count=0, deleted_byte_count=0,
+                       read_only=False, replica_placement=0,
+                       ttl=TTL.parse("10m").to_uint32(),
+                       compact_revision=0, modified_at=now - 400)
+    layout.register_volume(stale, dn)
+    assert 1 not in layout.writables
+
+
+# -- the lifecycle daemon + E2E acceptance -----------------------------------
+
+@pytest.fixture(scope="module")
+def lc_cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("lifecycle")
+    master = MasterServer(volume_size_limit_mb=16, meta_dir=str(tmp),
+                          pulse_seconds=60)
+    master.start()
+    d = tmp / "vs0"
+    d.mkdir()
+    vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60,
+                      tier_promote_hits=3, tier_promote_window=60.0)
+    vs.start()
+    client = WeedClient(master.url())
+    yield master, vs, client, tmp
+    vs.stop()
+    master.stop()
+
+
+_COL_N = [0]
+
+
+def _fresh_volume(cl, prefix: str, ttl: str = ""):
+    master, vs, _client, _tmp = cl
+    _COL_N[0] += 1
+    col = f"{prefix}{_COL_N[0]}"
+    q = f"&ttl={ttl}" if ttl else ""
+    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}{q}",
+             "POST")
+    a = rpc.call(f"{master.url()}/dir/assign?collection={col}{q}")
+    payload = f"{col} payload ".encode() * 64
+    rpc.call(f"http://{a['url']}/{a['fid']}", "POST", payload)
+    vs._send_heartbeat(full=True)  # the daemon reads heartbeat state
+    return int(a["fid"].split(",")[0]), col, a["fid"], payload
+
+
+def test_e2e_idle_tiering_cache_and_promotion(lc_cluster):
+    """The acceptance path: an idle-rule daemon tiers a cold volume
+    with zero read unavailability; a read burst makes hit-bytes beat
+    miss-bytes on the re-reads; sustained hits auto-promote the volume
+    back to local disk."""
+    master, vs, client, tmp = lc_cluster
+    vid, col, fid, payload = _fresh_volume(lc_cluster, "cold")
+    policy = Policy([Rule(collection=col, action="tier",
+                          dest=f"local://{tmp}/cold-tier",
+                          idle_for=0.05)])
+    daemon = LifecycleDaemon(master, policy, interval=3600, mbps=0)
+    # Scan 1 only observes: an idle decision needs a read baseline.
+    out = daemon.scan_once()
+    assert out["tiered"] == [] and out["errors"] == []
+    time.sleep(0.3)  # idle_for elapses with no reads and no writes
+    out = daemon.scan_once()
+    assert out["tiered"] == [vid], out
+    assert daemon.status()["actions"]["tier_ok"] >= 1
+    v = vs.store.find_volume(vid)
+    assert v.remote_file is not None and v.readonly
+    assert not os.path.exists(v.file_name() + ".dat")
+
+    # Zero read unavailability + cache accounting: pass 1 misses, the
+    # re-read passes are served from cache, so hit bytes pull ahead.
+    s0 = CACHE.stats()
+    for _ in range(3):
+        assert client.download(fid) == payload
+    s1 = CACHE.stats()
+    hit_d = s1["hit_bytes"] - s0["hit_bytes"]
+    miss_d = s1["miss_bytes"] - s0["miss_bytes"]
+    assert miss_d > 0 and hit_d > miss_d, (hit_d, miss_d)
+
+    # 3 reads inside the window >= tier_promote_hits: the holder's
+    # lifecycle tick schedules the download back to local.
+    assert CACHE.hits_in_window(v.remote_file.backend.spec,
+                                v.remote_file.key, 60.0) >= 3
+    vs._lifecycle_tick()
+    deadline = time.time() + 15
+    while vs.store.find_volume(vid).remote_file is not None:
+        assert time.time() < deadline, "promotion never completed"
+        time.sleep(0.05)
+    v = vs.store.find_volume(vid)
+    assert os.path.exists(v.file_name() + ".dat")
+    assert not os.path.exists(v.file_name() + ".vif")
+    assert client.download(fid) == payload  # local again, same bytes
+
+
+def test_e2e_ttl_expiry_vacuum_and_volume_retirement(lc_cluster):
+    """Short-TTL acceptance: expired needles 404 with an expiry reason,
+    the daemon's expire rule vacuums the bytes away, and once the whole
+    volume is past TTL + grace the holder retires it entirely."""
+    master, vs, client, _tmp = lc_cluster
+    vid, col, fid, payload = _fresh_volume(lc_cluster, "scratch",
+                                           ttl="1m")
+    assert client.download(fid) == payload  # live before expiry
+    v = vs.store.find_volume(vid)
+    assert v.super_block.ttl.minutes() == 1
+    before_dat = v.dat_size()
+
+    base_now = time.time()
+    expiry.set_clock(lambda: base_now + 90.0)  # past the 60s TTL
+    try:
+        with pytest.raises(rpc.RpcError) as ei:
+            client.download(fid)
+        assert ei.value.status == 404
+        # The expire rule drives vacuum; the bytes physically vanish.
+        daemon = LifecycleDaemon(
+            master, Policy([Rule(collection=col, action="expire")]),
+            interval=3600)
+        out = daemon.scan_once()
+        assert vid in out["vacuumed"], out
+        assert vs.store.find_volume(vid).dat_size() < before_dat
+        assert vs.store.find_volume(vid).file_count() == 0
+        # Fully past TTL + grace: the sweeper deletes the volume whole.
+        expiry.set_clock(lambda: base_now + 600.0)
+        vs._lifecycle_tick()
+        assert vs.store.find_volume(vid) is None
+    finally:
+        expiry.reset_clock()
+
+
+def test_daemon_requires_single_holder_and_skips_tiered():
+    """_consider must refuse to tier replicated volumes (the remote
+    object would be shared state under two holders) and never re-tier
+    an already-tiered one."""
+
+    class VInfo:
+        collection = "c"
+        tiered = False
+        ttl = 0
+        modified_at = 1.0
+        size = 100
+
+    class DN:
+        def url(self):
+            return "127.0.0.1:1"
+
+    class Topo:
+        volume_size_limit = 1000
+
+        def leaves(self):
+            return []
+
+    class M:
+        topo = Topo()
+
+        def is_leader(self):
+            return True
+
+    daemon = LifecycleDaemon(
+        M(), Policy([Rule(collection="*", action="tier",
+                          dest="local:///t", min_age=0.0001)]),
+        interval=3600)
+    tiered = []
+    daemon._tier_one = lambda dn, vid, vinfo, rule, out: tiered.append(
+        vid)
+    out = {"tiered": [], "vacuumed": [], "errors": []}
+    dn = DN()
+    # Two holders: refused.
+    daemon._consider(dn, 1, VInfo(), {1: [dn, dn]}, None, None, out)
+    assert tiered == []
+    # Single holder: tiered.
+    daemon._consider(dn, 1, VInfo(), {1: [dn]}, None, None, out)
+    assert tiered == [1]
+    # Already tiered: skipped.
+    vi = VInfo()
+    vi.tiered = True
+    daemon._consider(dn, 2, vi, {2: [dn]}, None, None, out)
+    assert tiered == [1]
+
+
+def test_daemon_unreachable_holder_degrades_scan_not_master():
+    """A dead holder costs the scan an error entry; the daemon keeps
+    going and the error is visible in status()."""
+
+    class DN:
+        def __init__(self):
+            self.volumes = {}
+
+        def url(self):
+            return "127.0.0.1:1"  # nothing listens here
+
+    class Topo:
+        volume_size_limit = 1000
+
+        def __init__(self, dn):
+            self._dn = dn
+
+        def leaves(self):
+            return [self._dn]
+
+    class M:
+        def __init__(self, dn):
+            self.topo = Topo(dn)
+
+        def is_leader(self):
+            return True
+
+    dn = DN()
+    daemon = LifecycleDaemon(
+        M(dn), Policy([Rule(collection="*", action="tier",
+                            dest="local:///t", min_age=0.0001)]),
+        interval=3600)
+    daemon._policy_retry.max_attempts = 1
+    class VInfo:
+        collection = ""
+        tiered = False
+        ttl = 0
+        modified_at = 1.0
+        size = 10
+    dn.volumes = {5: VInfo()}
+    out = daemon.scan_once()
+    assert out["tiered"] == []
+    assert out["errors"] and out["errors"][0]["volume"] == 5
+    assert daemon.status()["actions"]["tier_error"] == 1
+
+
+# -- kill -9 crash windows ---------------------------------------------------
+
+def _make_local_volume(dir_: str, vid: int, n: int = 30) -> bytes:
+    v = Volume(dir_, "", vid, use_worker=False)
+    for i in range(n):
+        v.write_needle(Needle(id=i + 1, cookie=7,
+                              data=f"needle-{i} ".encode() * 40))
+    v.sync()
+    v.close()
+    return b""
+
+
+def _run_child(script: str, tmp_path, *args) -> int:
+    path = tmp_path / "child.py"
+    path.write_text(textwrap.dedent(script))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, str(path), *map(str, args)],
+        capture_output=True, timeout=120, env=env)
+    return proc.returncode
+
+
+def test_kill9_during_tier_upload_leaves_volume_fully_local(tmp_path):
+    """SIGKILL mid-upload: the remote object is torn, but no .vif was
+    published — on remount the volume is fully local and readable, and
+    a re-run of the tier upload succeeds over the leftover object."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    remote_dir = tmp_path / "remote"
+    _make_local_volume(str(data_dir), 21)
+    rc = _run_child("""\
+        import os, signal, sys
+        from seaweedfs_tpu.storage.backend import LocalDirBackend
+        from seaweedfs_tpu.storage import tier
+        from seaweedfs_tpu.storage.volume import Volume
+
+        data_dir, remote_dir = sys.argv[1], sys.argv[2]
+
+        def half_then_die(self, key, path):
+            data = open(path, "rb").read()
+            with open(self._p(key), "wb") as f:
+                f.write(data[: len(data) // 2])
+                f.flush()
+                os.fsync(f.fileno())
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        LocalDirBackend.upload_file = half_then_die
+        v = Volume(data_dir, "", 21, create=False, use_worker=False)
+        v.set_readonly()
+        tier.move_dat_to_remote(v, "local://" + remote_dir)
+    """, tmp_path, data_dir, remote_dir)
+    assert rc == -signal.SIGKILL
+    # The torn half-object exists remotely, but nothing points at it.
+    assert os.path.exists(remote_dir / "21.dat")
+    assert not os.path.exists(data_dir / "21.vif")
+    assert os.path.exists(data_dir / "21.dat")
+    from seaweedfs_tpu.storage.store import Store
+    store = Store([str(data_dir)])
+    try:
+        v = store.find_volume(21)
+        assert v is not None and v.remote_file is None  # fully local
+        assert v.read_needle(3).data == b"needle-2 " * 40
+        # Re-tiering over the leftover partial object succeeds.
+        v.set_readonly()
+        move_dat_to_remote(v, f"local://{remote_dir}")
+        assert v.read_needle(3).data == b"needle-2 " * 40
+    finally:
+        store.close()
+
+
+def test_kill9_during_tier_download_leaves_volume_fully_remote(
+        tmp_path):
+    """SIGKILL mid-download: the temp download dies with the process —
+    on remount the .vif still rules, the volume is fully remote and
+    readable, and no torn .dat shadows the intact remote copy."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    remote_dir = tmp_path / "remote"
+    _make_local_volume(str(data_dir), 22)
+    v = Volume(str(data_dir), "", 22, create=False, use_worker=False)
+    v.set_readonly()
+    move_dat_to_remote(v, f"local://{remote_dir}")
+    v.close()
+    rc = _run_child("""\
+        import os, signal, sys
+        from seaweedfs_tpu.storage.backend import LocalDirBackend
+        from seaweedfs_tpu.storage import tier
+
+        data_dir = sys.argv[1]
+
+        def half_then_die(self, key, path):
+            data = open(self._p(key), "rb").read()
+            with open(path, "wb") as f:
+                f.write(data[: len(data) // 2])
+                f.flush()
+                os.fsync(f.fileno())
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        LocalDirBackend.download_file = half_then_die
+        v = tier.open_remote_volume(data_dir, "", 22)
+        tier.move_dat_from_remote(v)
+    """, tmp_path, data_dir)
+    assert rc == -signal.SIGKILL
+    assert not os.path.exists(data_dir / "22.dat")  # torn temp != .dat
+    assert os.path.exists(data_dir / "22.vif")
+    from seaweedfs_tpu.storage.store import Store
+    store = Store([str(data_dir)])
+    try:
+        v = store.find_volume(22)
+        assert v is not None and v.remote_file is not None
+        assert v.read_needle(5).data == b"needle-4 " * 40
+    finally:
+        store.close()
+
+
+def test_truncated_download_never_replaces_dat(tmp_path):
+    """A download that comes back short (fault, not crash) must raise
+    and leave the volume remote — never swap a torn .dat live."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    remote_dir = tmp_path / "remote"
+    _make_local_volume(str(data_dir), 23)
+    v = Volume(str(data_dir), "", 23, create=False, use_worker=False)
+    v.set_readonly()
+    move_dat_to_remote(v, f"local://{remote_dir}")
+
+    real = LocalDirBackend.download_file
+
+    def short(self, key, path):
+        real(self, key, path)
+        with open(path, "r+b") as f:
+            f.truncate(100)
+        return 100
+
+    LocalDirBackend.download_file = short
+    try:
+        from seaweedfs_tpu.storage.tier import move_dat_from_remote
+        with pytest.raises(VolumeError, match="got 100 bytes"):
+            move_dat_from_remote(v)
+    finally:
+        LocalDirBackend.download_file = real
+    assert not os.path.exists(data_dir / "23.dat")
+    assert not os.path.exists(data_dir / "23.dat.tmpdl")
+    assert v.remote_file is not None
+    assert v.read_needle(2).data == b"needle-1 " * 40
+    v.close()
+
+
+def test_scrub_skips_tiered_volumes(tmp_path):
+    """The backend owns a tiered volume's integrity: scrub must not
+    ranged-GET the whole .dat back over the WAN every sweep."""
+    from seaweedfs_tpu.storage.scrub import ScrubDaemon
+    from seaweedfs_tpu.storage.store import Store
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    _make_local_volume(str(data_dir), 24, n=5)
+    _make_local_volume(str(data_dir), 25, n=5)
+    store = Store([str(data_dir)])
+    try:
+        v = store.find_volume(24)
+        v.set_readonly()
+        move_dat_to_remote(v, f"local://{tmp_path}/remote")
+        reads = [0]
+        real = LocalDirBackend.read_range
+
+        def counting(self, key, offset, size):
+            reads[0] += 1
+            return real(self, key, offset, size)
+
+        LocalDirBackend.read_range = counting
+        try:
+            out = ScrubDaemon(store, ec_volumes={}).scrub_all()
+        finally:
+            LocalDirBackend.read_range = real
+        assert reads[0] == 0, "scrub fetched remote bytes"
+        scanned = [r["id"] for r in out["volumes"]]
+        assert 25 in scanned and 24 not in scanned
+    finally:
+        store.close()
+
+
+def test_open_remote_volume_mounts_without_dat(tmp_path):
+    """Startup with only .idx + .vif on disk (the .dat lives remotely):
+    the volume mounts remote-backed and serves reads; modified_at rides
+    the .vif so TTL decisions survive the round trip."""
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    _make_local_volume(str(data_dir), 26, n=6)
+    v = Volume(str(data_dir), "", 26, create=False, use_worker=False)
+    v.set_readonly()
+    before = int(v.modified_at)
+    move_dat_to_remote(v, f"local://{tmp_path}/remote")
+    v.close()
+    assert not os.path.exists(data_dir / "26.dat")
+    v2 = open_remote_volume(str(data_dir), "", 26)
+    try:
+        assert v2.readonly and v2.remote_file is not None
+        assert v2.read_needle(6).data == b"needle-5 " * 40
+        assert int(v2.modified_at) == before
+        assert load_vif(v2.file_name())["files"][0]["modified_at"] == \
+            before
+    finally:
+        v2.close()
+
+
+def test_shell_verbs_and_metrics_exposition(lc_cluster):
+    """`cluster.lifecycle` / `volume.tier.status` render live state,
+    `cluster.lifecycle run` drives a synchronous scan, and the tier
+    instruments ride the volume server's /metrics scrape."""
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    master, vs, _client, _tmp = lc_cluster
+    _fresh_volume(lc_cluster, "shellcol")
+    env = CommandEnv(master.url())
+    out = run_command(env, "cluster.lifecycle")
+    assert "enabled" in out and "rules" in out
+    out = run_command(env, "cluster.lifecycle run")
+    assert "scan complete" in out
+    out = run_command(env, "volume.tier.status")
+    assert "NODE" in out and "VOL" in out
+    assert vs.url() in out
+    assert "cache @" in out
+    body = rpc.call(f"http://{vs.url()}/metrics")
+    text = body.decode() if isinstance(body, bytes) else str(body)
+    for name in ("SeaweedFS_tier_cache_hit_bytes_total",
+                 "SeaweedFS_tier_cache_miss_bytes_total",
+                 "SeaweedFS_tier_moved_bytes_total",
+                 "SeaweedFS_ttl_expired_bytes_total",
+                 "SeaweedFS_lifecycle_actions_total"):
+        assert name in text, f"{name} missing from /metrics"
