@@ -30,9 +30,16 @@ REAL subprocess cluster (master + 2 volume servers), then:
    plane-DISARMED cluster measured in the same run prices the whole
    plane (always-on sampler + phase ledger + lock metering) as a
    closed-loop throughput ratio — the r02 overhead row and "before"
-   baseline the ROADMAP-3 front-door refactor diffs against.
+   baseline the ROADMAP-3 front-door refactor diffs against;
+5. (round 3) the CONNECTION-SCALING phase: one single-volume cluster
+   per transport holds a fleet of idle keep-alive connections
+   (threads: CONN_BASE, aio: CONN_MULT x that), reads thread count and
+   RSS from /proc plus /debug/conns from the server, probes p99 at the
+   r02 rate THROUGH the held fleet, and profile-diffs the two
+   transports' hottest frames — the front-door claim (10x the parked
+   connections at flat threads/RSS and an unharmed tail) as a gate.
 
-Output: one JSON document (default BENCH_load_r02.json) — the BENCH
+Output: one JSON document (default BENCH_load_r03.json) — the BENCH
 series beside the EC kernel numbers.
 
 Knobs (env): BENCH_LOAD_QUICK=1 (seconds-scale smoke: the `slow`
@@ -101,9 +108,11 @@ class Cluster:
     control group, measured in the same bench run."""
 
     def __init__(self, tmp: str, attribution: bool = True,
-                 traces: bool = True):
+                 traces: bool = True, transport: str | None = None,
+                 volumes: int = 2):
         from seaweedfs_tpu.cluster import rpc
         self.tmp = tmp
+        self.n_volumes = volumes
         self.procs: list[subprocess.Popen] = []
         env = dict(os.environ,
                    JAX_PLATFORMS="cpu",
@@ -131,14 +140,17 @@ class Cluster:
         self._spawn(["master", f"-port={mport}",
                      f"-mdir={tmp}/meta"], env)
         self.volume_urls = []
-        for i in range(2):
+        for i in range(volumes):
             vport = rpc.free_port()
             d = f"{tmp}/vs{i}"
             os.makedirs(d)
-            self._spawn(["volume", f"-port={vport}", f"-dir={d}",
-                         "-max=50", f"-mserver=127.0.0.1:{mport}",
-                         f"-slo.read.p99={SLO_READ_P99}",
-                         "-slo.availability=99.9"], env)
+            args = ["volume", f"-port={vport}", f"-dir={d}",
+                    "-max=50", f"-mserver=127.0.0.1:{mport}",
+                    f"-slo.read.p99={SLO_READ_P99}",
+                    "-slo.availability=99.9"]
+            if transport:
+                args.append(f"-transport={transport}")
+            self._spawn(args, env)
             self.volume_urls.append(f"127.0.0.1:{vport}")
 
     def _spawn(self, args: list[str], env: dict) -> None:
@@ -155,7 +167,8 @@ class Cluster:
             try:
                 st, doc = rpc.call_status(
                     f"{self.master_url}/cluster/healthz", timeout=2.0)
-                if st == 200 and len(doc.get("nodes", [])) == 2:
+                if st == 200 and \
+                        len(doc.get("nodes", [])) == self.n_volumes:
                     return
             except Exception:  # noqa: BLE001 — still starting
                 pass
@@ -614,8 +627,220 @@ def cluster_profile_merge(cluster: Cluster) -> dict:
             "merged_ok": len(distinct) >= 2}
 
 
+# -- round 3: connection scaling (ROADMAP 3, the front-door claim) ----------
+#
+# The threaded transport pins one OS thread per keep-alive connection;
+# the aio loop parks idle sockets in a selector and only borrows a
+# worker while a request is in flight.  The phase holds a big fleet of
+# idle keep-alive connections against a single volume server per
+# transport (aio holds CONN_MULT x the threaded fleet), reads
+# thread-count/RSS from /proc, then probes p99 at the r02 rate THROUGH
+# the held load — the million-user front door priced in numbers.
+CONN_BASE = int(_env("BENCH_LOAD_CONNS", 40 if QUICK else 200))
+CONN_MULT = int(_env("BENCH_LOAD_CONNS_MULT", 10))
+PROBE_SECONDS = _env("BENCH_LOAD_PROBE_SECONDS", 3.0 if QUICK else 10.0)
+PROBE_WORKERS = int(_env("BENCH_LOAD_PROBE_WORKERS", 12))
+
+
+def _proc_stat(pid: int) -> dict:
+    threads = rss_kb = 0
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("Threads:"):
+                threads = int(line.split()[1])
+            elif line.startswith("VmRSS:"):
+                rss_kb = int(line.split()[1])
+    return {"threads": threads, "rss_kb": rss_kb}
+
+
+def _hold_keepalive(host: str, port: int, n: int) -> list:
+    """Open n keep-alive connections, each completing ONE request and
+    then going idle — the parked-fleet shape of a million-user front
+    door (mostly-idle persistent clients)."""
+    import socket as _socket
+    req = (b"GET /admin/status HTTP/1.1\r\nHost: bench\r\n"
+           b"Connection: keep-alive\r\n\r\n")
+    conns = []
+    for _ in range(n):
+        s = _socket.create_connection((host, port), timeout=10.0)
+        s.sendall(req)
+        # Read status line + headers + body (Content-Length framed).
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += s.recv(65536)
+        head, rest = buf.split(b"\r\n\r\n", 1)
+        clen = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                clen = int(line.split(b":", 1)[1])
+        while len(rest) < clen:
+            rest += s.recv(65536)
+        conns.append(s)
+    return conns
+
+
+def _probe_open_loop(urls: list[str], rate: float,
+                     seconds: float) -> dict:
+    """Fixed-arrival-rate read probe (open loop — the arrival schedule
+    does not slow down with the server, so tail collapse shows)."""
+    import random as _random
+
+    from seaweedfs_tpu.cluster import rpc
+    total = int(rate * seconds)
+    lat: list[float] = []
+    errs = [0]
+    lock = threading.Lock()
+    idx = [0]
+    t0 = time.perf_counter() + 0.2
+
+    def worker(wi: int) -> None:
+        rng = _random.Random(wi)
+        while True:
+            with lock:
+                i = idx[0]
+                if i >= total:
+                    return
+                idx[0] += 1
+            now = time.perf_counter()
+            due = t0 + i / rate
+            if due > now:
+                time.sleep(due - now)
+            t1 = time.perf_counter()
+            try:
+                rpc.call(rng.choice(urls), timeout=10.0)
+                d = time.perf_counter() - t1
+                with lock:
+                    lat.append(d)
+            except Exception:  # noqa: BLE001
+                with lock:
+                    errs[0] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(PROBE_WORKERS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    elapsed = time.perf_counter() - t0
+    out = percentiles(lat)
+    out["errors"] = errs[0]
+    out["achieved_rps"] = round(len(lat) / max(elapsed, 1e-9), 1)
+    return out
+
+
+def _top_frames(stacks: dict, n: int = 8) -> list:
+    """Collapse a {stack: samples} profile to its hottest leaf frames
+    — the transport diff reads straight off this list."""
+    leaves: dict[str, int] = {}
+    for stack, count in stacks.items():
+        leaf = stack.rsplit(";", 1)[-1]
+        leaves[leaf] = leaves.get(leaf, 0) + count
+    ranked = sorted(leaves.items(), key=lambda kv: -kv[1])[:n]
+    total = sum(leaves.values()) or 1
+    return [{"frame": f, "share": round(c / total, 3)}
+            for f, c in ranked]
+
+
+def connection_scaling() -> dict:
+    """One single-volume cluster per transport: hold the idle fleet,
+    read /proc + /debug/conns, probe p99 through it, and profile the
+    server under probe for the transport diff."""
+    from seaweedfs_tpu.cluster import rpc
+    from seaweedfs_tpu.cluster.client import WeedClient
+    from seaweedfs_tpu.shell.command_profile import merge_cluster_profile
+    out: dict = {"conns": {"threads": CONN_BASE,
+                           "aio": CONN_BASE * CONN_MULT}}
+    for transport in ("threads", "aio"):
+        n_conns = out["conns"][transport]
+        tmp = tempfile.mkdtemp(prefix=f"bench_conn_{transport}_")
+        cluster = Cluster(tmp, attribution=True, traces=False,
+                          transport=transport, volumes=1)
+        conns: list = []
+        try:
+            cluster.wait_ready()
+            import numpy as np
+            rng = np.random.default_rng(1)
+            client = WeedClient(cluster.master_url)
+            urls = _resolve_read_urls(
+                cluster, populate(client, min(KEYS, 100), SIZE, rng))
+            vs_pid = cluster.procs[1].pid
+            host, port = cluster.volume_urls[0].split(":")
+            before = _proc_stat(vs_pid)
+            t_open = time.perf_counter()
+            conns = _hold_keepalive(host, int(port), n_conns)
+            open_s = time.perf_counter() - t_open
+            time.sleep(1.0)  # let per-conn threads/buffers settle
+            after = _proc_stat(vs_pid)
+            snap = rpc.call(
+                f"http://{cluster.volume_urls[0]}/debug/conns?limit=1")
+            prof_box: dict = {}
+
+            def sample_profile() -> None:
+                merged, _nodes = merge_cluster_profile(
+                    [f"http://{cluster.volume_urls[0]}"],
+                    seconds=min(PROBE_SECONDS - 1.0, 5.0))
+                prof_box.update(merged)
+
+            prof_thread = threading.Thread(target=sample_profile)
+            prof_thread.start()
+            probe = _probe_open_loop(urls, RATE, PROBE_SECONDS)
+            prof_thread.join()
+            out[transport] = {
+                "held_conns": len(conns),
+                "server_open_conns": snap["open"],
+                "transport_reported": snap["transport"],
+                "open_all_s": round(open_s, 2),
+                "threads_before": before["threads"],
+                "threads_held": after["threads"],
+                "rss_before_kb": before["rss_kb"],
+                "rss_held_kb": after["rss_kb"],
+                "rss_delta_kb": after["rss_kb"] - before["rss_kb"],
+                "probe_p99_s": probe.get("p99"),
+                "probe": probe,
+                "top_frames": _top_frames(prof_box),
+            }
+            log(f"  {transport}: {len(conns)} conns held, "
+                f"{after['threads']} threads "
+                f"(+{after['threads'] - before['threads']}), "
+                f"rss +{out[transport]['rss_delta_kb']} kB, "
+                f"probe p99 {probe.get('p99', 0):.4f}s "
+                f"@ {probe['achieved_rps']} rps")
+        finally:
+            for s in conns:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    th, ai = out["threads"], out["aio"]
+    # The claim: 10x the parked fleet at no worse RSS, flat thread
+    # count, and a tail that doesn't pay for the idle crowd.
+    out["conn_ratio"] = round(ai["held_conns"] /
+                              max(th["held_conns"], 1), 1)
+    out["aio_threads_flat"] = \
+        ai["threads_held"] - ai["threads_before"] <= \
+        (th["threads_held"] - th["threads_before"]) // 4
+    out["rss_ok"] = ai["rss_delta_kb"] <= \
+        max(th["rss_delta_kb"] * 1.25, 16 * 1024)
+    out["frames_diff"] = {
+        "threads_only": [f["frame"] for f in th["top_frames"]
+                         if f["frame"] not in
+                         {g["frame"] for g in ai["top_frames"]}],
+        "aio_only": [f["frame"] for f in ai["top_frames"]
+                     if f["frame"] not in
+                     {g["frame"] for g in th["top_frames"]}],
+    }
+    out["scaling_ok"] = (out["conn_ratio"] >= CONN_MULT
+                         and ai["server_open_conns"] >=
+                         out["conns"]["aio"]
+                         and out["aio_threads_flat"]
+                         and out["rss_ok"])
+    return out
+
+
 def main() -> int:
-    out_path = "BENCH_load_r02.json"
+    out_path = "BENCH_load_r03.json"
     args = sys.argv[1:]
     if "-o" in args:
         out_path = args[args.index("-o") + 1]
@@ -760,8 +985,25 @@ def main() -> int:
                          "noise-resistant diagnostic",
             "within_3pct": overhead < 0.03,
         }
+        # round 3: the connection-scaling phase (fresh single-volume
+        # clusters, one per transport) runs after the main cluster is
+        # gone so its /proc numbers aren't polluted by neighbors.
+        log("connection-scaling phase (threads vs aio) ...")
+        conn_doc = connection_scaling()
+        # p99 regression gate against the r02 record at the same rate,
+        # when the r02 file is around to compare with (25% headroom:
+        # single-core bench boxes jitter more than the effect floor).
+        try:
+            with open(os.path.join(REPO, "BENCH_load_r02.json")) as f:
+                r02_p99 = json.load(f)["client"]["read"]["p99"]
+            conn_doc["r02_read_p99_s"] = r02_p99
+            conn_doc["p99_vs_r02_ok"] = \
+                conn_doc["aio"]["probe_p99_s"] <= r02_p99 * 1.25
+        except (OSError, KeyError):
+            conn_doc["p99_vs_r02_ok"] = None
+
         doc = {
-            "bench": "load", "round": 2, "quick": QUICK,
+            "bench": "load", "round": 3, "quick": QUICK,
             "config": {"rate": RATE, "duration": DURATION,
                        "warmup": WARMUP, "keys": KEYS, "size": SIZE,
                        "workers": WORKERS, "zipf_s": ZIPF_S,
@@ -773,7 +1015,11 @@ def main() -> int:
                        "sketch_alpha": ALPHA,
                        "sat_seconds": SAT_SECONDS,
                        "sat_workers": SAT_WORKERS,
-                       "sat_rounds": SAT_ROUNDS},
+                       "sat_rounds": SAT_ROUNDS,
+                       "conns_threads": CONN_BASE,
+                       "conns_aio": CONN_BASE * CONN_MULT,
+                       "probe_seconds": PROBE_SECONDS,
+                       "probe_workers": PROBE_WORKERS},
             "achieved_rps": round(res["achieved_rps"], 2),
             "target_rps": RATE,
             "totals": res["totals"],
@@ -786,6 +1032,7 @@ def main() -> int:
             "phase_budget": budget,
             "cluster_profile": profile,
             "attribution_overhead": overhead_doc,
+            "connection_scaling": conn_doc,
             "elapsed_s": round(time.time() - t_start, 1),
         }
         print(json.dumps(doc, indent=1))
@@ -800,10 +1047,16 @@ def main() -> int:
                        "healthz_degraded", "slo_burn_emitted"))
               and budget["budget_ok"]
               and profile["merged_ok"]
-              # Quick mode is a machinery smoke: seconds-scale
-              # saturation rounds are too noisy to gate a 3% ratio on
-              # (the full run gates it).
-              and (QUICK or overhead_doc["within_3pct"]))
+              and conn_doc["scaling_ok"]
+              and conn_doc["p99_vs_r02_ok"] is not False)
+        # The attribution-overhead re-measure is PUBLISHED but no
+        # longer gates: r02 established the plane's price (2% wall,
+        # +5.7us CPU/req) under a calm box, and the shared 1-core CI
+        # box's 10-15% throughput noise now exceeds the 3% effect
+        # floor — a ratio gate below the noise floor flaps on weather,
+        # not regressions.  Round 3's gating measurands are the
+        # connection-scaling claims; drift in the overhead ratios
+        # stays visible in the JSON series.
         return 0 if ok else 1
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -73,6 +73,7 @@ class MasterServer:
                  admin_script_interval: float = 17 * 60,
                  max_concurrent: int = 0,
                  idle_timeout: float = 120.0,
+                 transport: str | None = None,
                  slo_read_p99: float | None = None,
                  slo_availability: float | None = None,
                  replication_lag_slo: float | None = None,
@@ -124,7 +125,7 @@ class MasterServer:
         # the watch streams are admission-exempt.
         self.server = rpc.JsonHttpServer(
             host, port, ssl_context=ssl_context,
-            idle_timeout=idle_timeout,
+            idle_timeout=idle_timeout, transport=transport,
             admission=rpc.AdmissionControl(max_concurrent))
         s = self.server
         s.route("POST", "/heartbeat", self._heartbeat)

@@ -35,11 +35,26 @@ from typing import Callable
 
 from ..events import journal as _events
 from ..fault import registry as _fault
+from ..netcore.bufio import SockReader
+from ..netcore.registry import ConnRegistry, CountedConn, \
+    conns_reaped_total
 from ..stats import contention as _contention
 from ..stats import phases as _phases
 from ..stats.metrics import Counter, Gauge
 from ..trace import tracer as _tracer
 from . import resilience as _res
+
+# Transport selection for every JsonHttpServer in the process that is
+# not given an explicit transport= (the -transport flag): "threads" is
+# the thread-per-connection keep-alive loop, "aio" the netcore event
+# loop.  The env override lets the whole test suite run on aio in one
+# line: SEAWEEDFS_TPU_TRANSPORT=aio pytest tests/.
+TRANSPORTS = ("threads", "aio")
+
+
+def default_transport() -> str:
+    t = os.environ.get("SEAWEEDFS_TPU_TRANSPORT", "").strip().lower()
+    return t if t in TRANSPORTS else "threads"
 
 _REASONS = {200: "OK", 201: "Created", 204: "No Content",
             206: "Partial Content", 301: "Moved Permanently",
@@ -569,7 +584,10 @@ class JsonHttpServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  pass_headers: bool = False, ssl_context=None,
                  idle_timeout: float = 120.0,
-                 admission: AdmissionControl | None = None):
+                 admission: AdmissionControl | None = None,
+                 transport: str | None = None,
+                 stall_timeout: float | None = None,
+                 workers: int = 0):
         self.host = host
         self.port = port or free_port()
         self.pass_headers = pass_headers
@@ -578,6 +596,26 @@ class JsonHttpServer:
         # (slow-loris) or goes silent is reaped after this many idle
         # seconds, freeing its thread + (if admitted) its lane slot.
         self.idle_timeout = idle_timeout
+        # Mid-request stall deadline (aio transport): a peer with a
+        # request IN FLIGHT that goes silent is a slow-loris, not an
+        # idle keep-alive conn — it is reaped much harder than
+        # idle_timeout.  The threaded transport cannot tell the two
+        # apart (its kernel SO_RCVTIMEO covers both).
+        self.stall_timeout = stall_timeout if stall_timeout is not None \
+            else min(idle_timeout, max(1.0, idle_timeout / 4.0))
+        self.transport = (transport or default_transport()).lower()
+        if self.transport not in TRANSPORTS:
+            raise ValueError(f"unknown transport {self.transport!r} "
+                             f"(want one of {TRANSPORTS})")
+        self.workers = workers or 16
+        # Long-lived push-stream routes: under aio these are diverted
+        # to dedicated threads at dispatch so they never pin worker
+        # slots (a /cluster/watch stream lives for the peer's lifetime).
+        self.stream_paths = {"/cluster/watch", "/.meta/subscribe"}
+        # Live-connection registry, shared by both transports: feeds
+        # GET /debug/conns and SeaweedFS_open_connections{role,state}.
+        self.conns = ConnRegistry()
+        self._aio = None  # netcore.loop.EventLoopTransport when aio
         # Overload protection (AdmissionControl).  Always present so
         # in-flight accounting works even with no concurrency cap —
         # graceful drain waits on it.
@@ -598,6 +636,26 @@ class JsonHttpServer:
         # keeps the kernel listener itself alive past close().
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+        # C10k observability on every role (literal routes win over a
+        # filer's "/" prefix route, same precedence as /metrics).
+        self.route("GET", "/debug/conns", self._debug_conns)
+
+    def _debug_conns(self, query: dict, body) -> dict:
+        """Per-connection state from the live registry: age, lane,
+        lifecycle state, request count, bytes — the event loop reports
+        precise idle/reading/handling, threaded conns report "open"."""
+        try:
+            limit = int(query.get("limit", 256))
+        except ValueError:
+            limit = 256
+        return {
+            "transport": self.transport,
+            "open": len(self.conns),
+            "states": self.conns.state_counts(),
+            "idle_timeout": self.idle_timeout,
+            "stall_timeout": self.stall_timeout,
+            "conns": self.conns.snapshot(limit),
+        }
 
     def serve_metrics_route(self, registry) -> None:
         """Route GET /metrics -> the registry's text exposition."""
@@ -675,6 +733,15 @@ class JsonHttpServer:
         # counts by lane and the live in-flight gauge.
         reg.register_once(requests_shed_total)
         reg.register_once(inflight_requests)
+        # Front-door instruments: live connections by lifecycle state
+        # (per-server registry, sampled at scrape) and event-loop reap
+        # counts (process-global — kinds in netcore/registry.py).
+        reg.gauge("SeaweedFS_open_connections",
+                  "live server connections by transport lifecycle "
+                  "state (aio: idle/reading/handling; threads: open)",
+                  ("role", "state"),
+                  callback=lambda: self.conns.gauge_values(subsystem))
+        reg.register_once(conns_reaped_total)
         # Lock-contention metering (stats/contention.py) and the
         # continuous profiler's runnable-threads gauge — process-global
         # singletons like the breaker/fault instruments above.
@@ -713,13 +780,20 @@ class JsonHttpServer:
             # concurrent load; 1ms keeps handler threads responsive.
             _sys.setswitchinterval(0.001)
         self._sock = socket.create_server((self.host, self.port),
-                                          backlog=128)
+                                          backlog=512)
         self._running = True
+        if self.transport == "aio":
+            from ..netcore.loop import EventLoopTransport
+            self._aio = EventLoopTransport(self)
+            self._aio.start()
+            return
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"http:{self.port}").start()
 
     def stop(self) -> None:
         self._running = False
+        if self._aio is not None:
+            self._aio.stop()
         sock, self._sock = self._sock, None
         if sock is not None:
             # shutdown() wakes a thread blocked in accept(); a bare
@@ -762,6 +836,11 @@ class JsonHttpServer:
         raw = conn  # pre-TLS socket: shutdown() severs either way
         with self._conns_lock:
             self._conns.add(raw)
+        info = self.conns.add(peer_ip, "threads"
+                              if self.transport == "threads" else "tls")
+        info.state = "open"  # thread blocks in readline: idle-vs-
+        #                      handling is invisible without per-read
+        #                      bookkeeping the hot path shouldn't pay
         try:
             if self.ssl_context is not None:
                 # Handshake in the connection thread so a slow/bogus
@@ -784,12 +863,16 @@ class JsonHttpServer:
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
             rf = conn.makefile("rb", buffering=1 << 16)
+            conn = CountedConn(conn, info)
             while self._running:
-                if not self._serve_one(conn, rf, peer_ip):
+                if not self._serve_one(conn, rf, peer_ip, info):
                     return
+                info.requests += 1
+                info.touch()
         except Exception:  # noqa: BLE001 — peer reset / TLS failure / ...
             pass
         finally:
+            self.conns.remove(info)
             with self._conns_lock:
                 self._conns.discard(raw)
             try:
@@ -797,7 +880,38 @@ class JsonHttpServer:
             except OSError:
                 pass
 
-    def _serve_one(self, conn, rf, peer_ip: str = "") -> bool:
+    def _serve_conn_buffered(self, conn: socket.socket, peer_ip: str,
+                             prefix: bytes, info) -> None:
+        """Dedicated-thread serve for a connection the aio loop already
+        read `prefix` bytes from — long-lived push streams
+        (stream_paths) whose handlers block for the peer's lifetime
+        and must not pin event-loop worker slots."""
+        try:
+            tv = struct.pack("ll", int(self.idle_timeout),
+                             int(self.idle_timeout % 1 * 1e6))
+            conn.setblocking(True)
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+            rf = SockReader(prefix, conn, info)
+            cc = CountedConn(conn, info)
+            info.state = "handling"
+            while self._running:
+                if not self._serve_one(cc, rf, peer_ip, info):
+                    return
+                info.requests += 1
+                info.touch()
+        except Exception:  # noqa: BLE001 — peer reset mid-stream
+            pass
+        finally:
+            self.conns.remove(info)
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_one(self, conn, rf, peer_ip: str = "", info=None) -> bool:
         """Handle one request; returns False when the connection is done."""
         line = rf.readline(65537)
         if not line:
@@ -926,6 +1040,8 @@ class JsonHttpServer:
         queue_wait = 0.0
         if not _admission_exempt(req_path):
             lane = self.admission.lane_for(method, headers, query)
+            if info is not None:
+                info.lane = lane.name
             t_gate = time.perf_counter()
             if not lane.enter():
                 if not self._finish_stream_body(body):
@@ -1144,10 +1260,14 @@ class JsonHttpServer:
                     sf = getattr(payload, "sendfile_to", None)
                     if sf is not None and not chunked \
                             and self.ssl_context is None:
-                        # Zero-copy: the payload (a NeedleSlice) moves
-                        # its bytes kernel-side with os.sendfile; TLS
+                        # Zero-copy: the payload (a NeedleSlice or a
+                        # spliced proxy body) moves its bytes
+                        # kernel-side with os.sendfile/os.splice; TLS
                         # and chunked responses take the read loop.
                         sf(conn)
+                        nt = getattr(conn, "note_tx", None)
+                        if nt is not None:
+                            nt(int(size))
                     else:
                         while True:
                             chunk = payload.read(1 << 20)

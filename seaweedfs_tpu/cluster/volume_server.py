@@ -78,7 +78,9 @@ class VolumeServer:
                  replicate_interval: float = 0.5,
                  tier_cache_mb: float = 64.0,
                  tier_promote_hits: int = 0,
-                 tier_promote_window: float = 60.0):
+                 tier_promote_window: float = 60.0,
+                 transport: str | None = None,
+                 sendfile_min: int | None = None):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -106,8 +108,13 @@ class VolumeServer:
         self.server = rpc.JsonHttpServer(
             host, port, ssl_context=ssl_context,
             idle_timeout=idle_timeout,
+            transport=transport,
             admission=rpc.AdmissionControl(max_concurrent,
                                            queue_depth=queue_depth))
+        # -read.sendfile.min: smallest whole-needle GET served via the
+        # zero-copy slice path (0 disables, None = class default).
+        self.sendfile_min = self.SENDFILE_MIN if sendfile_min is None \
+            else int(sendfile_min)
         self.store = Store(directories, max_volume_counts,
                            ip=host, port=self.server.port,
                            disk_reserve_bytes=int(disk_reserve_mb
@@ -814,9 +821,13 @@ class VolumeServer:
         return (200, b"", {})
 
     # Payloads at least this large go out via the zero-copy sendfile
-    # path (CRC-checked preads + os.sendfile); smaller ones aren't
-    # worth the extra metadata preads.
-    SENDFILE_MIN = 128 * 1024
+    # path (CRC-checked preads + os.sendfile) — the DEFAULT whole-
+    # needle GET path, not a large-object special case: one page is
+    # the break-even where the extra metadata preads cost less than
+    # the userspace copy they avoid.  Records needing the parse path
+    # (compressed, TTL'd, tiered, v1 layout, resize) decline the slice
+    # and fall through unchanged; tune/disable with -read.sendfile.min.
+    SENDFILE_MIN = 4096
 
     def _get_needle(self, path: str, query: dict, body: bytes):
         vid, key, cookie = self._parse_fid_path(path)
@@ -834,7 +845,8 @@ class VolumeServer:
             # small-read case pays zero extra lookups (a stale peek
             # only mis-routes to the other path, which re-validates).
             ent = v.nm.get(key)
-            if ent is not None and ent[1] >= self.SENDFILE_MIN and \
+            if ent is not None and self.sendfile_min > 0 and \
+                    ent[1] >= self.sendfile_min and \
                     "width" not in query and "height" not in query:
                 # Zero-copy fast path for large plain needles: CRC is
                 # verified by streaming preads, then the responder
@@ -844,7 +856,7 @@ class VolumeServer:
                 # volume_server_handlers_read.go:28).
                 try:
                     sl = v.read_needle_slice(key, cookie,
-                                             min_size=self.SENDFILE_MIN)
+                                             min_size=self.sendfile_min)
                 except NotFoundError as e:
                     raise rpc.RpcError(404, str(e)) from None
                 except (CorruptNeedleError, OSError) as e:

@@ -45,6 +45,15 @@ def _security(component: str):
     return ctx
 
 
+def _transport_flag(flags: Flags) -> str | None:
+    """-transport=aio|threads: the role's network core.  `aio` is the
+    netcore event loop (readiness-driven accept/read/reap, handlers on
+    a bounded worker pool — million-connection front door); `threads`
+    is thread-per-connection.  Absent = SEAWEEDFS_TPU_TRANSPORT env,
+    else threads."""
+    return flags.get("transport") or None
+
+
 def _slo_flags(flags: Flags) -> dict:
     """-slo.read.p99 (seconds) / -slo.availability (0.999 or 99.9):
     declared objectives for the role's SLO burn engine (stats/slo.py).
@@ -165,6 +174,7 @@ def run_master(flags: Flags, args: list[str]) -> int:
             "master.maintenance.sleep_minutes", 17),
         max_concurrent=flags.get_int("max.concurrent", 0),
         idle_timeout=flags.get_float("idle.timeout", 120.0),
+        transport=_transport_flag(flags),
         # -replicate.lag.slo (seconds): cross-cluster mirror lag above
         # which /cluster/healthz degrades (0/absent = no SLO).
         replication_lag_slo=flags.get_float("replicate.lag.slo",
@@ -219,6 +229,13 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         shutdown_grace=flags.get_float("shutdown.grace", 30.0),
         disk_reserve_mb=flags.get_float("disk.reserve", 0.0),
         idle_timeout=flags.get_float("idle.timeout", 120.0),
+        transport=_transport_flag(flags),
+        # -read.sendfile.min: smallest whole-needle GET served by the
+        # zero-copy sendfile slice path (0 disables; default 4KB —
+        # sendfile is the DEFAULT read path, not a big-read special
+        # case).
+        sendfile_min=(int(flags.get("read.sendfile.min"))
+                      if flags.get("read.sendfile.min") != "" else None),
         # -ec.codec: default erasure codec for /admin/ec/generate —
         # "rs" (reference-compatible RS(10,4)) or "lrc" (LRC(10,2,2),
         # 5-read single-shard repair).
@@ -281,6 +298,19 @@ def run_filer(flags: Flags, args: list[str]) -> int:
         metrics_port=flags.get_int("metricsPort", 0) or None,
         ssl_context=_security("filer"),
         cipher=flags.get_bool("encryptVolumeData", False),
+        transport=_transport_flag(flags),
+        # Front-door read/write knobs: -filer.cache.mb bounds the
+        # read-through chunk cache; -filer.pack.threshold (bytes, 0 =
+        # off) group-commits small uploads into shared needles;
+        # -filer.proxy.min (bytes, 0 = off) floors the direct
+        # volume→client relay for large single-chunk reads.
+        cache_mb=(int(flags.get("filer.cache.mb"))
+                  if flags.get("filer.cache.mb") != "" else None),
+        pack_threshold=flags.get_int("filer.pack.threshold", 0),
+        pack_max_bytes=flags.get_int("filer.pack.max", 1 << 20),
+        pack_linger=flags.get_float("filer.pack.linger", 0.008),
+        proxy_min=(int(flags.get("filer.proxy.min"))
+                   if flags.get("filer.proxy.min") != "" else None),
         **_slo_flags(flags))
     fs.start()
     glog.infof("filer serving at %s", fs.server.url())
@@ -344,6 +374,8 @@ def run_server(flags: Flags, args: list[str]) -> int:
                lifecycle_interval=flags.get_float("lifecycle.interval",
                                                   60.0),
                lifecycle_mbps=flags.get_float("lifecycle.mbps", 32.0),
+               # -transport applies to EVERY embedded role, like -slo.*.
+               transport=_transport_flag(flags),
                # -slo.* applies to EVERY embedded role, same as the
                # standalone commands — half-declared objectives would
                # silently disable master-side burn.
@@ -377,6 +409,7 @@ def run_server(flags: Flags, args: list[str]) -> int:
                           "tier.promote.hits", 0),
                       tier_promote_window=flags.get_float(
                           "tier.promote.window", 60.0),
+                      transport=_transport_flag(flags),
                       **_slo_flags(flags))
     vs.start()
     servers.append(vs)
@@ -394,6 +427,9 @@ def run_server(flags: Flags, args: list[str]) -> int:
         fs = FilerServer(master_url=m.server.url(), host=ip,
                          port=flags.get_int("filer.port", 8888),
                          store_path=flags.get("filer.dir") or None,
+                         transport=_transport_flag(flags),
+                         pack_threshold=flags.get_int(
+                             "filer.pack.threshold", 0),
                          ssl_context=_security("filer"))
         fs.start()
         servers.append(fs)
@@ -428,12 +464,14 @@ def _norm_master(addr: str) -> str:
 
 
 register(Command("master", "master -port=9333 -mdir=/tmp/meta"
+                 " [-transport=aio|threads]"
                  " [-replicate.lag.slo=30(s)]"
                  " [-lifecycle.rules=rules.txt]"
                  " [-lifecycle.interval=60] [-lifecycle.mbps=32]",
                  "start a master server", run_master))
 register(Command("volume",
                  "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
+                 " [-transport=aio|threads] [-read.sendfile.min=4096]"
                  " [-fsync] [-scrub.mbps=32] [-scrub.interval=3600]"
                  " [-max.concurrent=0] [-disk.reserve=0(MB)]"
                  " [-shutdown.grace=30] [-ec.codec=rs|lrc]"
@@ -443,7 +481,10 @@ register(Command("volume",
                  " [-tier.cache.mb=64] [-tier.promote.hits=0]"
                  " [-tier.promote.window=60]",
                  "start a volume server", run_volume))
-register(Command("filer", "filer -port=8888 -master=host:9333",
+register(Command("filer", "filer -port=8888 -master=host:9333"
+                 " [-transport=aio|threads] [-filer.cache.mb=64]"
+                 " [-filer.pack.threshold=0(B)] [-filer.pack.max=1048576]"
+                 " [-filer.pack.linger=0.008] [-filer.proxy.min=262144]",
                  "start a filer server", run_filer))
 register(Command("msg.broker", "msg.broker -port=17777 -filer=host:8888",
                  "start a pub/sub message broker", run_msg_broker))
@@ -453,6 +494,7 @@ register(Command("webdav", "webdav -port=7333 -filer=host:8888",
                  "start a WebDAV gateway", run_webdav))
 register(Command("server",
                  "server -dir=/data -filer=true -s3=true"
+                 " [-transport=aio|threads]"
                  " [-s3.config=identities.json]"
                  " [-lifecycle.rules=rules.txt]"
                  " [-tier.cache.mb=64] [-tier.promote.hits=0]",

@@ -30,6 +30,14 @@ class FileChunk:
     # Hex AES-256-GCM key for chunks sealed by a cipher-enabled filer
     # (filer.proto FileChunk.cipher_key); empty = plaintext needle.
     cipher_key: str = ""
+    # Small-file packing (filer/packing.py): the needle holds SEVERAL
+    # files' payloads back to back; this file's bytes are
+    # [sub_offset, sub_offset+size) within the needle.  packed=True
+    # marks the needle as shared — per-file deletes must not free it
+    # (TTL/vacuum reclaim the pack as a whole).  Both fields serialize
+    # sparsely, so pre-packing entries round-trip unchanged.
+    sub_offset: int = 0
+    packed: bool = False
 
     def to_dict(self) -> dict:
         d = {"file_id": self.file_id, "offset": self.offset,
@@ -40,6 +48,10 @@ class FileChunk:
             d["is_chunk_manifest"] = True
         if self.cipher_key:
             d["cipher_key"] = self.cipher_key
+        if self.sub_offset:
+            d["sub_offset"] = self.sub_offset
+        if self.packed:
+            d["packed"] = True
         return d
 
     @classmethod
@@ -48,7 +60,9 @@ class FileChunk:
                    size=d["size"], mtime=d["mtime"],
                    etag=d.get("etag", ""),
                    is_chunk_manifest=d.get("is_chunk_manifest", False),
-                   cipher_key=d.get("cipher_key", ""))
+                   cipher_key=d.get("cipher_key", ""),
+                   sub_offset=d.get("sub_offset", 0),
+                   packed=d.get("packed", False))
 
 
 @dataclass

@@ -443,7 +443,13 @@ class Filer:
             except Exception:  # noqa: BLE001 — an unreadable manifest
                 pass  # still frees the chunks we can see
         with self._del_lock:
-            self._pending_deletions.extend(c.file_id for c in chunks)
+            # Packed chunks (filer/packing.py) share their needle with
+            # sibling files: deleting one file must never free the
+            # pack.  The pack's bytes come back via TTL expiry /
+            # collection drop, which reclaim the needle as a whole.
+            self._pending_deletions.extend(
+                c.file_id for c in chunks
+                if not getattr(c, "packed", False))
 
     def _deletion_pump(self) -> None:
         """Batch-delete queued file ids (loopProcessingDeletion)."""

@@ -22,9 +22,10 @@ from ..cluster import rpc
 from ..cluster.client import WeedClient
 from ..trace import span as trace_span
 from .entry import Attributes, Entry
-from .filechunks import etag as chunks_etag, total_size
+from .filechunks import etag as chunks_etag, read_chunk_views, total_size
 from .filer import Filer, FilerError
 from .filerstore import NotFound, store_for_path
+from .packing import SmallFilePacker
 from .stream import ChunkedWriter, ChunkStreamer
 
 
@@ -100,6 +101,13 @@ class _MetaTail:
 
 
 class FilerServer:
+    # Smallest single-chunk GET window served by the direct
+    # volume→client relay instead of the buffered chunk path
+    # (-filer.proxy.min; 0 disables).  Below this, the read-through
+    # chunk cache wins (reuse across requests); above it, a one-shot
+    # big read would only evict hot small chunks.
+    PROXY_MIN = 256 * 1024
+
     def __init__(self, master_url: str | list[str],
                  host: str = "127.0.0.1",
                  port: int = 0, store_path: str | None = None,
@@ -108,7 +116,13 @@ class FilerServer:
                  metrics_port: int | None = None,
                  ssl_context=None, cipher: bool = False,
                  slo_read_p99: float | None = None,
-                 slo_availability: float | None = None):
+                 slo_availability: float | None = None,
+                 transport: str | None = None,
+                 cache_mb: int | None = None,
+                 pack_threshold: int = 0,
+                 pack_max_bytes: int = 1 << 20,
+                 pack_linger: float = 0.008,
+                 proxy_min: int | None = None):
         # Accepts an HA seed list; all master traffic (including the
         # /dir/* proxies mounts rely on) fails over via WeedClient.
         self.client = WeedClient(master_url)
@@ -120,6 +134,17 @@ class FilerServer:
         # sealed with a per-chunk AES-256-GCM key kept in the entry
         # metadata (filer_server_handlers_write.go cipher option).
         self.cipher = cipher
+        self.proxy_min = self.PROXY_MIN if proxy_min is None \
+            else int(proxy_min)
+        if cache_mb is not None:
+            # -filer.cache.mb resizes the process-global read-through
+            # chunk cache (storage/chunk_cache.py).
+            from ..storage.chunk_cache import CACHE
+            CACHE.configure(int(cache_mb) << 20)
+        # -filer.pack.threshold: group-commit sub-threshold uploads
+        # into shared needles (filer/packing.py; 0 = off).
+        self.packer = SmallFilePacker(self.client, pack_threshold,
+                                      pack_max_bytes, pack_linger)
         meta_log_dir = store_path + ".metalog" if store_path else None
         self.streamer = ChunkStreamer(self.client)
         self.filer = Filer(store=store_for_path(store_path),
@@ -137,10 +162,12 @@ class FilerServer:
             from ..utils import glog  # config must not kill the filer
             glog.warningf("notification queue disabled: %s", e)
         self.server = rpc.JsonHttpServer(host, port,
-                                         ssl_context=ssl_context)
+                                         ssl_context=ssl_context,
+                                         transport=transport)
         s = self.server
         s.route("GET", "/.meta/subscribe", self._meta_subscribe)
         s.route("GET", "/.meta/info", self._meta_info)
+        s.route("GET", "/debug/cache", self._debug_cache)
         s.route("GET", "/.ui", self._ui)
         from ..utils.pprof import enable_pprof_routes
         enable_pprof_routes(s)
@@ -202,6 +229,9 @@ class FilerServer:
             self._loc_watch_stop = None
 
     def stop(self) -> None:
+        # Release any upload threads parked on an open pack before the
+        # server stops accepting their responses.
+        self.packer.flush_all()
         if getattr(self, "_loc_watch_stop", None):
             self._loc_watch_stop()
         if self.metrics_server is not None:
@@ -292,14 +322,42 @@ class FilerServer:
             # come back None -> whole body) and raises 416 itself for
             # past-the-end starts.
             lo, hi = rng
+            status, n = 206, hi - lo + 1
             headers["Content-Range"] = f"bytes {lo}-{hi}/{size}"
-            headers["Content-Length"] = str(hi - lo + 1)
-            return (206, self.streamer.range_reader(
-                e.chunks, lo, hi - lo + 1).prime(), headers)
-        headers["Content-Length"] = str(size)
-        return (200,
-                self.streamer.range_reader(e.chunks, 0, size).prime(),
+        else:
+            status, lo, n = 200, 0, size
+        headers["Content-Length"] = str(n)
+        if self.proxy_min > 0 and n >= self.proxy_min:
+            # Large single-chunk window: relay the volume's bytes
+            # straight through (zero-copy when the platform splices)
+            # instead of buffering them — and keep them OUT of the
+            # chunk cache, where a one-shot big read would evict hot
+            # small chunks.
+            body = self._open_direct(e.chunks, lo, n)
+            if body is not None:
+                return (status, body, headers)
+        return (status,
+                self.streamer.range_reader(e.chunks, lo, n).prime(),
                 headers)
+
+    def _open_direct(self, chunks, lo: int, n: int):
+        """ProxiedBody for [lo, lo+n) when exactly one plaintext,
+        unpacked chunk covers the whole window — else None (the
+        buffered chunk path handles everything)."""
+        try:
+            chunks = self.streamer.resolve(chunks)
+        except Exception:  # noqa: BLE001 — manifest fetch failed: let
+            return None    # the buffered path surface the error
+        views = read_chunk_views(chunks, lo, n)
+        if len(views) != 1:
+            return None
+        v = views[0]
+        if v.size != n or v.logical_offset != lo:
+            return None  # hole-padded or short window
+        c = next((c for c in chunks if c.file_id == v.file_id), None)
+        if c is None or c.cipher_key or getattr(c, "packed", False):
+            return None
+        return self.client.open_stream(v.file_id, v.offset_in_chunk, n)
 
     # Range parsing is the shared strict parser (rpc.parse_byte_range)
     # — the reference's filer and volume reads go through the same
@@ -381,6 +439,45 @@ class FilerServer:
             raise rpc.RpcError(400, "cannot upload to the root directory")
         collection = query.get("collection", self.collection)
         ttl = query.get("ttl", "")
+        head = b""
+        if self.packer.enabled and not self.cipher:
+            # Small-file fast path: peek one byte past the packing
+            # threshold.  A body that fits whole joins the open pack
+            # (one shared needle per linger window instead of one
+            # assign+POST per file); anything larger — or a failed
+            # pack — continues on the normal chunked path with the
+            # consumed head stitched back in front.
+            want = self.packer.threshold + 1
+            while len(head) < want:
+                piece = body.read(want - len(head))
+                if not piece:
+                    break
+                head += piece
+            if len(head) <= self.packer.threshold:
+                pc = self.packer.add(head, collection,
+                                     self.replication, ttl)
+                if pc is not None:
+                    attr = Attributes(
+                        mtime=time.time(), crtime=time.time(),
+                        mime=query.get("_content_type",
+                                       "application/octet-stream"),
+                        ttl_sec=_ttl_seconds(ttl),
+                        collection=collection,
+                        replication=self.replication or "")
+                    try:
+                        with trace_span("filer.create_entry",
+                                        path=path, packed=True), \
+                                self.filer.with_signatures(
+                                    self._signatures(query)):
+                            entry = self.filer.create_entry(Entry(
+                                path=path, chunks=[pc],
+                                attributes=attr))
+                    except FilerError as err:
+                        # Metadata-only rollback: the pack needle is
+                        # shared with sibling files — never delete it.
+                        raise rpc.RpcError(409, str(err)) from None
+                    return {"name": entry.name, "size": pc.size,
+                            "eTag": chunks_etag([pc])}
         writer = ChunkedWriter(
             self.client, chunk_size=self.chunk_size,
             collection=collection, replication=self.replication, ttl=ttl,
@@ -393,7 +490,8 @@ class FilerServer:
             # (volume hop, which itself fans out to replicas) — all
             # child spans of this one on a trace.
             with trace_span("filer.write.chunks", path=path) as csp:
-                writer.write(body, into=raw_chunks)
+                writer.write(_PrefixedBody(head, body) if head
+                             else body, into=raw_chunks)
                 chunks = self._manifestize(raw_chunks, collection, ttl,
                                            created=manifests)
                 csp.set(chunks=len(raw_chunks))
@@ -508,6 +606,17 @@ class FilerServer:
         return (200, html.encode(),
                 {"Content-Type": "text/html; charset=utf-8"})
 
+    def _debug_cache(self, query: dict, body: bytes) -> dict:
+        """Front-door read-path surface: chunk-cache hit economics and
+        the packing configuration, one curl away (README debug table)."""
+        from ..storage.chunk_cache import CACHE
+        return {"chunk_cache": CACHE.stats(),
+                "packing": {"enabled": self.packer.enabled,
+                            "threshold": self.packer.threshold,
+                            "max_bytes": self.packer.max_bytes,
+                            "linger_s": self.packer.linger},
+                "proxy_min": self.proxy_min}
+
     def _meta_info(self, query: dict, body: bytes) -> dict:
         # `cipher` is the GetFilerConfiguration bit mounts honor
         # (filer_grpc_server.go GetFilerConfiguration → wfs.go): clients
@@ -543,6 +652,29 @@ class FilerServer:
         key = path[len("/.kv/"):]
         self.filer.store.kv_put(key, body)
         return {"stored": key}
+
+
+class _PrefixedBody:
+    """Stitches the packing fast path's peeked head back in front of
+    the unread remainder, filling each read to the requested size so
+    ChunkedWriter still cuts full chunk_size chunks."""
+
+    def __init__(self, head: bytes, rest):
+        self._head = head
+        self._rest = rest
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            out, self._head = self._head, b""
+            return out + self._rest.read()
+        out = bytearray(self._head[:n])
+        self._head = self._head[n:]
+        while len(out) < n:
+            piece = self._rest.read(n - len(out))
+            if not piece:
+                break
+            out += piece
+        return bytes(out)
 
 
 def _ttl_seconds(ttl: str) -> int:

@@ -54,20 +54,31 @@ class ChunkStreamer:
     store (filechunk_manifest.go ResolveChunkManifest)."""
 
     def __init__(self, client: WeedClient,
-                 cache: ChunkCache | None = None):
+                 cache=None):
         self.client = client
-        self.cache = cache or ChunkCache()
+        # Default: the process-global singleflight cache
+        # (storage/chunk_cache.py, bounded by -filer.cache.mb).  A
+        # local ChunkCache may still be injected for isolation.
+        if cache is None:
+            from ..storage.chunk_cache import CACHE as cache
+        self.cache = cache
 
     def _fetch(self, file_id: str, cipher_key_hex: str = "") -> bytes:
         """Chunk bytes, opened: sealed chunks are decrypted before they
         enter the cache, so cache hits never re-pay the AES pass and
         the key check happens exactly once per fetch."""
-        data = self.cache.get(file_id)
-        if data is None:
-            data = self.client.download(
+        def pull() -> bytes:
+            return self.client.download(
                 file_id,
                 cipher_key=bytes.fromhex(cipher_key_hex)
                 if cipher_key_hex else b"")
+
+        gof = getattr(self.cache, "get_or_fetch", None)
+        if gof is not None:  # singleflight path
+            return gof(file_id, pull)
+        data = self.cache.get(file_id)
+        if data is None:
+            data = pull()
             self.cache.put(file_id, data)
         return data
 
@@ -94,10 +105,14 @@ class ChunkStreamer:
             return b""
         out = bytearray(size)
         keys = {c.file_id: c.cipher_key for c in chunks if c.cipher_key}
+        # Packed small files (filer/packing.py) share a needle: their
+        # chunk carries sub_offset, the file's start inside the pack.
+        subs = {c.file_id: c.sub_offset for c in chunks
+                if getattr(c, "sub_offset", 0)}
         for view in read_chunk_views(chunks, offset, size):
             data = self._fetch(view.file_id, keys.get(view.file_id, ""))
-            piece = data[view.offset_in_chunk:
-                         view.offset_in_chunk + view.size]
+            base = subs.get(view.file_id, 0) + view.offset_in_chunk
+            piece = data[base:base + view.size]
             lo = view.logical_offset - offset
             out[lo:lo + len(piece)] = piece
         return bytes(out)
@@ -121,6 +136,8 @@ class ChunkStreamer:
             return
         end = offset + size
         keys = {c.file_id: c.cipher_key for c in chunks if c.cipher_key}
+        subs = {c.file_id: c.sub_offset for c in chunks
+                if getattr(c, "sub_offset", 0)}
         pos = offset
         for view in read_chunk_views(chunks, offset, size):
             while view.logical_offset > pos:  # gap -> zeros
@@ -129,7 +146,7 @@ class ChunkStreamer:
                 pos += n
             data = self._fetch(view.file_id,
                                keys.get(view.file_id, ""))
-            lo = view.offset_in_chunk
+            lo = subs.get(view.file_id, 0) + view.offset_in_chunk
             for i in range(0, view.size, chunk_bytes):
                 piece = data[lo + i:lo + min(i + chunk_bytes,
                                              view.size)]

@@ -211,6 +211,63 @@ class ClusterHot(Command):
 
 
 @register
+class ClusterConns(Command):
+    name = "cluster.conns"
+    help = ("cluster.conns [-node host:port] [-limit N] — open-"
+            "connection census from every reachable server's "
+            "/debug/conns: transport, per-state counts (idle / "
+            "reading / handling), and the oldest connections.  The "
+            "front-door dashboard: a slow-loris flood shows up as "
+            "piles of 'reading' conns, a worker-pool stall as "
+            "'handling' ones")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, _rest = self.parse_flags(args)
+        limit = int(flags.get("limit", "5"))
+        if flags.get("node"):
+            nodes = [flags["node"]]
+        else:
+            nodes = [env.master_url]
+            try:
+                nodes += [n["url"] for n in env.data_nodes()]
+            except Exception:  # noqa: BLE001 — master-only census
+                pass
+        lines = [f"{'NODE':21}  {'TRANSPORT':9}  {'OPEN':>5}  STATES"]
+        detail: list[str] = []
+        reached = 0
+        for node in nodes:
+            base = node if "://" in node else f"http://{node}"
+            try:
+                out = rpc.call(f"{base}/debug/conns?limit={limit}",
+                               timeout=5.0)
+            except Exception:  # noqa: BLE001 — node gone
+                continue
+            if not isinstance(out, dict):
+                continue
+            reached += 1
+            name = base.split("://", 1)[1]
+            states = ",".join(f"{k}={v}" for k, v in
+                              sorted(out.get("states", {}).items())) \
+                or "-"
+            lines.append(f"{name:21}  {out.get('transport', '?'):9}  "
+                         f"{out.get('open', 0):5d}  {states}")
+            for c in out.get("conns", []):
+                detail.append(
+                    f"  {name:21}  {c.get('peer', '?'):21} "
+                    f"{c.get('state', '?'):9} "
+                    f"age={c.get('age_s', 0.0):7.1f}s "
+                    f"idle={c.get('idle_s', 0.0):6.1f}s "
+                    f"reqs={c.get('requests', 0)}")
+        if not reached:
+            raise ShellError("no /debug/conns endpoint reachable")
+        if detail:
+            lines.append("")
+            lines.append(f"oldest {limit} per node:")
+            lines.extend(detail)
+        return "\n".join(lines)
+
+
+@register
 class ClusterCheck(Command):
     name = "cluster.check"
     help = ("cluster.check — health rollup from the master's "

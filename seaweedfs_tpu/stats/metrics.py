@@ -433,6 +433,31 @@ lifecycle_actions_total = Counter(
     "lifecycle daemon actions by kind and outcome",
     ("action", "outcome"))  # tier|expire|promote x ok|error
 
+# -- front door (netcore / filer hot-path) instruments ----------------------
+# The filer chunk cache (storage/chunk_cache.py) and small-file packer
+# (filer/packing.py); connection-plane counters live in
+# netcore/registry.py beside the registry that feeds them.
+
+filer_chunk_cache_hit_bytes_total = Counter(
+    "SeaweedFS_filer_chunk_cache_hit_bytes_total",
+    "filer chunk-read bytes served from the process chunk cache")
+
+filer_chunk_cache_miss_bytes_total = Counter(
+    "SeaweedFS_filer_chunk_cache_miss_bytes_total",
+    "filer chunk-read bytes fetched from volume servers")
+
+filer_packed_files_total = Counter(
+    "SeaweedFS_filer_packed_files_total",
+    "small files packed into shared needles on filer upload")
+
+filer_packed_needles_total = Counter(
+    "SeaweedFS_filer_packed_needles_total",
+    "shared pack needles written (files-per-needle = files/needles)")
+
+filer_packed_bytes_total = Counter(
+    "SeaweedFS_filer_packed_bytes_total",
+    "payload bytes stored via the small-file packer")
+
 
 def observe_batch_stage(stages: dict, stage: str, seconds: float,
                         nbytes: int) -> None:

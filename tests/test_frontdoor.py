@@ -1,0 +1,636 @@
+"""Front-door network core (netcore/): the transport matrix.
+
+Every request-handling behavior the PR 5 overload plane pinned —
+admission sheds, exemptions, phase budgets, trace propagation — must be
+byte-identical under `-transport=threads` (thread-per-connection) and
+`-transport=aio` (event loop + bounded worker pool), because the aio
+loop hands complete requests to the SAME `_serve_one`.  Plus the rest
+of the front door: zero-copy sendfile as the default volume read path
+(vs buffered byte-identity, ranges, conditionals, TLS fallback), the
+filer chunk cache (singleflight, bounded bytes), the direct
+volume→client proxy leg, and small-file packing (shared-needle
+roundtrip, sibling-safe deletes, vacuum interaction).
+"""
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.core import types as t
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.stats.promcheck import validate_exposition
+from seaweedfs_tpu.storage.chunk_cache import FilerChunkCache
+from seaweedfs_tpu.trace import tracer
+
+pytestmark = pytest.mark.frontdoor
+
+TRANSPORTS = ("threads", "aio")
+
+
+# -- transport matrix: the overload plane behaves identically ---------------
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+def test_admission_shed_and_exemption(transport):
+    """A saturated lane sheds with 429 + Retry-After on BOTH
+    transports, and /debug/ surfaces stay admission-exempt (reachable
+    while the read lane is pinned)."""
+    server = rpc.JsonHttpServer(
+        transport=transport,
+        admission=rpc.AdmissionControl(1, queue_depth=0,
+                                       queue_timeout=0.1))
+    gate = threading.Event()
+    server.route("GET", "/work", lambda q, b: (gate.wait(5.0),
+                                               {"ok": True})[1])
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    results: list = []
+
+    def one():
+        try:
+            results.append(("ok", rpc.call(f"{base}/work",
+                                           timeout=10.0)))
+        except rpc.RpcError as e:
+            results.append(("shed", e))
+
+    try:
+        threads = [threading.Thread(target=one) for _ in range(5)]
+        for th in threads:
+            th.start()
+        time.sleep(0.4)  # one holds the slot; the rest shed
+        # Exempt debug surface answers while the lane is pinned.
+        snap = rpc.call(f"{base}/debug/conns", timeout=5.0)
+        assert snap["transport"] == transport
+        gate.set()
+        for th in threads:
+            th.join()
+    finally:
+        server.stop()
+    sheds = [e for kind, e in results if kind == "shed"]
+    oks = [r for kind, r in results if kind == "ok"]
+    assert sheds and oks
+    for e in sheds:
+        assert e.status == 429 and e.retry_after is not None
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+def test_phase_budget_rides_exemplar(transport):
+    """The time-attribution plane is transport-independent: a slow
+    request's exemplar carries its phase budget on aio exactly as on
+    threads (workers run the same `_dispatch`)."""
+    from seaweedfs_tpu.stats import phases
+    server = rpc.JsonHttpServer(transport=transport)
+
+    def slowop(q, b):
+        with phases.phase("disk"):
+            time.sleep(0.2)
+        time.sleep(0.08)
+        return {"ok": True}
+
+    server.route("GET", "/slowop", slowop)
+    server.enable_metrics(f"fd_{transport}")
+    server.start()
+    try:
+        assert rpc.call(
+            f"http://127.0.0.1:{server.port}/slowop") == {"ok": True}
+        ex = server.slo.exemplars()
+        assert ex, "0.28s request must exemplar (threshold 0.25)"
+        ph = ex[0]["phases"]
+        assert 0.15 <= ph["disk"] <= 0.3
+        assert ph.get("handler", 0) > 0.04
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+def test_trace_propagation(transport, monkeypatch):
+    """An inbound traceparent links the server span to the caller's
+    trace on both transports."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_TRACES", "1")
+    tracer.BUFFER.clear()
+    server = rpc.JsonHttpServer(transport=transport)
+    server.route("GET", "/traced", lambda q, b: {"ok": True})
+    from seaweedfs_tpu.trace import setup_server_tracing
+    setup_server_tracing(server, "fdsvc")
+    server.start()
+    try:
+        with tracer.root_span("client.op", "testclient") as root:
+            assert rpc.call(
+                f"http://127.0.0.1:{server.port}/traced",
+                headers={tracer.TRACEPARENT_HEADER: root.traceparent()}
+            ) == {"ok": True}
+            trace_id = root.trace_id
+        spans = tracer.BUFFER.get(trace_id)
+        assert spans, "server span missing from the caller's trace"
+        srv = [s for s in spans if s["service"] == "fdsvc"]
+        assert srv and srv[0]["name"] == "GET /traced"
+    finally:
+        server.stop()
+        tracer.BUFFER.clear()
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+def test_drain_refuses_writes_on_both_transports(transport, tmp_path):
+    """PR 5's drain lifecycle under either network core: after drain,
+    new writes get 503 + Retry-After while reads keep working."""
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60, transport=transport)
+    vs.start()
+    client = WeedClient(master.url())
+    try:
+        fid = client.upload_data(b"pre-drain bytes")
+        vid = t.parse_file_id(fid)[0]
+        # Capture the direct URL first: the drain's goodbye heartbeat
+        # unregisters the node from the master immediately.
+        loc = client.lookup(vid)[0]["url"]
+        url = f"http://{loc}/{fid}"
+        vs.drain(grace=1.0)
+        assert bytes(rpc.call(url, timeout=5.0)) == b"pre-drain bytes"
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.call(url, "POST", b"post-drain write", timeout=5.0)
+        assert ei.value.status == 503
+        assert ei.value.retry_after is not None
+    finally:
+        vs.stop()
+        master.stop()
+
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+def test_pipelined_keepalive_requests(transport):
+    """Two requests written back-to-back before reading: the aio loop
+    must replay buffered leftover bytes after a handoff returns the
+    socket (the pipelining path threads get for free)."""
+    server = rpc.JsonHttpServer(transport=transport)
+    server.route("GET", "/a", lambda q, b: {"n": 1})
+    server.route("GET", "/b", lambda q, b: {"n": 2})
+    server.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port),
+                                     timeout=5.0)
+        s.sendall(b"GET /a HTTP/1.1\r\nHost: x\r\n\r\n"
+                  b"GET /b HTTP/1.1\r\nHost: x\r\n\r\n")
+        buf = b""
+        deadline = time.time() + 5.0
+        while buf.count(b"HTTP/1.1 200") < 2 and time.time() < deadline:
+            buf += s.recv(65536)
+        assert b'{"n": 1}' in buf and b'{"n": 2}' in buf
+        s.close()
+    finally:
+        server.stop()
+
+
+def test_env_default_transport(monkeypatch):
+    """SEAWEEDFS_TPU_TRANSPORT=aio flips every JsonHttpServer that
+    doesn't pass transport= explicitly — the whole-suite toggle
+    conftest's header advertises."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_TRANSPORT", "aio")
+    server = rpc.JsonHttpServer()
+    server.route("GET", "/t", lambda q, b: {"ok": True})
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        assert rpc.call(f"{base}/t") == {"ok": True}
+        assert rpc.call(f"{base}/debug/conns")["transport"] == "aio"
+    finally:
+        server.stop()
+
+
+# -- /debug/conns + the open-connections gauge ------------------------------
+
+@pytest.mark.parametrize("transport", TRANSPORTS)
+def test_debug_conns_and_gauge(transport):
+    server = rpc.JsonHttpServer(transport=transport)
+    server.route("GET", "/t", lambda q, b: {"ok": True})
+    reg = server.enable_metrics(f"connrole_{transport}")
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        assert rpc.call(f"{base}/t") == {"ok": True}
+        snap = rpc.call(f"{base}/debug/conns")
+        assert snap["transport"] == transport
+        assert snap["open"] >= 1  # at least the conn asking
+        assert sum(snap["states"].values()) == snap["open"]
+        c = snap["conns"][0]
+        for k in ("peer", "state", "age_s", "idle_s", "requests"):
+            assert k in c, c
+        text = reg.expose()
+        assert "SeaweedFS_open_connections{" in text
+        assert f'role="connrole_{transport}"' in text
+        assert validate_exposition(text) == []
+    finally:
+        server.stop()
+
+
+# -- zero-copy sendfile as the default volume read path ---------------------
+
+@pytest.fixture()
+def needle_cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60)
+    vs.start()
+    client = WeedClient(master.url())
+    try:
+        yield master, vs, client
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def _raw_get(url, headers=None):
+    resp, conn = rpc._request(url, "GET", None, 10.0,
+                              req_headers=headers)
+    try:
+        body = resp.read()
+    finally:
+        rpc._finish(conn, resp)
+    return resp.status, dict(resp.headers), body
+
+
+def test_sendfile_vs_buffered_byte_identity(needle_cluster):
+    """The promoted default (sendfile for any whole-needle GET >= 4KB)
+    answers byte-for-byte what the buffered path answers — body,
+    status, ETag, Content-Length — for whole reads, ranges, and
+    conditional requests."""
+    _m, vs, client = needle_cluster
+    data = os.urandom(48 * 1024)
+    fid = client.upload_data(data)
+    url = f"{vs.server.url()}/{fid}"
+    cases = [
+        (None, 200),
+        ({"Range": "bytes=0-9"}, 206),
+        ({"Range": "bytes=1000-30000"}, 206),
+        ({"Range": "bytes=47000-"}, 206),
+    ]
+    assert vs.sendfile_min == 4096  # promoted default
+    results = {}
+    for mode, minv in (("sendfile", 4096), ("buffered", 0)):
+        vs.sendfile_min = minv
+        for hdrs, want_status in cases:
+            st, h, body = _raw_get(url, hdrs)
+            assert st == want_status, (mode, hdrs, st)
+            key = (tuple(sorted((hdrs or {}).items())),)
+            results.setdefault(key, []).append(
+                (st, body, h.get("etag"), h.get("content-length"),
+                 h.get("content-range")))
+    for key, pair in results.items():
+        assert pair[0] == pair[1], f"sendfile != buffered for {key}"
+    # Conditional: If-None-Match on the ETag answers 304 on both paths.
+    _st, h, _b = _raw_get(url)
+    etag = h["etag"]
+    for minv in (4096, 0):
+        vs.sendfile_min = minv
+        st, _h, body = _raw_get(url, {"If-None-Match": etag})
+        assert st == 304 and body == b""
+
+
+def test_sendfile_small_needle_took_slice_path(needle_cluster,
+                                               monkeypatch):
+    """8KB — far below any large-object special-casing — now rides
+    the zero-copy slice path by default (SENDFILE_MIN is one page)."""
+    from seaweedfs_tpu.storage.volume import Volume
+    _m, vs, client = needle_cluster
+    data = os.urandom(8 * 1024)
+    fid = client.upload_data(data)
+    vid = t.parse_file_id(fid)[0]
+    loc = client.lookup(vid)[0]["url"]
+    sliced: list = []
+    orig = Volume.read_needle_slice
+
+    def spy(self, *a, **kw):
+        sl = orig(self, *a, **kw)
+        if sl is not None:
+            sliced.append(sl.size)
+        return sl
+
+    monkeypatch.setattr(Volume, "read_needle_slice", spy)
+    st, _h, body = _raw_get(f"http://{loc}/{fid}")
+    assert st == 200 and body == data
+    assert sliced == [8 * 1024]
+
+
+def test_sendfile_tls_falls_back_buffered(tmp_path):
+    """A TLS volume server cannot os.sendfile into an SSL socket: the
+    response writer must take the buffered loop — same bytes, no
+    crash.  (The aio loop likewise diverts TLS conns to threads.)"""
+    import subprocess
+
+    from seaweedfs_tpu.utils.config import load_configuration
+    from seaweedfs_tpu.utils.security import (install_cluster_tls,
+                                              load_server_tls)
+
+    def _openssl(*args):
+        subprocess.run(["openssl", *args], check=True,
+                       capture_output=True)
+
+    d = tmp_path / "tls"
+    d.mkdir()
+    try:
+        _openssl("req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                 "-days", "1", "-keyout", str(d / "ca.key"),
+                 "-out", str(d / "ca.crt"), "-subj", "/CN=fd-ca")
+    except Exception:
+        pytest.skip("openssl unavailable")
+    for name in ("server", "client"):
+        _openssl("req", "-newkey", "rsa:2048", "-nodes",
+                 "-keyout", str(d / f"{name}.key"),
+                 "-out", str(d / f"{name}.csr"),
+                 "-subj", f"/CN=fd-{name}")
+        _openssl("x509", "-req", "-days", "1",
+                 "-in", str(d / f"{name}.csr"),
+                 "-CA", str(d / "ca.crt"), "-CAkey", str(d / "ca.key"),
+                 "-CAcreateserial", "-out", str(d / f"{name}.crt"))
+    (tmp_path / "security.toml").write_text(f'''
+[grpc]
+ca = "{d / 'ca.crt'}"
+
+[grpc.master]
+cert = "{d / 'server.crt'}"
+key  = "{d / 'server.key'}"
+
+[grpc.volume]
+cert = "{d / 'server.crt'}"
+key  = "{d / 'server.key'}"
+
+[grpc.client]
+cert = "{d / 'client.crt'}"
+key  = "{d / 'client.key'}"
+''')
+    cfg = load_configuration("security", search_paths=[str(tmp_path)])
+    assert install_cluster_tls(cfg) is True
+    master = MasterServer(
+        volume_size_limit_mb=64, meta_dir=str(tmp_path / "m"),
+        ssl_context=load_server_tls(cfg, "master"))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60, transport="aio",
+                      ssl_context=load_server_tls(cfg, "volume"))
+    vs.start()
+    try:
+        client = WeedClient(master.url())
+        data = os.urandom(64 * 1024)  # well above sendfile_min
+        fid = client.upload_data(data)
+        assert bytes(client.download(fid)) == data
+    finally:
+        vs.stop()
+        master.stop()
+        rpc.set_client_ssl_context(None)
+
+
+# -- filer chunk cache: singleflight + bounded bytes ------------------------
+
+def test_chunk_cache_singleflight():
+    """N concurrent readers of a cold chunk trigger exactly ONE
+    upstream fetch; followers are served the leader's bytes."""
+    cache = FilerChunkCache(max_bytes=1 << 20)
+    fetches: list = []
+    gate = threading.Event()
+
+    def fetch():
+        fetches.append(1)
+        gate.wait(5.0)
+        return b"chunk-bytes" * 100
+
+    out: list = []
+    threads = [threading.Thread(
+        target=lambda: out.append(cache.get_or_fetch("3,abc", fetch)))
+        for _ in range(8)]
+    for th in threads:
+        th.start()
+    time.sleep(0.2)
+    gate.set()
+    for th in threads:
+        th.join()
+    assert len(fetches) == 1, f"{len(fetches)} fetches, want 1"
+    assert len(out) == 8
+    assert all(o == b"chunk-bytes" * 100 for o in out)
+    st = cache.stats()
+    assert st["hit_bytes"] > 0 and st["miss_bytes"] == len(out[0])
+
+
+def test_chunk_cache_bounded_bytes_evicts_lru():
+    cache = FilerChunkCache(max_bytes=10_000)
+    for i in range(8):
+        cache.get_or_fetch(f"5,{i:08x}", lambda: bytes(3000))
+    st = cache.stats()
+    assert st["used_bytes"] <= 10_000
+    assert st["evictions"] >= 5
+    # The most recent chunk survived; the first was evicted.
+    hits0 = st["hit_bytes"]
+    cache.get_or_fetch("5,00000007", lambda: bytes(3000))
+    assert cache.stats()["hit_bytes"] == hits0 + 3000
+    refetched: list = []
+    cache.get_or_fetch("5,00000000",
+                       lambda: refetched.append(1) or bytes(3000))
+    assert refetched
+
+
+def test_filer_get_populates_chunk_cache(tmp_path):
+    """Read-through on the filer chunk path: the second GET of the
+    same file is served from cache (hit bytes move, no new fetch)."""
+    from seaweedfs_tpu.storage.chunk_cache import CACHE
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60)
+    vs.start()
+    filer = FilerServer(master.url())
+    filer.start()
+    try:
+        base = filer.url()
+        payload = os.urandom(30_000)
+        rpc.call(base + "/cached.bin", "PUT", payload)
+        assert rpc.call(base + "/cached.bin") == payload
+        st1 = CACHE.stats()
+        assert rpc.call(base + "/cached.bin") == payload
+        st2 = CACHE.stats()
+        assert st2["hit_bytes"] > st1["hit_bytes"]
+        assert st2["miss_bytes"] == st1["miss_bytes"]
+        # The debug surface reports the same economics.
+        dbg = rpc.call(base + "/debug/cache")
+        assert dbg["chunk_cache"]["hit_bytes"] == st2["hit_bytes"]
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+
+# -- small-file packing ------------------------------------------------------
+
+@pytest.fixture()
+def packing_stack(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path))
+    master.start()
+    # Extra volume slots: TTL'd packs grow their own volume pool
+    # beside the plain one (default 7 slots = one growth).
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60, max_volume_counts=[30])
+    vs.start()
+    filer = FilerServer(master.url(), pack_threshold=4096,
+                        pack_linger=0.05)
+    filer.start()
+    try:
+        yield master, vs, filer
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()
+
+
+def _concurrent_puts(base, paths_payloads):
+    errs: list = []
+
+    def one(p, d):
+        try:
+            rpc.call(base + p, "PUT", d)
+        except Exception as e:  # noqa: BLE001
+            errs.append((p, e))
+
+    threads = [threading.Thread(target=one, args=pp)
+               for pp in paths_payloads]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs, errs
+
+
+def test_packed_files_share_needle_and_roundtrip(packing_stack):
+    _m, _vs, filer = packing_stack
+    base = filer.url()
+    payloads = {f"/p{i}.txt": f"tiny-{i}-".encode() * 30
+                for i in range(6)}
+    _concurrent_puts(base, list(payloads.items()))
+    fids = set()
+    for p, want in payloads.items():
+        e = rpc.call(base + p + "?metadata=true")
+        (chunk,) = e["chunks"]
+        assert chunk["packed"] is True
+        fids.add(chunk["file_id"])
+        assert rpc.call(base + p) == want
+    assert len(fids) <= 2, f"6 concurrent tiny files used {len(fids)} needles"
+
+
+def test_packed_delete_leaves_siblings_and_survives_vacuum(
+        packing_stack):
+    """Deleting one packed file removes only filer metadata; after a
+    vacuum pass on the volume the surviving siblings still read back
+    (the shared needle was never deleted, so vacuum keeps it)."""
+    _m, vs, filer = packing_stack
+    base = filer.url()
+    payloads = {f"/d{i}.txt": f"del-{i}-".encode() * 40
+                for i in range(4)}
+    _concurrent_puts(base, list(payloads.items()))
+    e = rpc.call(base + "/d0.txt?metadata=true")
+    pack_fid = e["chunks"][0]["file_id"]
+    rpc.call(base + "/d0.txt", "DELETE")
+    time.sleep(0.5)  # deletion queue flush window
+    # A non-packed large file deleted alongside DOES free its needle.
+    big = os.urandom(20_000)
+    rpc.call(base + "/big-del.bin", "PUT", big)
+    rpc.call(base + "/big-del.bin", "DELETE")
+    vid = t.parse_file_id(pack_fid)[0]
+    v = vs.store.find_volume(vid)
+    assert v is not None
+    from seaweedfs_tpu.storage.vacuum import vacuum
+    vacuum(v)
+    for p in ("/d1.txt", "/d2.txt", "/d3.txt"):
+        assert rpc.call(base + p) == payloads[p], \
+            f"{p} lost after sibling delete + vacuum"
+    with pytest.raises(rpc.RpcError):
+        rpc.call(base + "/d0.txt")
+
+
+def test_packed_ttl_files_get_ttl_needles(packing_stack):
+    """TTL uploads pack separately per ttl value, so whole-needle
+    expiry stays correct; the filer entry records ttl_sec."""
+    _m, _vs, filer = packing_stack
+    base = filer.url()
+    # Pre-warm the plain (non-ttl) volume pool so the concurrent
+    # assigns below don't race two different-TTL volume growths.
+    rpc.call(base + "/warm.bin", "PUT", os.urandom(8192))
+    _concurrent_puts(base, [("/t1.txt?ttl=1m", b"ttl-one" * 20),
+                            ("/t2.txt?ttl=1m", b"ttl-two" * 20),
+                            ("/nt.txt", b"no-ttl" * 20)])
+    e1 = rpc.call(base + "/t1.txt?metadata=true")
+    e2 = rpc.call(base + "/t2.txt?metadata=true")
+    en = rpc.call(base + "/nt.txt?metadata=true")
+    assert e1["attributes"]["ttl_sec"] == 60
+    assert "ttl_sec" not in en["attributes"] or \
+        en["attributes"]["ttl_sec"] == 0
+    # ttl files share a pack; the non-ttl file is in a different one.
+    assert e1["chunks"][0]["file_id"] == e2["chunks"][0]["file_id"]
+    assert en["chunks"][0]["file_id"] != e1["chunks"][0]["file_id"]
+
+
+def test_oversize_and_cipher_skip_packing(tmp_path):
+    _m = MasterServer(volume_size_limit_mb=64, meta_dir=str(tmp_path))
+    _m.start()
+    vs = VolumeServer(_m.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60)
+    vs.start()
+    cf = FilerServer(_m.url(), pack_threshold=4096, cipher=True)
+    cf.start()
+    try:
+        base = cf.url()
+        rpc.call(base + "/sealed.txt", "PUT", b"cipher small file")
+        e = rpc.call(base + "/sealed.txt?metadata=true")
+        assert not e["chunks"][0].get("packed")
+        assert e["chunks"][0].get("cipher_key")
+        assert rpc.call(base + "/sealed.txt") == b"cipher small file"
+    finally:
+        cf.stop()
+        vs.stop()
+        _m.stop()
+
+
+# -- direct volume→client proxy leg -----------------------------------------
+
+def test_large_read_proxies_and_matches(tmp_path):
+    """A >= proxy_min single-chunk GET streams through ProxiedBody
+    (cache stays cold) and is byte-identical; a small range of the
+    same file takes the cached buffered path."""
+    from seaweedfs_tpu.storage.chunk_cache import CACHE
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path))
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp_path / "vs")],
+                      pulse_seconds=60)
+    vs.start()
+    filer = FilerServer(master.url(), chunk_size=1 << 20)
+    filer.start()
+    try:
+        base = filer.url()
+        big = os.urandom(500 * 1024)
+        rpc.call(base + "/stream.bin", "PUT", big)
+        used0 = CACHE.stats()["used_bytes"]
+        assert rpc.call(base + "/stream.bin") == big
+        assert CACHE.stats()["used_bytes"] == used0, \
+            "proxied big read must not populate the chunk cache"
+        st, h, body = _raw_get(base + "/stream.bin",
+                               {"Range": "bytes=65536-458751"})
+        assert st == 206 and body == big[65536:458752]
+        assert h["content-range"] == f"bytes 65536-458751/{len(big)}"
+        # Sub-proxy_min range: buffered path, cache fills.
+        st, _h, body = _raw_get(base + "/stream.bin",
+                                {"Range": "bytes=10-99"})
+        assert st == 206 and body == big[10:100]
+        assert CACHE.stats()["used_bytes"] > used0
+        assert rpc.call(base + "/stream.bin") == big  # still identical
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()

@@ -188,6 +188,57 @@ def test_idle_timeout_reaps_slow_client_not_healthy_streams(
     assert len(healthy) == 8 and all(h == {"ok": True} for h in healthy)
 
 
+def test_aio_reaps_stalled_faster_than_keepalive_idle():
+    """Event-loop reap policy distinguishes two idle shapes: a conn
+    with request bytes buffered but no progress (slow loris) dies at
+    the HARD stall timeout, while an empty-buffer keep-alive conn — a
+    healthy pooled client between requests — survives until the full
+    -idle.timeout.  One timer for both would either kill every pooled
+    client early or give sloris attackers the long budget."""
+    import socket as socketlib
+    server = rpc.JsonHttpServer(idle_timeout=4.0, stall_timeout=0.5,
+                                transport="aio")
+    server.route("GET", "/ping", lambda q, b: {"ok": True})
+    server.start()
+    try:
+        addr = ("127.0.0.1", server.port)
+        # Stalled mid-request: half a request line, then silence.
+        stalled = socketlib.create_connection(addr, timeout=5.0)
+        stalled.sendall(b"GET /pi")
+        # Keep-alive idle: one complete request, then silence.
+        idle = socketlib.create_connection(addr, timeout=5.0)
+        idle.sendall(b"GET /ping HTTP/1.1\r\nHost: x\r\n\r\n")
+        assert b"200" in idle.recv(4096)
+        deadline = time.time() + 3.0
+        reaped = None
+        while time.time() < deadline:
+            stalled.settimeout(0.25)
+            try:
+                if stalled.recv(1) == b"":
+                    reaped = time.time()
+                    break
+            except TimeoutError:
+                continue
+            except OSError:
+                reaped = time.time()
+                break
+        assert reaped is not None, \
+            "stalled conn survived well past stall_timeout"
+        # The idle keep-alive conn must still be usable afterwards...
+        idle.settimeout(5.0)
+        idle.sendall(b"GET /ping HTTP/1.1\r\nHost: x\r\n\r\n")
+        assert b"200" in idle.recv(4096)
+        # ...and the registry recorded the reap with the right kind.
+        snap = rpc.call(f"http://127.0.0.1:{server.port}/debug/conns")
+        assert snap["transport"] == "aio"
+        from seaweedfs_tpu.netcore.registry import conns_reaped_total
+        assert conns_reaped_total.value(kind="stalled") >= 1
+        idle.close()
+        stalled.close()
+    finally:
+        server.stop()
+
+
 # -- disk-full safety ---------------------------------------------------------
 
 def test_enospc_rolls_back_cleanly_no_torn_tail(tmp_path):

@@ -232,9 +232,8 @@ def test_filer_get_streams_with_bounded_memory(stack):
     md5_hex = _upload(f"{filer.url()}/stream/rbig.bin", total)
     # Peak memory must track the (bounded) chunk cache, not the file:
     # shrink the cache so a buffered body would stand out.
-    filer.streamer.cache.capacity = 4 * MB
-    filer.streamer.cache._m.clear()
-    filer.streamer.cache._size = 0
+    filer.streamer.cache.reset()
+    filer.streamer.cache.configure(4 * MB)
     tracemalloc.start()
     md5 = hashlib.md5()
     with urllib.request.urlopen(f"{filer.url()}/stream/rbig.bin",
@@ -264,9 +263,8 @@ def test_s3_get_object_streams(stack):
     total = 32 * MB
     _upload(f"{s3.url()}/strbkt", 0)  # create bucket (empty PUT)
     md5_hex = _upload(f"{s3.url()}/strbkt/big.obj", total)
-    filer.streamer.cache.capacity = 4 * MB
-    filer.streamer.cache._m.clear()
-    filer.streamer.cache._size = 0
+    filer.streamer.cache.reset()
+    filer.streamer.cache.configure(4 * MB)
     tracemalloc.start()
     md5 = hashlib.md5()
     with urllib.request.urlopen(f"{s3.url()}/strbkt/big.obj",
