@@ -38,9 +38,18 @@ REAL subprocess cluster (master + 2 volume servers), then:
    r02 rate THROUGH the held fleet, and profile-diffs the two
    transports' hottest frames — the front-door claim (10x the parked
    connections at flat threads/RSS and an unharmed tail) as a gate.
+6. (round 4) the TENANCY / QoS noisy-neighbor phase: a flood tenant
+   offers 10x its rps quota against the same volume server a victim
+   tenant reads from; with -tenant.rules armed the flood's excess must
+   shed as 429 + Retry-After, the flood's admitted rate must hold near
+   its quota, and the victim's p99 under flood must stay within 3x its
+   solo baseline with zero errors and zero 429s for in-quota traffic.
+   A ruleless cluster publishes the QoS-off comparison.  Standalone:
+   `python bench_load.py --tenant` writes only BENCH_tenant_r01.json.
 
 Output: one JSON document (default BENCH_load_r03.json) — the BENCH
-series beside the EC kernel numbers.
+series beside the EC kernel numbers — plus BENCH_tenant_r01.json from
+the round-4 tenant phase.
 
 Knobs (env): BENCH_LOAD_QUICK=1 (seconds-scale smoke: the `slow`
 pytest path), BENCH_LOAD_RATE, BENCH_LOAD_DURATION, BENCH_LOAD_WARMUP,
@@ -109,7 +118,7 @@ class Cluster:
 
     def __init__(self, tmp: str, attribution: bool = True,
                  traces: bool = True, transport: str | None = None,
-                 volumes: int = 2):
+                 volumes: int = 2, tenant_rules: str | None = None):
         from seaweedfs_tpu.cluster import rpc
         self.tmp = tmp
         self.n_volumes = volumes
@@ -137,8 +146,10 @@ class Cluster:
                        SEAWEEDFS_TPU_PHASES="0")
         mport = rpc.free_port()
         self.master_url = f"http://127.0.0.1:{mport}"
-        self._spawn(["master", f"-port={mport}",
-                     f"-mdir={tmp}/meta"], env)
+        margs = ["master", f"-port={mport}", f"-mdir={tmp}/meta"]
+        if tenant_rules:
+            margs.append(f"-tenant.rules={tenant_rules}")
+        self._spawn(margs, env)
         self.volume_urls = []
         for i in range(volumes):
             vport = rpc.free_port()
@@ -148,6 +159,8 @@ class Cluster:
                     "-max=50", f"-mserver=127.0.0.1:{mport}",
                     f"-slo.read.p99={SLO_READ_P99}",
                     "-slo.availability=99.9"]
+            if tenant_rules:
+                args.append(f"-tenant.rules={tenant_rules}")
             if transport:
                 args.append(f"-transport={transport}")
             self._spawn(args, env)
@@ -839,9 +852,191 @@ def connection_scaling() -> dict:
     return out
 
 
+# -- round 4: the tenancy / QoS noisy-neighbor phase -------------------------
+#
+# One flood tenant offers 10x its rps quota while a victim tenant runs
+# an in-quota read load against the same volume server.  With the QoS
+# plane armed (-tenant.rules) the flood's excess is shed as cheap 429s
+# and the victim's tail must hold: p99 under flood within 3x the solo
+# baseline measured on the SAME cluster, zero errors and zero 429s for
+# the in-quota victim.  A second ruleless cluster publishes the
+# QoS-off comparison (what the victim pays when nobody is throttled).
+
+TEN_QUOTA_RPS = _env("BENCH_TENANT_QUOTA_RPS", 20.0)
+TEN_FLOOD_X = _env("BENCH_TENANT_FLOOD_X", 10.0)
+TEN_VICTIM_RATE = _env("BENCH_TENANT_VICTIM_RATE", 50.0)
+TEN_SECONDS = _env("BENCH_TENANT_SECONDS", 4.0 if QUICK else 10.0)
+TEN_WORKERS = int(_env("BENCH_TENANT_WORKERS", 8))
+
+
+def _tenant_probe(urls: list[str], tenant: str, rate: float,
+                  seconds: float) -> dict:
+    """Open-loop reads AS a tenant (X-Weed-Tenant on the wire),
+    classifying admitted / 429-shed / errored per request; the
+    percentiles cover the admitted requests only (shed requests get
+    Retry-After, they are not latency samples)."""
+    import random as _random
+
+    from seaweedfs_tpu.cluster import rpc
+    hdr = {"X-Weed-Tenant": tenant}
+    total = int(rate * seconds)
+    lat: list[float] = []
+    shed = [0]
+    errs = [0]
+    retry_after = [0.0]
+    lock = threading.Lock()
+    idx = [0]
+    t0 = time.perf_counter() + 0.1
+
+    def worker(wi: int) -> None:
+        rng = _random.Random(wi)
+        while True:
+            with lock:
+                i = idx[0]
+                if i >= total:
+                    return
+                idx[0] += 1
+            due = t0 + i / rate
+            now = time.perf_counter()
+            if due > now:
+                time.sleep(due - now)
+            t1 = time.perf_counter()
+            try:
+                rpc.call(rng.choice(urls), timeout=10.0, headers=hdr)
+                d = time.perf_counter() - t1
+                with lock:
+                    lat.append(d)
+            except rpc.RpcError as e:
+                with lock:
+                    if e.status == 429:
+                        shed[0] += 1
+                        if e.retry_after:
+                            retry_after[0] = max(retry_after[0],
+                                                 float(e.retry_after))
+                    else:
+                        errs[0] += 1
+            except Exception:  # noqa: BLE001 — connection-level failure
+                with lock:
+                    errs[0] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(TEN_WORKERS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    elapsed = time.perf_counter() - t0
+    out = percentiles(lat)
+    out["offered"] = total
+    out["admitted"] = len(lat)
+    out["shed_429"] = shed[0]
+    out["errors"] = errs[0]
+    out["retry_after_max_s"] = round(retry_after[0], 3)
+    out["offered_rps"] = round(total / max(elapsed, 1e-9), 1)
+    out["admitted_rps"] = round(len(lat) / max(elapsed, 1e-9), 1)
+    return out
+
+
+def tenant_phase() -> dict:
+    """Noisy-neighbor A/B: QoS-on (rules file) vs QoS-off (ruleless),
+    fresh single-volume cluster each, same key set and rates."""
+    import numpy as np
+
+    from seaweedfs_tpu.cluster import rpc
+    from seaweedfs_tpu.cluster.client import WeedClient
+    flood_rate = TEN_QUOTA_RPS * TEN_FLOOD_X
+    doc: dict = {"quota_rps": TEN_QUOTA_RPS,
+                 "flood_offered_rps": flood_rate,
+                 "victim_rate_rps": TEN_VICTIM_RATE,
+                 "seconds": TEN_SECONDS, "workers": TEN_WORKERS}
+    for mode in ("qos_on", "qos_off"):
+        tmp = tempfile.mkdtemp(prefix=f"bench_tenant_{mode}_")
+        rules = None
+        if mode == "qos_on":
+            rules = os.path.join(tmp, "tenants.txt")
+            with open(rules, "w") as fh:
+                fh.write(f"flood   max_rps={TEN_QUOTA_RPS:g} weight=1\n"
+                         "victim  weight=4 max_bytes=1TB\n")
+        cluster = Cluster(tmp, attribution=False, traces=False,
+                          volumes=1, tenant_rules=rules)
+        try:
+            cluster.wait_ready()
+            rng = np.random.default_rng(1)
+            client = WeedClient(cluster.master_url)
+            urls = _resolve_read_urls(
+                cluster, populate(client, min(KEYS, 60), SIZE, rng))
+            row: dict = {}
+            if mode == "qos_on":
+                log(f"  {mode}: victim solo baseline "
+                    f"({TEN_VICTIM_RATE:g} rps x {TEN_SECONDS:g}s) ...")
+                row["victim_solo"] = _tenant_probe(
+                    urls, "victim", TEN_VICTIM_RATE, TEN_SECONDS)
+            log(f"  {mode}: flood {flood_rate:g} rps vs victim "
+                f"{TEN_VICTIM_RATE:g} rps ...")
+            flood_box: dict = {}
+
+            def run_flood() -> None:
+                flood_box.update(_tenant_probe(
+                    urls, "flood", flood_rate, TEN_SECONDS + 1.5))
+
+            ft = threading.Thread(target=run_flood)
+            ft.start()
+            time.sleep(0.75)  # flood ramps first: victim measures UNDER it
+            row["victim_under_flood"] = _tenant_probe(
+                urls, "victim", TEN_VICTIM_RATE, TEN_SECONDS)
+            ft.join()
+            row["flood"] = flood_box
+            snap = rpc.call(
+                f"http://{cluster.volume_urls[0]}/debug/tenants")
+            row["server_view"] = {
+                k: snap[k] for k in ("rates", "admission") if k in snap}
+            doc[mode] = row
+        finally:
+            cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    solo = doc["qos_on"]["victim_solo"]
+    under = doc["qos_on"]["victim_under_flood"]
+    flood = doc["qos_on"]["flood"]
+    ratio = under["p99"] / max(solo["p99"], 1e-9)
+    doc["victim_p99_ratio"] = round(ratio, 3)
+    # 50ms absolute escape hatch: on a shared 1-core box a 1ms solo
+    # baseline makes the 3x ratio a sub-noise gate; a victim tail that
+    # stays under 50ms absolute is unharmed by any reading.
+    doc["gates"] = {
+        "victim_p99_within_3x_solo":
+            under["p99"] <= max(3.0 * solo["p99"], 0.05),
+        "flood_excess_shed_as_429": flood["shed_429"] > 0,
+        "flood_held_near_quota":
+            flood["admitted_rps"] <= TEN_QUOTA_RPS * 1.6,
+        "victim_zero_errors":
+            solo["errors"] == 0 and under["errors"] == 0
+            and solo["shed_429"] == 0 and under["shed_429"] == 0,
+    }
+    doc["qos_ok"] = all(doc["gates"].values())
+    return doc
+
+
+def tenant_round(out_path: str) -> int:
+    """Round 4 runner: publish BENCH_tenant_r01.json, gate on qos_ok."""
+    t0 = time.time()
+    log("tenant phase (round 4: noisy-neighbor QoS fairness) ...")
+    phase = tenant_phase()
+    doc = {"bench": "tenant", "round": 4, "quick": QUICK,
+           **phase, "elapsed_s": round(time.time() - t0, 1)}
+    print(json.dumps(doc, indent=1))
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    log(f"wrote {out_path}")
+    return 0 if doc["qos_ok"] else 1
+
+
 def main() -> int:
     out_path = "BENCH_load_r03.json"
     args = sys.argv[1:]
+    tenant_only = "--tenant" in args
+    if tenant_only:
+        out_path = "BENCH_tenant_r01.json"
     if "-o" in args:
         out_path = args[args.index("-o") + 1]
 
@@ -852,6 +1047,9 @@ def main() -> int:
     # convoy and the measured CLIENT tail is the interpreter's, not
     # the cluster's.
     sys.setswitchinterval(0.001)
+
+    if tenant_only:
+        return tenant_round(out_path)
 
     tmp = tempfile.mkdtemp(prefix="bench_load_")
     cluster = Cluster(tmp, attribution=True)
@@ -1057,7 +1255,11 @@ def main() -> int:
         # not regressions.  Round 3's gating measurands are the
         # connection-scaling claims; drift in the overhead ratios
         # stays visible in the JSON series.
-        return 0 if ok else 1
+        # round 4: the tenancy / QoS noisy-neighbor phase publishes
+        # its own JSON (BENCH_tenant_r01.json) and gates alongside.
+        ten_rc = tenant_round(
+            os.path.join(REPO, "BENCH_tenant_r01.json"))
+        return 0 if (ok and ten_rc == 0) else 1
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -79,7 +79,8 @@ class MasterServer:
                  replication_lag_slo: float | None = None,
                  lifecycle_rules: str = "",
                  lifecycle_interval: float = 60.0,
-                 lifecycle_mbps: float = 32.0):
+                 lifecycle_mbps: float = 32.0,
+                 tenant_rules: str = ""):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
@@ -120,13 +121,29 @@ class MasterServer:
         # oldest unacked change-log record is older than this, and
         # recovers when the standby catches up.
         self.replication_lag_slo = replication_lag_slo
+        # Tenancy & QoS plane (-tenant.rules): declarative per-tenant
+        # quotas.  Stored-usage rules (max_bytes/max_objects) are
+        # enforced HERE at assign time against the heartbeat-fed
+        # rollup; rate rules feed this master's own admission buckets.
+        # The rollup snapshots to <meta_dir>/tenants.json so a restart
+        # answers quota checks before heartbeats repopulate it.
+        from ..tenancy import QuotaPolicy, UsageRollup
+        from ..tenancy import load_rules as load_tenant_rules
+        self.tenant_policy = load_tenant_rules(tenant_rules) \
+            if tenant_rules else QuotaPolicy()
+        self.usage_rollup = UsageRollup(
+            f"{meta_dir}/tenants.json" if meta_dir else None)
+        self._last_quota_emit: dict[str, float] = {}
         # Overload protection (-max.concurrent): bounded assignment/
         # lookup concurrency with 429 sheds; /heartbeat, healthz, and
         # the watch streams are admission-exempt.
         self.server = rpc.JsonHttpServer(
             host, port, ssl_context=ssl_context,
             idle_timeout=idle_timeout, transport=transport,
-            admission=rpc.AdmissionControl(max_concurrent))
+            admission=rpc.AdmissionControl(
+                max_concurrent,
+                tenant_policy=self.tenant_policy
+                if self.tenant_policy.rules else None))
         s = self.server
         s.route("POST", "/heartbeat", self._heartbeat)
         s.route("GET", "/dir/assign", self._assign)
@@ -166,6 +183,7 @@ class MasterServer:
         s.route("GET", "/cluster/lifecycle", self._cluster_lifecycle)
         s.route("POST", "/cluster/lifecycle/run",
                 self._cluster_lifecycle_run)
+        s.route("GET", "/cluster/tenants", self._cluster_tenants)
         reg = s.enable_metrics("master")
         # SLO plane: declared objectives drive the burn engine behind
         # /cluster/healthz; /debug/slow + /debug/slo expose exemplars
@@ -194,6 +212,17 @@ class MasterServer:
         reg.gauge("SeaweedFS_node_health",
                   "per data node: 1 = heartbeat fresh, 0 = stale",
                   ("node",), callback=self._node_health_values)
+        reg.gauge("SeaweedFS_master_tenant_bytes",
+                  "cluster-wide stored bytes by tenant (heartbeat "
+                  "rollup, replicas counted per copy)", ("tenant",),
+                  callback=lambda: {
+                      (t,): float(e["bytes"]) for t, e in
+                      self.usage_rollup.totals().items()})
+        reg.gauge("SeaweedFS_master_tenant_objects",
+                  "cluster-wide stored objects by tenant", ("tenant",),
+                  callback=lambda: {
+                      (t,): float(e["objects"]) for t, e in
+                      self.usage_rollup.totals().items()})
         self._grow_lock = threading.Lock()
         self._hb_apply_lock = threading.Lock()  # guards the lock table
         self._hb_node_locks: dict[str, threading.Lock] = {}
@@ -397,6 +426,12 @@ class MasterServer:
     def stop(self) -> None:
         self._stop.set()
         self.lifecycle.stop()
+        # Final usage snapshot: quota checks after a restart answer
+        # from this until heartbeats repopulate the rollup.
+        try:
+            self.usage_rollup.save(force=True)
+        except OSError:
+            pass
         if self.raft is not None:
             self.raft.stop()
         self.server.stop()
@@ -526,6 +561,12 @@ class MasterServer:
                 # pairing config from the node's shipper — the health
                 # rollup's lag-SLO input and /cluster/mirror's rows.
                 dn.replication = hb["replication"]
+            if "tenants" in hb:
+                # Absolute per-(tenant, collection) stored usage:
+                # replace this node's rollup rows and write through to
+                # the durable snapshot (cadence-gated inside save()).
+                self.usage_rollup.update_node(dn.url(), hb["tenants"])
+                self.usage_rollup.save()
             seq = hb.get("seq")
             if seq is not None:
                 # The epoch changes when the volume server restarts, so
@@ -729,9 +770,55 @@ class MasterServer:
             rack=query.get("rack", ""),
             data_node=query.get("dataNode", ""))
 
+    def _quota_verdict(self, tenant: str) -> tuple | None:
+        """(rule, used_bytes, used_objects, reasons) when the tenant is
+        over a stored-usage quota, else None."""
+        rule = self.tenant_policy.rule_for(tenant)
+        if rule is None or not (rule.max_bytes or rule.max_objects):
+            return None
+        used_b, used_o = self.usage_rollup.usage_for(tenant)
+        reasons = []
+        if rule.max_bytes and used_b >= rule.max_bytes:
+            reasons.append(f"stored bytes {used_b} >= "
+                           f"max_bytes {rule.max_bytes}")
+        if rule.max_objects and used_o >= rule.max_objects:
+            reasons.append(f"stored objects {used_o} >= "
+                           f"max_objects {rule.max_objects}")
+        if not reasons:
+            return None
+        return (rule, used_b, used_o, reasons)
+
+    def _check_assign_quota(self, tenant: str) -> None:
+        """Hard byte/object quotas reject at ASSIGN time — before any
+        volume server sees a byte — with the same 403 QuotaExceeded
+        the filer/S3 front door answers.  Soft rules only journal (one
+        `quota.exceeded` row per tenant per >=5s episode) and surface
+        on healthz."""
+        if not tenant:
+            return
+        verdict = self._quota_verdict(tenant)
+        if verdict is None:
+            return
+        rule, used_b, used_o, reasons = verdict
+        now = time.monotonic()
+        if now - self._last_quota_emit.get(tenant, 0.0) >= 5.0:
+            self._last_quota_emit[tenant] = now
+            from ..events import emit as emit_event
+            emit_event("quota.exceeded", node=self.url(),
+                       severity="warn", tenant=tenant,
+                       soft=rule.soft, used_bytes=used_b,
+                       used_objects=used_o, reason="; ".join(reasons))
+        if rule.soft:
+            return
+        raise rpc.RpcError(
+            403, f"QuotaExceeded: tenant {tenant!r} over quota "
+                 f"({'; '.join(reasons)}); delete data (and let "
+                 f"vacuum reclaim) to resume writes")
+
     def _assign(self, query: dict, body: bytes) -> dict:
         if not self.is_leader():
             return self._proxy_to_leader("/dir/assign", query, body)
+        self._check_assign_quota(query.get("_tenant", ""))
         from .raft import NotLeader
         option = self._option_from_query(query)
         count = int(query.get("count", 1))
@@ -1124,13 +1211,38 @@ class MasterServer:
         slo_doc = {"read": _qs(slo_reads), "write": _qs(slo_writes),
                    "sources": len(slo_reads),
                    "fast_burn": burning_nodes}
+        # Tenancy rollup: a tenant over a HARD stored quota is a
+        # healthz problem row (mirroring the 403s being answered);
+        # soft breaches stay warnings — they must not flip the whole
+        # cluster to 503 for a load balancer.
+        tenancy_rows = []
+        tenancy_warnings = []
+        for t, ent in sorted(self.usage_rollup.totals().items()):
+            verdict = self._quota_verdict(t)
+            tenancy_rows.append({"tenant": t, "bytes": ent["bytes"],
+                                 "objects": ent["objects"],
+                                 "over_quota": verdict is not None})
+            if verdict is not None:
+                rule, _b, _o, reasons = verdict
+                if rule.soft:
+                    tenancy_warnings.append(
+                        f"tenant {t}: soft quota exceeded — "
+                        f"{'; '.join(reasons)}")
+                else:
+                    problems.append(
+                        f"tenant {t}: hard quota exceeded — "
+                        f"{'; '.join(reasons)} (writes rejected "
+                        f"with 403 QuotaExceeded)")
         doc = {"healthy": not problems, "problems": problems,
                "leader": self.leader_url(), "is_leader": self.is_leader(),
                "nodes": nodes, "volumes": volumes,
                "ec_volumes": ec_volumes, "slo": slo_doc,
                "replication": {"lag_slo": self.replication_lag_slo,
                                "volumes": replication_rows},
-               "lifecycle": self.lifecycle.status()}
+               "lifecycle": self.lifecycle.status(),
+               "tenancy": {"rules": len(self.tenant_policy.rules),
+                           "warnings": tenancy_warnings,
+                           "tenants": tenancy_rows}}
         return not problems, doc
 
     def _cluster_mirror(self, query: dict, body: bytes) -> dict:
@@ -1166,6 +1278,34 @@ class MasterServer:
                 "caught_up": bool(rows) and all(
                     not r.get("lag_seq") for r in rows),
                 "volumes": rows}
+
+    def _cluster_tenants(self, query: dict, body: bytes) -> dict:
+        """GET /cluster/tenants — the tenancy rollup: per-tenant stored
+        usage (heartbeat-fed, replicas per copy), the matching quota
+        rule, and an over_quota verdict per tenant — the shell's
+        `cluster.tenants` / `tenant.ls` source."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/cluster/tenants", query,
+                                         body, "GET")
+        tenants: dict[str, dict] = {}
+        for t, ent in sorted(self.usage_rollup.totals().items()):
+            row = {"bytes": ent["bytes"], "objects": ent["objects"],
+                   "collections": ent["collections"]}
+            rule = self.tenant_policy.rule_for(t)
+            if rule is not None:
+                row["rule"] = rule.to_dict()
+                over = []
+                if rule.max_bytes and ent["bytes"] >= rule.max_bytes:
+                    over.append("bytes")
+                if rule.max_objects and \
+                        ent["objects"] >= rule.max_objects:
+                    over.append("objects")
+                row["over_quota"] = over
+                row["enforcement"] = "soft" if rule.soft else "hard"
+            tenants[t] = row
+        return {"tenants": tenants,
+                "rules": self.tenant_policy.to_dict()["rules"],
+                "leader": self.url()}
 
     def _cluster_lifecycle(self, query: dict, body: bytes) -> dict:
         """GET /cluster/lifecycle — the daemon's rules, scan history,

@@ -40,7 +40,8 @@ from ..netcore.registry import ConnRegistry, CountedConn, \
     conns_reaped_total
 from ..stats import contention as _contention
 from ..stats import phases as _phases
-from ..stats.metrics import Counter, Gauge
+from ..stats.metrics import Counter, Gauge, Histogram
+from ..tenancy import context as _tenant_ctx
 from ..trace import tracer as _tracer
 from . import resilience as _res
 
@@ -192,6 +193,37 @@ inflight_requests = Gauge(
     callback=_inflight_values)
 
 
+def _queue_depth_values() -> dict:
+    out = {("read",): 0.0, ("write",): 0.0, ("internal",): 0.0}
+    for adm in list(_admission_instances):
+        for lane in adm.lanes.values():
+            out[(lane.name,)] += float(lane.waiting)
+    return out
+
+
+# Per-lane queue pressure: the signal worker-pool autoscaling (and an
+# operator eyeballing a saturated role) needs BEFORE sheds start — a
+# nonzero depth with zero sheds is the early-warning band.
+admission_queue_depth = Gauge(
+    "SeaweedFS_admission_queue_depth",
+    "admission waiters currently queued per lane", ("lane",),
+    callback=_queue_depth_values)
+
+# Realized queue wait per lane (admitted AND timed-out waits): the
+# companion latency signal to the depth gauge above.
+admission_wait_seconds = Histogram(
+    "SeaweedFS_admission_wait_seconds",
+    "time spent waiting in the admission queue", ("lane",))
+
+# Per-tenant QoS throttles (tenancy/qos.py token buckets): an
+# over-rate tenant's 429s, named — the flooding principal is visible
+# on any role's scrape, distinct from lane sheds which blame no one.
+tenant_throttled_total = Counter(
+    "SeaweedFS_tenant_throttled_total",
+    "requests throttled (429) by per-tenant QoS token buckets",
+    ("tenant",))
+
+
 class _Lane:
     """One admission lane: a concurrency cap plus a bounded wait queue.
 
@@ -204,15 +236,23 @@ class _Lane:
 
     __slots__ = ("name", "cap", "queue_depth", "queue_timeout", "_sem",
                  "inflight", "waiting", "shed", "_lock",
-                 "_last_shed_emit")
+                 "_last_shed_emit", "_drr")
 
     def __init__(self, name: str, cap: int, queue_depth: int,
-                 queue_timeout: float):
+                 queue_timeout: float, weight_for=None):
+        from ..tenancy.qos import DrrQueue
         self.name = name
         self.cap = cap
         self.queue_depth = queue_depth
         self.queue_timeout = queue_timeout
         self._sem = threading.BoundedSemaphore(cap) if cap > 0 else None
+        # Per-tenant sub-queues inside this lane: freed slots are
+        # handed out deficit-round-robin across tenants (weighted by
+        # quota-rule weight=), so one flooding tenant's backlog cannot
+        # monopolize the queue.  Untenanted traffic shares the ""
+        # sub-queue — with a single tenant (or none) this degrades to
+        # the plain FIFO the lane always had.
+        self._drr = DrrQueue(weight_for=weight_for)
         self.inflight = 0
         self.waiting = 0
         self.shed = 0
@@ -231,9 +271,15 @@ class _Lane:
             if cap > 0 else threading.Lock()
         self._last_shed_emit = 0.0
 
-    def enter(self) -> bool:
+    def enter(self, tenant: str = "") -> bool:
         """Admit (possibly after a bounded wait) or shed; True = admitted
-        (the caller MUST pair it with exit())."""
+        (the caller MUST pair it with exit()).
+
+        The wait queue is per-tenant DRR: a waiter parks in its
+        tenant's sub-queue and is woken by exit() handing it a freed
+        slot directly (the semaphore is bypassed on handoff, so queued
+        waiters can never be barged by fast-path newcomers — a free
+        permit only exists while nobody waits)."""
         if self._sem is None:
             with self._lock:
                 self.inflight += 1
@@ -245,24 +291,45 @@ class _Lane:
         with self._lock:
             queue_full = self.waiting >= self.queue_depth
             if not queue_full:
+                w = self._drr.push(tenant)
                 self.waiting += 1
         if queue_full:
             self._record_shed()
             return False
-        ok = self._sem.acquire(timeout=self.queue_timeout)
+        t0 = time.perf_counter()
+        granted = w.event.wait(self.queue_timeout)
+        admission_wait_seconds.observe(time.perf_counter() - t0,
+                                       lane=self.name)
         with self._lock:
             self.waiting -= 1
-            if ok:
+            if not granted and w.event.is_set():
+                # Lost race: exit() handed us the slot between the wait
+                # timing out and this lock — the handoff is already
+                # made, so refusing it would leak a permit.
+                granted = True
+            if granted:
                 self.inflight += 1
-        if not ok:
+            else:
+                self._drr.discard(w)
+        if not granted:
             self._record_shed()
-        return ok
+        return granted
 
     def exit(self) -> None:
         with self._lock:
             self.inflight -= 1
-        if self._sem is not None:
-            self._sem.release()
+            if self._sem is None:
+                return
+            w = self._drr.pop()
+            if w is not None:
+                # Direct handoff: the permit moves to the waiter.  Set
+                # INSIDE the lock — a waiter timing out concurrently
+                # rechecks is_set() under this same lock, so the slot
+                # is either visibly handed or still poppable, never
+                # handed to a corpse.
+                w.event.set()
+                return
+        self._sem.release()
 
     def _record_shed(self) -> None:
         requests_shed_total.inc(lane=self.name)
@@ -317,7 +384,9 @@ class AdmissionControl:
                  queue_depth: int | None = None,
                  queue_timeout: float = 2.0,
                  internal_concurrent: int | None = None,
-                 retry_after: float = 1.0):
+                 retry_after: float = 1.0,
+                 tenant_policy=None):
+        from ..tenancy.qos import TenantBuckets
         self.max_concurrent = max_concurrent
         if queue_depth is None:
             queue_depth = 2 * max_concurrent
@@ -325,17 +394,47 @@ class AdmissionControl:
             internal_concurrent = max(1, max_concurrent // 4) \
                 if max_concurrent else 0
         self.retry_after = retry_after
+        # Tenancy QoS (-tenant.rules): per-tenant req/s + write-MB/s
+        # token buckets at the gate, and DRR weights inside the lane
+        # queues.  No policy = no throttling, weight 1 for everyone.
+        self.tenant_policy = tenant_policy
+        self.tenant_buckets = TenantBuckets(tenant_policy)
+        weight_for = tenant_policy.weight_for if tenant_policy \
+            is not None else None
+        self._last_throttle_emit: dict[str, float] = {}
         self.lanes = {
             "read": _Lane("read", max_concurrent, queue_depth,
-                          queue_timeout),
+                          queue_timeout, weight_for),
             "write": _Lane("write", max_concurrent, queue_depth,
-                           queue_timeout),
+                           queue_timeout, weight_for),
             "internal": _Lane("internal", internal_concurrent,
                               max(1, queue_depth // 2)
                               if internal_concurrent else 0,
-                              queue_timeout),
+                              queue_timeout, weight_for),
         }
         _admission_instances.add(self)
+
+    def throttle(self, tenant: str, nbytes: int = 0) -> float:
+        """Per-tenant token-bucket check: 0.0 = admitted, else the
+        Retry-After to surface on the 429.  Counts + journals the
+        throttle (one `tenant.throttled` row per tenant per >=5s
+        episode, like the lane-shed event)."""
+        if not tenant:
+            return 0.0
+        retry = self.tenant_buckets.admit(tenant, nbytes)
+        if retry <= 0.0:
+            return 0.0
+        tenant_throttled_total.inc(tenant=tenant)
+        now = time.monotonic()
+        if now - self._last_throttle_emit.get(tenant, 0.0) >= 5.0:
+            self._last_throttle_emit[tenant] = now
+            with _tracer.root_span("tenant.throttled", "rpc"):
+                _events.emit(
+                    "tenant.throttled", severity="warn", tenant=tenant,
+                    retry_after=round(retry, 3),
+                    throttled_total=int(
+                        tenant_throttled_total.value(tenant=tenant)))
+        return retry
 
     def lane_for(self, method: str, headers: dict,
                  query: dict) -> _Lane:
@@ -350,9 +449,14 @@ class AdmissionControl:
         return sum(lane.inflight for lane in self.lanes.values())
 
     def snapshot(self) -> dict:
-        return {name: {"cap": lane.cap, "inflight": lane.inflight,
-                       "waiting": lane.waiting, "shed": lane.shed}
-                for name, lane in self.lanes.items()}
+        out = {}
+        for name, lane in self.lanes.items():
+            with lane._lock:  # DrrQueue is lane-lock serialized
+                queued = lane._drr.tenants()
+            out[name] = {"cap": lane.cap, "inflight": lane.inflight,
+                         "waiting": lane.waiting, "shed": lane.shed,
+                         "queued_tenants": queued}
+        return out
 
 
 def free_port() -> int:
@@ -733,6 +837,11 @@ class JsonHttpServer:
         # counts by lane and the live in-flight gauge.
         reg.register_once(requests_shed_total)
         reg.register_once(inflight_requests)
+        # Tenancy & QoS instruments: live per-lane queue depth, time
+        # spent waiting for admission, and per-tenant throttle counts.
+        reg.register_once(admission_queue_depth)
+        reg.register_once(admission_wait_seconds)
+        reg.register_once(tenant_throttled_total)
         # Front-door instruments: live connections by lifecycle state
         # (per-server registry, sampled at scrape) and event-loop reap
         # counts (process-global — kinds in netcore/registry.py).
@@ -1030,6 +1139,25 @@ class JsonHttpServer:
                           None, close=not keep)
             return keep
 
+        # Principal resolution (tenancy/): the tenant is the
+        # X-Weed-Tenant header (stamped by the S3 gateway from the
+        # authenticated identity, or set explicitly by a client), else
+        # the collection as fallback; the originating client rides
+        # X-Weed-Client on proxy legs (filer→volume) so hot-key
+        # attribution names the real caller, not the proxy's IP.
+        # Resolved ONCE here, parked in reserved query keys for the
+        # handlers and in the thread-local principal context so every
+        # outbound hop this thread makes auto-forwards it (same model
+        # as the traceparent).
+        tenant = headers.get("x-weed-tenant", "") \
+            or query.get("collection", "")
+        client = headers.get("x-weed-client", "") \
+            or query.get("_remote_addr", "")
+        query["_tenant"] = tenant
+        if client:
+            query["_client"] = client
+        _tenant_ctx.set_principal(tenant, client)
+
         # Admission gate: classify into a lane (read / write /
         # internal) and acquire a slot — or shed with 429 +
         # Retry-After when the lane AND its bounded wait queue are
@@ -1042,8 +1170,29 @@ class JsonHttpServer:
             lane = self.admission.lane_for(method, headers, query)
             if info is not None:
                 info.lane = lane.name
+            # Per-tenant QoS at the gate (token buckets): over-rate
+            # tenants are refused BEFORE touching the lane, so their
+            # excess never competes for queue slots.  Internal cluster
+            # traffic is tenant-exempt, like the low-priority lane.
+            if tenant and lane.name != "internal":
+                wbytes = len(body) if isinstance(
+                    body, (bytes, bytearray)) and \
+                    method not in ("GET", "HEAD") else 0
+                retry = self.admission.throttle(tenant, wbytes)
+                if retry > 0.0:
+                    if not self._finish_stream_body(body):
+                        keep = False
+                    self._observe_request(method, req_path, 429, 0.0)
+                    self._respond(
+                        conn, method, 429,
+                        {"error": f"tenant {tenant!r} over rate "
+                                  f"quota; retry"},
+                        {"Retry-After": f"{retry:.3g}"},
+                        close=not keep)
+                    return keep
             t_gate = time.perf_counter()
-            if not lane.enter():
+            if not lane.enter("" if lane.name == "internal"
+                              else tenant):
                 if not self._finish_stream_body(body):
                     keep = False
                 # Sheds are part of the error tail: count them in the
@@ -1073,6 +1222,9 @@ class JsonHttpServer:
         finally:
             if lane is not None:
                 lane.exit()
+            # Keep-alive threads serve many requests: a stale
+            # principal must not leak into the next one.
+            _tenant_ctx.clear_principal()
 
     def _observe_request(self, method: str, req_path: str, status: int,
                          seconds: float, trace_id: str = "",
@@ -1123,6 +1275,8 @@ class JsonHttpServer:
             tspan = _tracer.begin_server_span(
                 self.trace_service, method, req_path,
                 headers.get("traceparent", ""))
+            if tspan is not None and query.get("_tenant"):
+                tspan.attrs["tenant"] = query["_tenant"]
         # Phase ledger (stats/phases.py): opened on this thread for
         # the handler's lifetime; instrumentation anywhere below
         # (metered locks, disk wrappers, EC device timers, outbound
@@ -1559,6 +1713,17 @@ def _request(url: str, method: str, body, timeout: float,
                _tracer.TRACEPARENT_HEADER not in req_headers):
         req_headers = {**(req_headers or {}),
                        _tracer.TRACEPARENT_HEADER: tp}
+    # Principal propagation rides the same way: the thread's resolved
+    # tenant/client forward on every outbound hop so proxy legs
+    # (filer→volume, volume→replica) keep the ORIGINAL attribution.
+    _t = _tenant_ctx.current_tenant()
+    if _t and (req_headers is None or
+               "X-Weed-Tenant" not in req_headers):
+        req_headers = {**(req_headers or {}), "X-Weed-Tenant": _t}
+    _c = _tenant_ctx.current_client()
+    if _c and (req_headers is None or
+               "X-Weed-Client" not in req_headers):
+        req_headers = {**(req_headers or {}), "X-Weed-Client": _c}
     # Manual split on the hot path: urlsplit costs ~7µs/request and
     # its internal cache misses on per-fid URLs.  Anything unusual
     # (IPv6 brackets, userinfo, missing scheme, query-with-no-path)

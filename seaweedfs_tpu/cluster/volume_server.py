@@ -80,7 +80,8 @@ class VolumeServer:
                  tier_promote_hits: int = 0,
                  tier_promote_window: float = 60.0,
                  transport: str | None = None,
-                 sendfile_min: int | None = None):
+                 sendfile_min: int | None = None,
+                 tenant_rules: str = ""):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -102,6 +103,14 @@ class VolumeServer:
         # -read.redirect (volume.go:79, default true): GETs of volumes
         # not hosted here 301 to a current holder instead of 404ing.
         self.read_redirect = read_redirect
+        # Tenancy & QoS (-tenant.rules): quota rules feed per-tenant
+        # token buckets + DRR weights in the admission plane, and the
+        # usage ledger below reports per-(tenant, collection) stored
+        # bytes/objects to the master on every heartbeat.
+        from ..tenancy import TenantUsage, load_rules
+        self.tenant_policy = load_rules(tenant_rules) \
+            if tenant_rules else None
+        self.usage = TenantUsage()
         # Overload protection (-max.concurrent): bounded read/write
         # lanes + the lower-priority internal lane; 0 = no shedding
         # (in-flight is still tracked for graceful drain).
@@ -109,8 +118,9 @@ class VolumeServer:
             host, port, ssl_context=ssl_context,
             idle_timeout=idle_timeout,
             transport=transport,
-            admission=rpc.AdmissionControl(max_concurrent,
-                                           queue_depth=queue_depth))
+            admission=rpc.AdmissionControl(
+                max_concurrent, queue_depth=queue_depth,
+                tenant_policy=self.tenant_policy))
         # -read.sendfile.min: smallest whole-needle GET served via the
         # zero-copy slice path (0 disables, None = class default).
         self.sendfile_min = self.SENDFILE_MIN if sendfile_min is None \
@@ -263,6 +273,7 @@ class VolumeServer:
         from ..stats.hotkeys import HotKeyTracker
         self.hot = HotKeyTracker()
         s.route("GET", "/debug/hot", self._debug_hot)
+        s.route("GET", "/debug/tenants", self._debug_tenants)
         s.route("GET", "/admin/volume_file", self._volume_file)
         s.route("POST", "/admin/copy_volume", self._copy_volume)
         s.route("POST", "/admin/mount", self._admin_mount)
@@ -439,6 +450,19 @@ class VolumeServer:
                   "remote backend block-fetch latency quantiles "
                   "(5-minute window)", ("quantile",),
                   callback=tier_fetch_quantiles)
+        # Tenancy plane: live per-tenant stored usage on this node —
+        # the same numbers the heartbeat reports into the master's
+        # rollup, scrapeable without a /debug/tenants hit.
+        reg.gauge("SeaweedFS_tenant_stored_bytes",
+                  "stored bytes by tenant on this server", ("tenant",),
+                  callback=lambda: {
+                      (t,): float(e["bytes"])
+                      for t, e in self.usage.stored_totals().items()})
+        reg.gauge("SeaweedFS_tenant_stored_objects",
+                  "stored objects by tenant on this server",
+                  ("tenant",), callback=lambda: {
+                      (t,): float(e["objects"])
+                      for t, e in self.usage.stored_totals().items()})
 
     # -- heartbeats ---------------------------------------------------------
 
@@ -511,6 +535,11 @@ class VolumeServer:
                 # folds every node into one cluster-wide tail on
                 # /cluster/healthz and degrades on fast burn.
                 "slo": self.server.slo.heartbeat_view(),
+                # Per-(tenant, collection) stored usage, ABSOLUTE
+                # values (idempotent): the master's UsageRollup
+                # replaces this node's rows wholesale each beat, so a
+                # dropped beat or failover never double-counts.
+                "tenants": self.usage.heartbeat_view(),
             }
             if self.shipper is not None:
                 # Per-volume replication lag (seq delta + seconds) +
@@ -628,6 +657,7 @@ class VolumeServer:
             return
         from ..stats.metrics import ttl_expired_bytes_total
         ttl_expired_bytes_total.inc(size, via="volume_retire")
+        self.usage.drop_volume(v.vid)
         emit_event("volume.expired", node=self.url(), vid=v.vid,
                    collection=v.collection, bytes=size, tiered=tiered,
                    ttl=str(v.super_block.ttl))
@@ -829,9 +859,19 @@ class VolumeServer:
     # and fall through unchanged; tune/disable with -read.sendfile.min.
     SENDFILE_MIN = 4096
 
+    @staticmethod
+    def _principal(query: dict) -> tuple[str, str]:
+        """(tenant, originating client) the rpc middleware resolved —
+        `_client` carries the X-Weed-Client a proxying filer forwarded,
+        so hot-key attribution names the real caller, not the proxy."""
+        return (query.get("_tenant", ""),
+                query.get("_client", "") or
+                query.get("_remote_addr", ""))
+
     def _get_needle(self, path: str, query: dict, body: bytes):
         vid, key, cookie = self._parse_fid_path(path)
-        self.hot.read(vid, key, query.get("_remote_addr", ""))
+        tenant, client = self._principal(query)
+        self.hot.read(vid, key, client, tenant)
         if _fault.ARMED:
             _fault.hit("volume.read", vid=vid, server=self.url())
         v = self.store.find_volume(vid)
@@ -890,11 +930,14 @@ class VolumeServer:
                         total = sl.size
                         sl.offset += lo
                         sl.size = hi - lo + 1
+                        self.usage.note_request(tenant,
+                                                read_bytes=sl.size)
                         return (206, sl, {
                             **cond,
                             "Content-Length": str(sl.size),
                             "Content-Range":
                             f"bytes {lo}-{hi}/{total}"})
+                    self.usage.note_request(tenant, read_bytes=sl.size)
                     return (200, sl,
                             {**cond,
                              "Content-Length": str(sl.size)})
@@ -931,6 +974,8 @@ class VolumeServer:
         weed/server/common.go:233 via
         volume_server_handlers_read.go:255-264) — storage layout must
         never change read behavior."""
+        self.usage.note_request(query.get("_tenant", ""),
+                                read_bytes=len(n.data))
         cond, not_modified = self._conditional_headers(
             query, f"{n.checksum:08x}", n.name if n.has_name() else b"",
             n.mime if n.has_mime() else b"",
@@ -1565,6 +1610,15 @@ class VolumeServer:
         out["node"] = self.url()
         return out
 
+    def _debug_tenants(self, query: dict, body: bytes) -> dict:
+        """GET /debug/tenants — this node's live per-tenant ledger:
+        stored bytes/objects by (tenant, collection) plus the sliding
+        req/s and read/write bytes/s meters."""
+        out = self.usage.snapshot()
+        out["node"] = self.url()
+        out["admission"] = self.server.admission.snapshot()
+        return out
+
     def _ui(self, query: dict, body: bytes):
         """Status page (the reference's volume UI, server/volume_ui)."""
         from html import escape as esc
@@ -1633,7 +1687,8 @@ class VolumeServer:
         self._check_write_jwt(path, query)
         self._refuse_if_draining(query)
         vid, key, cookie = self._parse_fid_path(path)
-        self.hot.write(vid, key, query.get("_remote_addr", ""))
+        tenant, client = self._principal(query)
+        self.hot.write(vid, key, client, tenant)
         if _fault.ARMED:
             _fault.hit("volume.write", vid=vid, server=self.url())
         v = self.store.find_volume(vid)
@@ -1708,17 +1763,32 @@ class VolumeServer:
                     except Exception:  # noqa: BLE001 — best effort
                         pass
                 raise
+        # Usage accounting: replica copies account on their own server
+        # (the ?type=replicate leg lands here too), so the master's
+        # rollup counts bytes the way the disks do — per copy.  An
+        # overwrite keeps the object count; the superseded bytes are
+        # reclaimed by the delete/vacuum decrement path.
+        self.usage.add(tenant, v.collection, len(body),
+                       nobjects=0 if existed else 1, vid=vid)
+        self.usage.note_request(tenant, written_bytes=len(body))
         return {"size": len(body), "eTag": f"{n.checksum:08x}"}
 
     def _delete_needle(self, path: str, query: dict, body: bytes) -> dict:
         self._check_write_jwt(path, query)
         self._refuse_if_draining(query)
         vid, key, _cookie = self._parse_fid_path(path)
-        self.hot.write(vid, key, query.get("_remote_addr", ""))
+        tenant, client = self._principal(query)
+        self.hot.write(vid, key, client, tenant)
         v = self.store.find_volume(vid)
         if v is None:
             raise rpc.RpcError(404, f"volume {vid} not on this server")
         freed = self.store.delete_needle(vid, key)
+        if freed > 0:
+            # Deletes decrement at tombstone time (not vacuum time):
+            # quota headroom comes back the moment the user deletes,
+            # even though the disk bytes wait for compaction.
+            self.usage.remove(tenant, v.collection, freed, 1, vid=vid)
+        self.usage.note_request(tenant)
         if query.get("type") != "replicate":
             self._replicate(path, query, b"", "DELETE")
         return {"size": freed}
@@ -1854,6 +1924,10 @@ class VolumeServer:
     def _admin_delete_volume(self, query: dict, body: bytes) -> dict:
         req = json.loads(body)
         self.store.delete_volume(req["volume"])
+        # Whole-volume teardown: subtract everything the volume still
+        # held from the tenant ledger (the per-needle decrement path
+        # never saw these).
+        self.usage.drop_volume(req["volume"])
         self._send_heartbeat()
         return {}
 

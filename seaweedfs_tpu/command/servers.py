@@ -186,6 +186,10 @@ def run_master(flags: Flags, args: list[str]) -> int:
         lifecycle_rules=flags.get("lifecycle.rules", ""),
         lifecycle_interval=flags.get_float("lifecycle.interval", 60.0),
         lifecycle_mbps=flags.get_float("lifecycle.mbps", 32.0),
+        # Tenancy plane: -tenant.rules names the quota/QoS policy file
+        # (line grammar or TOML) — hard quotas reject at /dir/assign,
+        # rps/bw limits throttle with 429, weights drive DRR fairness.
+        tenant_rules=flags.get("tenant.rules", ""),
         **_slo_flags(flags))
     m.start()
     glog.infof("master serving at %s", m.server.url())
@@ -258,6 +262,10 @@ def run_volume(flags: Flags, args: list[str]) -> int:
         tier_cache_mb=flags.get_float("tier.cache.mb", 64.0),
         tier_promote_hits=flags.get_int("tier.promote.hits", 0),
         tier_promote_window=flags.get_float("tier.promote.window", 60.0),
+        # Tenancy plane: same policy file as the master's -tenant.rules
+        # — here it drives the per-tenant token buckets and DRR weights
+        # on this node's admission lanes.
+        tenant_rules=flags.get("tenant.rules", ""),
         # -slo.read.p99 / -slo.availability: declared objectives for
         # the burn engine; exemplars + quantiles run regardless.
         **_slo_flags(flags))
@@ -311,6 +319,13 @@ def run_filer(flags: Flags, args: list[str]) -> int:
         pack_linger=flags.get_float("filer.pack.linger", 0.008),
         proxy_min=(int(flags.get("filer.proxy.min"))
                    if flags.get("filer.proxy.min") != "" else None),
+        # Tenancy plane: -tenant.rules arms the filer's front-door QoS
+        # gate; -filer.cache.tenant.mb caps any one tenant's share of
+        # the chunk cache (0/absent = no per-tenant cap).
+        tenant_rules=flags.get("tenant.rules", ""),
+        cache_tenant_mb=(int(flags.get("filer.cache.tenant.mb"))
+                         if flags.get("filer.cache.tenant.mb") != ""
+                         else None),
         **_slo_flags(flags))
     fs.start()
     glog.infof("filer serving at %s", fs.server.url())
@@ -374,6 +389,7 @@ def run_server(flags: Flags, args: list[str]) -> int:
                lifecycle_interval=flags.get_float("lifecycle.interval",
                                                   60.0),
                lifecycle_mbps=flags.get_float("lifecycle.mbps", 32.0),
+               tenant_rules=flags.get("tenant.rules", ""),
                # -transport applies to EVERY embedded role, like -slo.*.
                transport=_transport_flag(flags),
                # -slo.* applies to EVERY embedded role, same as the
@@ -409,6 +425,7 @@ def run_server(flags: Flags, args: list[str]) -> int:
                           "tier.promote.hits", 0),
                       tier_promote_window=flags.get_float(
                           "tier.promote.window", 60.0),
+                      tenant_rules=flags.get("tenant.rules", ""),
                       transport=_transport_flag(flags),
                       **_slo_flags(flags))
     vs.start()
@@ -430,6 +447,7 @@ def run_server(flags: Flags, args: list[str]) -> int:
                          transport=_transport_flag(flags),
                          pack_threshold=flags.get_int(
                              "filer.pack.threshold", 0),
+                         tenant_rules=flags.get("tenant.rules", ""),
                          ssl_context=_security("filer"))
         fs.start()
         servers.append(fs)
@@ -467,7 +485,8 @@ register(Command("master", "master -port=9333 -mdir=/tmp/meta"
                  " [-transport=aio|threads]"
                  " [-replicate.lag.slo=30(s)]"
                  " [-lifecycle.rules=rules.txt]"
-                 " [-lifecycle.interval=60] [-lifecycle.mbps=32]",
+                 " [-lifecycle.interval=60] [-lifecycle.mbps=32]"
+                 " [-tenant.rules=tenants.txt]",
                  "start a master server", run_master))
 register(Command("volume",
                  "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
@@ -479,12 +498,14 @@ register(Command("volume",
                  " [-replicate.peer=standby-master:9333]"
                  " [-replicate.collections=a,b] [-replicate.interval=0.5]"
                  " [-tier.cache.mb=64] [-tier.promote.hits=0]"
-                 " [-tier.promote.window=60]",
+                 " [-tier.promote.window=60] [-tenant.rules=tenants.txt]",
                  "start a volume server", run_volume))
 register(Command("filer", "filer -port=8888 -master=host:9333"
                  " [-transport=aio|threads] [-filer.cache.mb=64]"
                  " [-filer.pack.threshold=0(B)] [-filer.pack.max=1048576]"
-                 " [-filer.pack.linger=0.008] [-filer.proxy.min=262144]",
+                 " [-filer.pack.linger=0.008] [-filer.proxy.min=262144]"
+                 " [-tenant.rules=tenants.txt]"
+                 " [-filer.cache.tenant.mb=0]",
                  "start a filer server", run_filer))
 register(Command("msg.broker", "msg.broker -port=17777 -filer=host:8888",
                  "start a pub/sub message broker", run_msg_broker))
@@ -497,6 +518,7 @@ register(Command("server",
                  " [-transport=aio|threads]"
                  " [-s3.config=identities.json]"
                  " [-lifecycle.rules=rules.txt]"
+                 " [-tenant.rules=tenants.txt]"
                  " [-tier.cache.mb=64] [-tier.promote.hits=0]",
                  "start master+volume(+filer+s3) in one process",
                  run_server))

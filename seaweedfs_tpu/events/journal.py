@@ -128,6 +128,14 @@ TYPES: dict[str, str] = {
                       "was retired whole: remote copy deleted if "
                       "tiered, local files dropped, master unregisters "
                       "it on the next heartbeat",
+    "quota.exceeded": "a tenant crossed a stored-usage quota "
+                      "(max_bytes/max_objects): hard rules started "
+                      "rejecting its writes with 403 QuotaExceeded, "
+                      "soft rules only journal and warn on healthz",
+    "tenant.throttled": "a tenant's request or write-bandwidth token "
+                        "bucket ran dry and its excess is being shed "
+                        "with 429 + Retry-After (one row per >=5s "
+                        "episode, with the cumulative count)",
 }
 
 SEVERITIES = ("info", "warn", "error")

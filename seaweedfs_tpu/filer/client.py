@@ -18,11 +18,19 @@ from ..trace import current_traceparent
 
 
 def _traced(req: urllib.request.Request) -> urllib.request.Request:
-    """Propagate the active trace context on the urllib-based calls
-    (the rpc-pooled calls inject it in rpc._request)."""
+    """Propagate the active trace AND tenancy context on the
+    urllib-based calls (the rpc-pooled calls inject both in
+    rpc._request)."""
     tp = current_traceparent()
     if tp:
         req.add_header("traceparent", tp)
+    from ..tenancy import context as _tenant_ctx
+    tenant = _tenant_ctx.current_tenant()
+    if tenant:
+        req.add_header("X-Weed-Tenant", tenant)
+    client = _tenant_ctx.current_client()
+    if client:
+        req.add_header("X-Weed-Client", client)
     return req
 
 

@@ -442,13 +442,19 @@ class Filer:
                 chunks = data + manifests
             except Exception:  # noqa: BLE001 — an unreadable manifest
                 pass  # still frees the chunks we can see
+        # The pump thread that actually issues the blob deletes has no
+        # request context, so the deleting principal is captured HERE —
+        # the volume-side tenant ledger decrements the same tenant the
+        # delete request named.
+        from ..tenancy import context as _tenant_ctx
+        tenant = _tenant_ctx.current_tenant()
         with self._del_lock:
             # Packed chunks (filer/packing.py) share their needle with
             # sibling files: deleting one file must never free the
             # pack.  The pack's bytes come back via TTL expiry /
             # collection drop, which reclaim the needle as a whole.
             self._pending_deletions.extend(
-                c.file_id for c in chunks
+                (c.file_id, tenant) for c in chunks
                 if not getattr(c, "packed", False))
 
     def _deletion_pump(self) -> None:
@@ -457,11 +463,20 @@ class Filer:
             self.flush_deletions()
 
     def flush_deletions(self) -> None:
+        from ..tenancy import context as _tenant_ctx
         with self._del_lock:
             batch, self._pending_deletions = self._pending_deletions, []
         if batch and self._delete_fn is not None:
+            by_tenant: dict[str, list[str]] = {}
+            for fid, tenant in batch:
+                by_tenant.setdefault(tenant, []).append(fid)
             try:
-                self._delete_fn(batch)
+                for tenant, fids in by_tenant.items():
+                    _tenant_ctx.set_principal(tenant)
+                    try:
+                        self._delete_fn(fids)
+                    finally:
+                        _tenant_ctx.clear_principal()
             except Exception:  # noqa: BLE001 — blob servers may be down;
                 with self._del_lock:  # retry next tick
                     self._pending_deletions = batch + \

@@ -15,6 +15,7 @@ Reference: weed/server/filer_server.go + filer_server_handlers_*.go:
 from __future__ import annotations
 
 import json
+import threading
 import time
 import urllib.parse
 
@@ -122,7 +123,9 @@ class FilerServer:
                  pack_threshold: int = 0,
                  pack_max_bytes: int = 1 << 20,
                  pack_linger: float = 0.008,
-                 proxy_min: int | None = None):
+                 proxy_min: int | None = None,
+                 tenant_rules: str = "",
+                 cache_tenant_mb: int | None = None):
         # Accepts an HA seed list; all master traffic (including the
         # /dir/* proxies mounts rely on) fails over via WeedClient.
         self.client = WeedClient(master_url)
@@ -141,6 +144,24 @@ class FilerServer:
             # chunk cache (storage/chunk_cache.py).
             from ..storage.chunk_cache import CACHE
             CACHE.configure(int(cache_mb) << 20)
+        if cache_tenant_mb is not None:
+            # -filer.cache.tenant.mb caps any one tenant's share of the
+            # chunk cache (tenant-first eviction; 0 = off).
+            from ..storage.chunk_cache import CACHE
+            CACHE.configure_tenant_cap(int(cache_tenant_mb) << 20)
+        # Tenancy plane: local rules drive the front-door QoS gate
+        # (per-tenant DRR fairness + token buckets in the rpc server);
+        # HARD byte/object quotas are enforced against the MASTER's
+        # cluster-wide rollup, polled with a short TTL (fail-open — a
+        # quota check must never take writes down with the master).
+        from ..tenancy import TenantUsage, load_rules
+        self.tenant_policy = load_rules(tenant_rules) \
+            if tenant_rules else None
+        self.usage = TenantUsage()
+        self._quota_cache: dict = {}     # tenant -> master row
+        self._quota_cache_at = 0.0
+        self._quota_cache_ttl = 2.0
+        self._quota_lock = threading.Lock()
         # -filer.pack.threshold: group-commit sub-threshold uploads
         # into shared needles (filer/packing.py; 0 = off).
         self.packer = SmallFilePacker(self.client, pack_threshold,
@@ -161,13 +182,15 @@ class FilerServer:
         except Exception as e:  # noqa: BLE001 — a broken notification
             from ..utils import glog  # config must not kill the filer
             glog.warningf("notification queue disabled: %s", e)
-        self.server = rpc.JsonHttpServer(host, port,
-                                         ssl_context=ssl_context,
-                                         transport=transport)
+        self.server = rpc.JsonHttpServer(
+            host, port, ssl_context=ssl_context, transport=transport,
+            admission=rpc.AdmissionControl(
+                0, tenant_policy=self.tenant_policy))
         s = self.server
         s.route("GET", "/.meta/subscribe", self._meta_subscribe)
         s.route("GET", "/.meta/info", self._meta_info)
         s.route("GET", "/debug/cache", self._debug_cache)
+        s.route("GET", "/debug/tenants", self._debug_tenants)
         s.route("GET", "/.ui", self._ui)
         from ..utils.pprof import enable_pprof_routes
         enable_pprof_routes(s)
@@ -327,6 +350,7 @@ class FilerServer:
         else:
             status, lo, n = 200, 0, size
         headers["Content-Length"] = str(n)
+        self.usage.note_request(query.get("_tenant", ""), read_bytes=n)
         if self.proxy_min > 0 and n >= self.proxy_min:
             # Large single-chunk window: relay the volume's bytes
             # straight through (zero-copy when the platform splices)
@@ -363,6 +387,57 @@ class FilerServer:
     # — the reference's filer and volume reads go through the same
     # processRangeRequest (filer_server_handlers_read.go:130).
     _parse_range = staticmethod(rpc.parse_byte_range)
+
+    # -- tenancy -------------------------------------------------------------
+
+    def _tenant_rows(self) -> dict:
+        """Master /cluster/tenants rows, cached ~2s.  Fail-open: a
+        master outage must degrade quota enforcement, not uploads —
+        the master re-checks at assign time anyway (the backstop)."""
+        now = time.monotonic()
+        with self._quota_lock:
+            if now - self._quota_cache_at < self._quota_cache_ttl:
+                return self._quota_cache
+        try:
+            doc = self.client._master_call("/cluster/tenants")
+            rows = doc.get("tenants", {}) if isinstance(doc, dict) \
+                else {}
+        except Exception:  # noqa: BLE001 — fail open
+            rows = self._quota_cache
+        with self._quota_lock:
+            self._quota_cache = rows
+            self._quota_cache_at = now
+        return rows
+
+    def _check_quota(self, tenant: str) -> None:
+        """Reject an upload up front when the master's rollup says the
+        tenant is over a HARD quota — same 403 shape as the master's
+        assign gate, but caught before any chunk bytes move."""
+        if not tenant:
+            return
+        row = self._tenant_rows().get(tenant)
+        if not row:
+            return
+        over = row.get("over_quota") or []
+        if over and row.get("enforcement") == "hard":
+            raise rpc.RpcError(
+                403, f"QuotaExceeded: tenant {tenant!r} over quota "
+                f"({','.join(over)}); delete data (and let vacuum "
+                "reclaim) to resume writes")
+
+    def _debug_tenants(self, query: dict, body: bytes) -> dict:
+        """GET /debug/tenants — same shape as the volume server's:
+        stored/rates at top level, plus the filer-only surfaces (the
+        master-rollup quota cache and per-tenant chunk-cache bytes)."""
+        from ..storage.chunk_cache import CACHE
+        out = self.usage.snapshot()
+        out["node"] = self.url()
+        out["admission"] = self.server.admission.snapshot()
+        out["quota_cache"] = self._quota_cache
+        out["cache_tenants"] = CACHE.stats().get("tenants", {})
+        out["rules"] = self.tenant_policy.to_dict() \
+            if self.tenant_policy else []
+        return out
 
     # -- write ---------------------------------------------------------------
 
@@ -437,6 +512,8 @@ class FilerServer:
             return {"path": path, "is_directory": True}
         if path == "/":
             raise rpc.RpcError(400, "cannot upload to the root directory")
+        tenant = query.get("_tenant", "")
+        self._check_quota(tenant)
         collection = query.get("collection", self.collection)
         ttl = query.get("ttl", "")
         head = b""
@@ -476,6 +553,8 @@ class FilerServer:
                         # Metadata-only rollback: the pack needle is
                         # shared with sibling files — never delete it.
                         raise rpc.RpcError(409, str(err)) from None
+                    self.usage.note_request(tenant,
+                                            written_bytes=pc.size)
                     return {"name": entry.name, "size": pc.size,
                             "eTag": chunks_etag([pc])}
         writer = ChunkedWriter(
@@ -522,6 +601,8 @@ class FilerServer:
                 [c.file_id for c in raw_chunks] +
                 [c.file_id for c in chunks if c.is_chunk_manifest])
             raise rpc.RpcError(409, str(e)) from None
+        self.usage.note_request(tenant,
+                                written_bytes=total_size(chunks))
         return {"name": entry.name, "size": total_size(chunks),
                 "eTag": chunks_etag(chunks)}
 

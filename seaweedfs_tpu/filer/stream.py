@@ -75,7 +75,9 @@ class ChunkStreamer:
 
         gof = getattr(self.cache, "get_or_fetch", None)
         if gof is not None:  # singleflight path
-            return gof(file_id, pull)
+            from ..tenancy import context as _tenant_ctx
+            return gof(file_id, pull,
+                       tenant=_tenant_ctx.current_tenant())
         data = self.cache.get(file_id)
         if data is None:
             data = pull()

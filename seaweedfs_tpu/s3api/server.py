@@ -18,6 +18,7 @@ import hashlib
 import json
 import threading
 import time
+import urllib.error
 import urllib.parse
 import urllib.request
 import uuid
@@ -349,6 +350,15 @@ class S3ApiServer:
             identity = self.iam.authenticate(
                 method, path, raw_query, headers,
                 body if isinstance(body, (bytes, bytearray)) else None)
+            if identity is not None and identity.name:
+                # Tenancy principal = the authenticated S3 identity.
+                # set_principal makes every downstream filer/volume hop
+                # carry X-Weed-Tenant (rpc._request injects it), so
+                # quotas, fair admission, usage ledgers and /debug/hot
+                # all attribute to the S3 user, not the gateway.
+                from ..tenancy import context as _tenant_ctx
+                _tenant_ctx.set_principal(identity.name,
+                                          _tenant_ctx.current_client())
             if sha_hdr.startswith("STREAMING-"):
                 # aws-chunked framing: strip the chunk headers and
                 # signatures or the framed wire bytes would be stored
@@ -365,6 +375,39 @@ class S3ApiServer:
         except S3Error as e:
             return (e.status, _error_xml(e.code, e.message),
                     {"Content-Type": "application/xml"})
+        except rpc.RpcError as e:
+            # Tenancy verdicts from the filer/master surface in S3
+            # shape: hard quota -> 403 QuotaExceeded, throttle -> the
+            # AWS SlowDown error (503) with Retry-After preserved.
+            ans = self._tenancy_error(e.status, e.message,
+                                      e.retry_after)
+            if ans is None:
+                raise
+            return ans
+        except urllib.error.HTTPError as e:
+            # FilerProxy's streaming calls ride urllib, not the rpc
+            # pool — same tenancy mapping for their error shape.
+            msg = e.read().decode("utf-8", "replace")
+            ra = e.headers.get("Retry-After") if e.headers else None
+            ans = self._tenancy_error(
+                e.code, msg, float(ra) if ra else None)
+            if ans is None:
+                raise
+            return ans
+
+    @staticmethod
+    def _tenancy_error(status: int, message: str,
+                       retry_after: float | None):
+        if status == 403 and "QuotaExceeded" in message:
+            return (403, _error_xml("QuotaExceeded", message),
+                    {"Content-Type": "application/xml"})
+        if status == 429:
+            hdrs = {"Content-Type": "application/xml"}
+            if retry_after is not None:
+                hdrs["Retry-After"] = f"{retry_after:g}"
+            return (503, _error_xml(
+                "SlowDown", "Reduce your request rate."), hdrs)
+        return None
 
     def _dispatch(self, method: str, path: str, query: dict,
                   headers: dict, body,

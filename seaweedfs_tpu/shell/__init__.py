@@ -20,3 +20,4 @@ from . import command_cluster  # noqa: F401,E402
 from . import command_profile  # noqa: F401,E402
 from . import command_mirror  # noqa: F401,E402
 from . import command_lifecycle  # noqa: F401,E402
+from . import command_tenant  # noqa: F401,E402

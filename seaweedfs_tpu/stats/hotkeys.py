@@ -85,13 +85,16 @@ class SpaceSaving:
 
 
 # The dimensions the volume server tracks, and the two op classes.
-DIMENSIONS = ("volume", "needle", "client")
+# `client` is the ORIGINATING client (the filer forwards it on the
+# proxy leg via X-Weed-Client, so /debug/hot names the real caller,
+# not the filer's own IP); `tenant` is the resolved principal.
+DIMENSIONS = ("volume", "needle", "client", "tenant")
 OPS = ("read", "write")
 
 
 class HotKeyTracker:
-    """volume/needle/client x read/write space-saving sketches for one
-    volume server; `snapshot()` is the /debug/hot payload."""
+    """volume/needle/client/tenant x read/write space-saving sketches
+    for one volume server; `snapshot()` is the /debug/hot payload."""
 
     def __init__(self, capacity: int = 128):
         self.capacity = capacity
@@ -99,17 +102,22 @@ class HotKeyTracker:
         self._sketches = {(dim, op): SpaceSaving(capacity)
                           for dim in DIMENSIONS for op in OPS}
 
-    def _offer(self, op: str, vid: int, key: int, client: str) -> None:
+    def _offer(self, op: str, vid: int, key: int, client: str,
+               tenant: str = "") -> None:
         self._sketches[("volume", op)].offer(vid)
         self._sketches[("needle", op)].offer(f"{vid},{key:x}")
         if client:
             self._sketches[("client", op)].offer(client)
+        if tenant:
+            self._sketches[("tenant", op)].offer(tenant)
 
-    def read(self, vid: int, key: int, client: str = "") -> None:
-        self._offer("read", vid, key, client)
+    def read(self, vid: int, key: int, client: str = "",
+             tenant: str = "") -> None:
+        self._offer("read", vid, key, client, tenant)
 
-    def write(self, vid: int, key: int, client: str = "") -> None:
-        self._offer("write", vid, key, client)
+    def write(self, vid: int, key: int, client: str = "",
+              tenant: str = "") -> None:
+        self._offer("write", vid, key, client, tenant)
 
     def snapshot(self, k: int = 16) -> dict:
         out: dict = {"capacity": self.capacity, "started": self.started,

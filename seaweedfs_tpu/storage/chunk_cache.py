@@ -44,15 +44,32 @@ class FilerChunkCache:
         self.miss_bytes = 0
         self.evictions = 0
         self.fetch_latency = WindowedSketch(window=300.0)
+        # Per-tenant byte cap (tenancy plane, 0 = disabled): one
+        # tenant's working set may occupy at most this many cached
+        # bytes, so a scan-heavy tenant evicts ITS OWN oldest chunks
+        # instead of flushing everyone else's hot set.
+        self.tenant_max_bytes = 0
+        self._owners: dict[str, str] = {}       # file_id -> tenant
+        self._tenant_bytes: dict[str, int] = {}
+        self.tenant_evictions = 0
 
     def configure(self, max_bytes: int) -> None:
         with self._lock:
             self.max_bytes = max(0, int(max_bytes))
             self._evict_locked()
 
-    def get_or_fetch(self, file_id: str, fetch) -> bytes:
+    def configure_tenant_cap(self, max_bytes: int) -> None:
+        """-filer.cache.tenant.mb: uniform per-tenant occupancy cap."""
+        with self._lock:
+            self.tenant_max_bytes = max(0, int(max_bytes))
+            for t in list(self._tenant_bytes):
+                self._evict_tenant_locked(t, keep="")
+
+    def get_or_fetch(self, file_id: str, fetch,
+                     tenant: str = "") -> bytes:
         """Return the chunk bytes, fetching via `fetch()` at most once
-        across concurrent callers."""
+        across concurrent callers.  `tenant` attributes the cache
+        occupancy of a newly inserted chunk (first fetcher wins)."""
         while True:
             with self._lock:
                 data = self._chunks.get(file_id)
@@ -82,6 +99,11 @@ class FilerChunkCache:
             if file_id not in self._chunks:
                 self._chunks[file_id] = data
                 self._bytes += len(data)
+                if tenant:
+                    self._owners[file_id] = tenant
+                    self._tenant_bytes[tenant] = \
+                        self._tenant_bytes.get(tenant, 0) + len(data)
+                    self._evict_tenant_locked(tenant, keep=file_id)
             self._chunks.move_to_end(file_id)
             self.miss_bytes += len(data)
             self._evict_locked()
@@ -91,17 +113,47 @@ class FilerChunkCache:
         ev.set()
         return data
 
+    def _drop_owner_locked(self, file_id: str, nbytes: int) -> None:
+        t = self._owners.pop(file_id, "")
+        if t:
+            left = self._tenant_bytes.get(t, 0) - nbytes
+            if left > 0:
+                self._tenant_bytes[t] = left
+            else:
+                self._tenant_bytes.pop(t, None)
+
     def _evict_locked(self) -> None:
         while self._bytes > self.max_bytes and self._chunks:
-            _, old = self._chunks.popitem(last=False)
+            fid, old = self._chunks.popitem(last=False)
             self._bytes -= len(old)
+            self._drop_owner_locked(fid, len(old))
             self.evictions += 1
+
+    def _evict_tenant_locked(self, tenant: str, keep: str) -> None:
+        """Tenant-first eviction: while `tenant` is over its cap, drop
+        ITS oldest chunks (never `keep`, the one just inserted — a
+        single over-cap chunk still gets cached once)."""
+        if self.tenant_max_bytes <= 0:
+            return
+        while self._tenant_bytes.get(tenant, 0) > self.tenant_max_bytes:
+            victim = next(
+                (fid for fid in self._chunks
+                 if fid != keep and self._owners.get(fid) == tenant),
+                None)
+            if victim is None:
+                return
+            old = self._chunks.pop(victim)
+            self._bytes -= len(old)
+            self._drop_owner_locked(victim, len(old))
+            self.evictions += 1
+            self.tenant_evictions += 1
 
     def invalidate(self, file_id: str) -> None:
         with self._lock:
             old = self._chunks.pop(file_id, None)
             if old is not None:
                 self._bytes -= len(old)
+                self._drop_owner_locked(file_id, len(old))
 
     # -- introspection ---------------------------------------------------
 
@@ -115,6 +167,9 @@ class FilerChunkCache:
             used = self._bytes
             hit_b, miss_b = self.hit_bytes, self.miss_bytes
             evictions = self.evictions
+            tenant_rows = dict(self._tenant_bytes)
+            tenant_cap = self.tenant_max_bytes
+            tenant_evictions = self.tenant_evictions
 
         def _ms(q: float) -> float:
             v = self.fetch_latency.quantile(q)
@@ -128,6 +183,9 @@ class FilerChunkCache:
             "miss_bytes": miss_b,
             "evictions": evictions,
             "fetch_ms": {"p50": _ms(0.5), "p99": _ms(0.99)},
+            "tenant_max_bytes": tenant_cap,
+            "tenant_evictions": tenant_evictions,
+            "tenants": tenant_rows,
         }
 
     def reset(self) -> None:
@@ -140,6 +198,10 @@ class FilerChunkCache:
             self.miss_bytes = 0
             self.evictions = 0
             self.fetch_latency = WindowedSketch(window=300.0)
+            self.tenant_max_bytes = 0
+            self._owners.clear()
+            self._tenant_bytes.clear()
+            self.tenant_evictions = 0
 
 
 CACHE = FilerChunkCache()

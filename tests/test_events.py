@@ -725,6 +725,44 @@ def _drive_volume_expired(cl):
     assert vs.store.find_volume(vid) is None
 
 
+def _drive_quota_exceeded(cl):
+    """A hard stored-usage quota breach through the real assign path:
+    install a rule on the live master, seed its rollup as a heartbeat
+    would, and watch the assign reject 403."""
+    from seaweedfs_tpu.tenancy.quota import QuotaRule
+    master, _s, _st, _c, _t = cl
+    master.tenant_policy.rules.append(
+        QuotaRule(tenant="evquota", max_bytes=1))
+    master.usage_rollup.update_node(
+        "evnode:0", [{"tenant": "evquota", "collection": "evcol",
+                      "bytes": 4096, "objects": 1}])
+    master._last_quota_emit.pop("evquota", None)  # defeat the 5s dedup
+    try:
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.call(f"{master.url()}/dir/assign",
+                     headers={"X-Weed-Tenant": "evquota"})
+        assert ei.value.status == 403
+    finally:
+        master.tenant_policy.rules.pop()
+        master.usage_rollup.update_node("evnode:0", [])
+
+
+def _drive_tenant_throttled(cl):
+    """An over-rate tenant through the real admission throttle: a
+    fresh AdmissionControl with a 1 rps rule sheds within one burst."""
+    from seaweedfs_tpu.tenancy.quota import QuotaPolicy, QuotaRule
+    adm = rpc.AdmissionControl(
+        0, tenant_policy=QuotaPolicy(
+            [QuotaRule(tenant="evflood", max_rps=1.0)]))
+    adm._last_throttle_emit.pop("evflood", None)
+    retry = 0.0
+    for _ in range(50):
+        retry = adm.throttle("evflood")
+        if retry > 0.0:
+            break
+    assert retry > 0.0, "1 rps bucket never throttled a 50-call burst"
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -764,6 +802,8 @@ DRIVERS = {
     "lifecycle.tier": _drive_lifecycle_tier,
     "lifecycle.promote": _drive_lifecycle_promote,
     "volume.expired": _drive_volume_expired,
+    "quota.exceeded": _drive_quota_exceeded,
+    "tenant.throttled": _drive_tenant_throttled,
 }
 
 
@@ -777,8 +817,9 @@ def test_driver_catalog_matches_registry():
     # lifecycle types + 1 codec type: ec.repair.local + 1 SLO type:
     # slo.burn + 4 cross-cluster mirror types: replication.ship/ack/
     # lag/cutover + 3 data-lifecycle types: lifecycle.tier/promote +
-    # volume.expired).
-    assert len(TYPES) == 38
+    # volume.expired + 2 tenancy types: quota.exceeded +
+    # tenant.throttled).
+    assert len(TYPES) == 40
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))

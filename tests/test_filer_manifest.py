@@ -265,7 +265,9 @@ def test_server_manifest_roundtrip(stack):  # noqa: F811
     _req(filer_srv, "/big/manifest.bin", "DELETE").read()
     import seaweedfs_tpu.filer.filer as filer_mod  # noqa: F401
     with filer_srv.filer._del_lock:
-        pending = set(filer_srv.filer._pending_deletions)
+        # the queue holds (fid, deleting-tenant) pairs
+        pending = {fid for fid, _tenant in
+                   filer_srv.filer._pending_deletions}
     assert manifests[0]["file_id"] in pending
     assert len(pending) == 1201  # 1000 resolved + 200 raw + 1 manifest
     assert inner_fids <= pending

@@ -1,0 +1,480 @@
+"""Tenancy & QoS plane: quota grammar, usage ledger, DRR fairness,
+token buckets, per-tenant chunk-cache caps, noisy-neighbor chaos, and
+the hard-quota end-to-end (403 at master assign AND the filer/S3 front
+doors, usage surviving a master restart via the tenants.json snapshot,
+delete-driven reclaim restoring writability).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+import urllib.parse
+
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.stats.promcheck import validate_exposition
+from seaweedfs_tpu.storage.chunk_cache import FilerChunkCache
+from seaweedfs_tpu.tenancy import (DrrQueue, QuotaPolicy, TenantBuckets,
+                                   TenantUsage, TokenBucket, UsageRollup,
+                                   load_rules, parse_rules_text,
+                                   parse_rules_toml, parse_size)
+
+pytestmark = pytest.mark.tenancy
+
+
+# -- quota rule grammar ------------------------------------------------------
+
+def test_parse_size():
+    assert parse_size("1024") == 1024
+    assert parse_size("64MB") == 64 << 20
+    assert parse_size("1.5KB") == 1536
+    assert parse_size("2GiB") == 2 << 30
+    with pytest.raises(ValueError):
+        parse_size("twelve")
+
+
+def test_rules_text_grammar():
+    policy = parse_rules_text(
+        "# comment\n"
+        "alice max_bytes=1GB max_objects=100 weight=4\n"
+        "bob   max_rps=10 max_mbps=8 soft=true\n"
+        "*     max_bytes=10GB\n")
+    assert len(policy) == 3
+    r = policy.rule_for("alice")
+    assert r.max_bytes == 1 << 30 and r.max_objects == 100
+    assert policy.weight_for("alice") == 4.0
+    assert policy.rule_for("bob").soft is True
+    # wildcard catches everyone else; empty tenant never matches
+    assert policy.rule_for("mallory").max_bytes == 10 << 30
+    assert policy.rule_for("") is None
+
+
+def test_rules_text_errors():
+    with pytest.raises(ValueError, match="line 1"):
+        parse_rules_text("alice max_bytes=nope\n")
+    with pytest.raises(ValueError, match="unknown rule keys"):
+        parse_rules_text("alice max_bananas=3\n")
+    with pytest.raises(ValueError):
+        parse_rules_text("alice\n")  # a rule needs at least one limit
+
+
+def test_rules_toml(tmp_path):
+    p = tmp_path / "tenants.toml"
+    p.write_text('[[rule]]\ntenant = "alice"\nmax_bytes = "2MB"\n'
+                 'weight = 2.0\n'
+                 '[[rule]]\ntenant = "*"\nmax_rps = 5\n')
+    policy = load_rules(str(p))
+    assert policy.rule_for("alice").max_bytes == 2 << 20
+    assert policy.rule_for("zoe").max_rps == 5.0
+    assert parse_rules_toml(p.read_text()).weight_for("alice") == 2.0
+
+
+# -- token buckets -----------------------------------------------------------
+
+def test_token_bucket_admit_and_retry():
+    b = TokenBucket(rate=10.0, burst=2.0)
+    assert b.try_take() == 0.0
+    assert b.try_take() == 0.0
+    retry = b.try_take()  # bucket drained
+    assert retry > 0.0
+    time.sleep(retry + 0.02)
+    assert b.try_take() == 0.0  # refilled
+
+
+def test_tenant_buckets_scope():
+    policy = parse_rules_text("flood max_rps=2\n")
+    tb = TenantBuckets(policy)
+    # ruleless tenants and untenanted traffic pass free, always
+    for _ in range(50):
+        assert tb.admit("calm") == 0.0
+        assert tb.admit("") == 0.0
+    verdicts = [tb.admit("flood") for _ in range(20)]
+    assert any(v > 0.0 for v in verdicts)
+    assert "flood" in tb.snapshot()["rps_tenants"]
+
+
+# -- deficit round robin -----------------------------------------------------
+
+def test_drr_weight_proportionality():
+    weights = {"heavy": 3.0, "light": 1.0}
+    q = DrrQueue(weight_for=lambda t: weights.get(t, 1.0))
+    for _ in range(60):
+        q.push("heavy")
+        q.push("light")
+    served: list[str] = []
+    for _ in range(40):
+        served.append(q.pop().tenant)
+    heavy = served.count("heavy")
+    light = served.count("light")
+    # 3:1 weights -> ~30/10 of the first 40 serves; allow slack for
+    # deficit carry at the window edge.
+    assert heavy == pytest.approx(30, abs=3)
+    assert light == pytest.approx(10, abs=3)
+    assert heavy + light == 40
+
+
+def test_drr_skips_cancelled_and_drains():
+    q = DrrQueue()
+    a = q.push("a")
+    q.push("a")
+    b = q.push("b")
+    q.discard(a)
+    got = [q.pop(), q.pop()]
+    assert all(w is not None and not w.cancelled for w in got)
+    assert b in got
+    assert q.pop() is None
+    assert len(q) == 0
+
+
+# -- usage accounting --------------------------------------------------------
+
+def test_tenant_usage_ledger():
+    u = TenantUsage()
+    u.add("alice", "pics", 1000, 2, vid=7)
+    u.add("alice", "pics", 500, 1, vid=8)
+    u.add("bob", "", 100, 1, vid=7)
+    rows = {(r["tenant"], r["collection"]): r
+            for r in u.heartbeat_view()}
+    assert rows[("alice", "pics")]["bytes"] == 1500
+    assert rows[("alice", "pics")]["objects"] == 3
+    u.remove("alice", "pics", 500, 1, vid=8)
+    assert u.stored_totals()["alice"]["bytes"] == 1000
+    # dropping a volume sheds exactly that volume's contribution
+    u.drop_volume(7)
+    totals = u.stored_totals()
+    assert "bob" not in totals
+    assert totals.get("alice", {}).get("bytes", 0) == 0 or \
+        "alice" not in totals
+    # over-removal clamps at zero instead of going negative
+    u.add("carol", "", 10, 1, vid=9)
+    u.remove("carol", "", 9999, 99, vid=9)
+    assert "carol" not in u.stored_totals()
+
+
+def test_usage_rollup_snapshot_roundtrip(tmp_path):
+    path = str(tmp_path / "tenants.json")
+    r = UsageRollup(path)
+    r.update_node("vs1", [{"tenant": "alice", "collection": "",
+                           "bytes": 2048, "objects": 2}])
+    r.update_node("vs2", [{"tenant": "alice", "collection": "",
+                           "bytes": 1024, "objects": 1}])
+    assert r.usage_for("alice") == (3072, 3)
+    r.save(force=True)
+    # a fresh rollup (master restart) restores the totals from disk
+    r2 = UsageRollup(path)
+    assert r2.usage_for("alice") == (3072, 3)
+    assert r2.totals()["alice"]["objects"] == 3
+    # absolute node reports REPLACE: a shrunken re-report shrinks usage
+    r2.update_node("vs1", [{"tenant": "alice", "collection": "",
+                            "bytes": 100, "objects": 1}])
+    assert r2.usage_for("alice") == (1124, 2)
+
+
+# -- per-tenant chunk-cache caps ---------------------------------------------
+
+def test_chunk_cache_tenant_cap():
+    c = FilerChunkCache(max_bytes=1 << 20)
+    c.configure_tenant_cap(3000)
+    blob = b"x" * 1000
+    for i in range(5):
+        c.get_or_fetch(f"scan,{i}", lambda: blob, tenant="scanner")
+    # victim's chunks went in before the scanner blew its cap — they
+    # must survive (the scanner evicts its OWN oldest, not the LRU)
+    c2 = FilerChunkCache(max_bytes=1 << 20)
+    c2.configure_tenant_cap(3000)
+    c2.get_or_fetch("victim,1", lambda: blob, tenant="victim")
+    for i in range(5):
+        c2.get_or_fetch(f"scan,{i}", lambda: blob, tenant="scanner")
+    stats = c2.stats()
+    assert stats["tenants"]["scanner"] <= 3000
+    assert stats["tenants"]["victim"] == 1000
+    assert stats["tenant_evictions"] >= 2
+    hits = c2.hit_bytes
+    c2.get_or_fetch("victim,1", lambda: (_ for _ in ()).throw(
+        AssertionError("victim chunk was evicted")), tenant="victim")
+    assert c2.hit_bytes == hits + 1000
+    # reset() clears the tenant plane too (conftest hermeticity)
+    c2.reset()
+    assert c2.stats()["tenants"] == {}
+    assert c2.tenant_max_bytes == 0
+
+
+# -- live-cluster helpers ----------------------------------------------------
+
+def _http(url: str, method: str = "GET", body: bytes = b"",
+          headers: dict | None = None):
+    """Raw request so tests can inspect status + headers + body of
+    error answers (rpc.call raises on non-2xx)."""
+    u = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+    try:
+        conn.request(method, u.path + (f"?{u.query}" if u.query else ""),
+                     body=body or None, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _wait_until(fn, timeout=10.0, every=0.1, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(every)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture()
+def tenant_cluster(tmp_path):
+    rules = tmp_path / "tenants.txt"
+    rules.write_text("alice max_bytes=1KB\n"
+                     "flood max_rps=5 weight=1\n"
+                     "victim weight=4 max_bytes=1GB\n")
+    m = MasterServer(meta_dir=str(tmp_path / "m"),
+                     tenant_rules=str(rules))
+    m.start()
+    vs = VolumeServer(m.url(), [str(tmp_path / "vs")], pulse_seconds=1,
+                      tenant_rules=str(rules))
+    vs.start()
+    f = FilerServer(m.url(), store_path=str(tmp_path / "filer.db"),
+                    tenant_rules=str(rules))
+    f._quota_cache_ttl = 0.2  # keep the E2E fast
+    f.start()
+    try:
+        yield m, vs, f, rules
+    finally:
+        import contextlib
+        # the restart E2E stops the master itself; teardown tolerates
+        # an already-stopped role
+        for srv in (f, vs, m):
+            with contextlib.suppress(Exception):
+                srv.stop()
+
+
+# -- hard-quota end-to-end ---------------------------------------------------
+
+def test_hard_quota_e2e(tenant_cluster, tmp_path):
+    m, vs, f, _rules = tenant_cluster
+    hdr = {"X-Weed-Tenant": "alice"}
+    vurl = f"http://{vs.url()}"
+
+    # 1. fill past the 1KB quota (first write is under, so it lands)
+    out = rpc.call(f.url() + "/a.bin", "POST", b"x" * 2048, headers=hdr)
+    assert out["size"] == 2048
+    _wait_until(
+        lambda: rpc.call(m.url() + "/cluster/tenants")
+        ["tenants"].get("alice", {}).get("bytes", 0) >= 2048,
+        what="heartbeat usage rollup")
+
+    # 2a. master assign rejects with 403 QuotaExceeded
+    st, _h, body = _http(m.url() + "/dir/assign", headers=hdr)
+    assert st == 403 and b"QuotaExceeded" in body
+    # ...and emits the quota.exceeded event
+    evs = rpc.call(m.url() + "/debug/events?type=quota.exceeded")
+    assert any(e.get("attrs", {}).get("tenant") == "alice"
+               for e in evs["events"])
+
+    # 2b. the filer front door rejects before moving chunk bytes
+    time.sleep(0.3)  # let the filer's quota cache expire
+    st, _h, body = _http(f.url() + "/b.bin", "POST", b"y" * 10,
+                         headers=hdr)
+    assert st == 403 and b"QuotaExceeded" in body
+    # other tenants are untouched
+    assert rpc.call(f.url() + "/c.bin", "POST", b"z" * 10,
+                    headers={"X-Weed-Tenant": "bob"})["size"] == 10
+
+    # 3. delete reclaims; the next heartbeat drops usage and writes
+    #    resume (vacuum-independent: deletes decrement the live ledger)
+    rpc.call(f.url() + "/a.bin", "DELETE", headers=hdr)
+    _wait_until(
+        lambda: rpc.call(m.url() + "/cluster/tenants")
+        ["tenants"].get("alice", {}).get("bytes", 1) < 1024,
+        what="usage reclaim after delete")
+    st, _h, _b = _http(m.url() + "/dir/assign", headers=hdr)
+    assert st == 200
+    assert rpc.call(f.url() + "/d.bin", "POST", b"w" * 100,
+                    headers=hdr)["size"] == 100
+
+    # 4. the volume-side ledger and /debug/tenants agree
+    dt = rpc.call(vurl + "/debug/tenants")
+    stored = {r["tenant"]: r["bytes"] for r in dt["stored"]}
+    assert stored.get("alice", 0) == 100
+
+    # 5. usage survives a master restart via <mdir>/tenants.json: a
+    #    FRESH master on the same meta_dir — with no volume heartbeats
+    #    arriving — serves the snapshotted rollup immediately
+    _wait_until(
+        lambda: rpc.call(m.url() + "/cluster/tenants")
+        ["tenants"].get("alice", {}).get("bytes", 0) >= 100,
+        what="rollup of the resumed write")
+    m.stop()
+    assert (tmp_path / "m" / "tenants.json").exists()
+    m2 = MasterServer(meta_dir=str(tmp_path / "m"))
+    m2.start()
+    try:
+        doc = rpc.call(m2.url() + "/cluster/tenants")
+        assert doc["tenants"]["alice"]["bytes"] >= 100
+    finally:
+        m2.stop()
+
+
+# -- noisy-neighbor chaos ----------------------------------------------------
+
+def test_noisy_neighbor_throttle_and_victim_p99(tenant_cluster):
+    m, vs, f, _rules = tenant_cluster
+    vurl = f"http://{vs.url()}"
+    # seed one object the victim will read
+    fid = rpc.call(m.url() + "/dir/assign")
+    loc, fidstr = fid["url"], fid["fid"]
+    rpc.call(f"http://{loc}/{fidstr}", "POST", b"v" * 4096,
+             headers={"X-Weed-Tenant": "victim"})
+
+    before = rpc.tenant_throttled_total.value(tenant="flood")
+    # flood: 10x its 5 req/s quota for ~1s
+    flood_hdr = {"X-Weed-Tenant": "flood"}
+    shed = ok = 0
+    retry_after = None
+    t_end = time.monotonic() + 1.0
+    while time.monotonic() < t_end:
+        st, h, _b = _http(f"http://{loc}/{fidstr}", headers=flood_hdr)
+        if st == 429:
+            shed += 1
+            retry_after = h.get("Retry-After") or retry_after
+        else:
+            ok += 1
+        time.sleep(0.02)  # ~50 req/s offered
+    assert shed > 0, "flood was never throttled"
+    assert retry_after is not None and float(retry_after) > 0.0
+    # the flood's excess is counted, by tenant
+    assert rpc.tenant_throttled_total.value(tenant="flood") \
+        >= before + shed
+
+    # victim p99 holds while the flood continues
+    lat: list[float] = []
+    victim_hdr = {"X-Weed-Tenant": "victim"}
+    for _ in range(40):
+        _http(f"http://{loc}/{fidstr}", headers=flood_hdr)
+        t0 = time.perf_counter()
+        st, _h, body = _http(f"http://{loc}/{fidstr}",
+                             headers=victim_hdr)
+        lat.append(time.perf_counter() - t0)
+        assert st == 200 and len(body) == 4096
+    lat.sort()
+    p99 = lat[int(len(lat) * 0.99) - 1]
+    assert p99 < 0.5, f"victim p99 {p99 * 1000:.1f}ms under flood"
+
+    # the throttle episode is on the cluster timeline
+    evs = rpc.call(vurl + "/debug/events?type=tenant.throttled")
+    assert any(e.get("attrs", {}).get("tenant") == "flood"
+               for e in evs["events"])
+
+
+# -- attribution: the filer proxy leg names the real principal ---------------
+
+def test_hotkey_tenant_attribution_via_filer(tenant_cluster):
+    m, vs, f, _rules = tenant_cluster
+    hdr = {"X-Weed-Tenant": "victim"}
+    rpc.call(f.url() + "/hot.bin", "POST", b"h" * 512, headers=hdr)
+    for _ in range(3):
+        assert len(rpc.call(f.url() + "/hot.bin", headers=hdr)) == 512
+    hot = rpc.call(f"http://{vs.url()}/debug/hot")
+    reads = {r["key"] for r in
+             hot["dimensions"]["tenant"]["read"]["top"]}
+    writes = {r["key"] for r in
+              hot["dimensions"]["tenant"]["write"]["top"]}
+    # the proxy leg forwarded the ORIGINATING principal: the volume
+    # server attributes to the tenant, not to "the filer"
+    assert "victim" in reads and "victim" in writes
+    clients = {r["key"] for r in
+               hot["dimensions"]["client"]["read"]["top"]}
+    assert clients, "client dimension lost on the proxy leg"
+
+
+# -- S3 gateway error shape --------------------------------------------------
+
+def test_s3_quota_and_slowdown_xml(tenant_cluster):
+    m, vs, f, _rules = tenant_cluster
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+    s3 = S3ApiServer(f.url())
+    s3.start()
+    try:
+        _http(s3.url() + "/qbucket", "PUT")
+        # drive alice over quota through the gateway, then PUT again
+        st, _h, _b = _http(s3.url() + "/qbucket/big", "PUT", b"x" * 2048,
+                           headers={"X-Weed-Tenant": "alice"})
+        assert st == 200
+        _wait_until(
+            lambda: rpc.call(m.url() + "/cluster/tenants")
+            ["tenants"].get("alice", {}).get("bytes", 0) >= 2048,
+            what="rollup of the s3 upload")
+        time.sleep(0.3)  # filer quota cache TTL
+        st, h, body = _http(s3.url() + "/qbucket/more", "PUT", b"y",
+                            headers={"X-Weed-Tenant": "alice"})
+        assert st == 403
+        assert b"<Code>QuotaExceeded</Code>" in body
+        assert h.get("Content-Type") == "application/xml"
+        # rate-limit throttle surfaces as AWS SlowDown with Retry-After
+        got_slow = False
+        for _ in range(40):
+            st, h, body = _http(s3.url() + "/qbucket/f", "PUT", b"z",
+                                headers={"X-Weed-Tenant": "flood"})
+            if st == 503 and b"<Code>SlowDown</Code>" in body:
+                assert float(h.get("Retry-After", "0")) > 0.0
+                got_slow = True
+                break
+        assert got_slow, "flood was never told to SlowDown"
+    finally:
+        s3.stop()
+
+
+# -- shell verbs -------------------------------------------------------------
+
+def test_shell_tenant_verbs(tenant_cluster):
+    m, vs, f, _rules = tenant_cluster
+    import seaweedfs_tpu.shell  # noqa: F401 — registers verbs
+    from seaweedfs_tpu.shell.command_tenant import (ClusterTenants,
+                                                    TenantLs, TenantQuota)
+    from seaweedfs_tpu.shell.env import CommandEnv
+    rpc.call(f.url() + "/s.bin", "POST", b"s" * 700,
+             headers={"X-Weed-Tenant": "alice"})
+    _wait_until(
+        lambda: rpc.call(m.url() + "/cluster/tenants")
+        ["tenants"].get("alice", {}).get("bytes", 0) >= 700,
+        what="rollup for shell verbs")
+    env = CommandEnv(m.url(), filer_url=f.url())
+    out = ClusterTenants().do([], env)
+    assert "alice" in out and "RULE" in out
+    out = TenantLs().do([], env)
+    assert "alice" in out
+    out = TenantQuota().do(["alice"], env)
+    assert "alice" in out and "KB" in out
+
+
+# -- promcheck: the new instruments scrape clean on every role ---------------
+
+def test_promcheck_tenancy_instruments(tenant_cluster):
+    m, vs, f, _rules = tenant_cluster
+    rpc.call(f.url() + "/p.bin", "POST", b"p" * 300,
+             headers={"X-Weed-Tenant": "alice"})
+    _wait_until(
+        lambda: rpc.call(m.url() + "/cluster/tenants")
+        ["tenants"].get("alice", {}).get("bytes", 0) >= 300,
+        what="rollup before the scrape")
+    mtext = bytes(rpc.call(m.url() + "/metrics")).decode()
+    vtext = bytes(rpc.call(f"http://{vs.url()}/metrics")).decode()
+    ftext = f.metrics_registry.expose()
+    for text, who in ((mtext, "master"), (vtext, "volume"),
+                      (ftext, "filer")):
+        assert validate_exposition(text) == [], f"{who} scrape dirty"
+        assert "SeaweedFS_admission_queue_depth" in text, who
+        assert "SeaweedFS_tenant_throttled_total" in text, who
+    assert "SeaweedFS_master_tenant_bytes" in mtext
+    assert 'tenant="alice"' in mtext
+    assert "SeaweedFS_tenant_stored_bytes" in vtext
