@@ -11,7 +11,17 @@ survivor byte read.  RS(10,4) reads 10 shards; LRC(10,2,2) reads the
 lost shard's 5-member locality group — the read_savings field is the
 measured ratio.
 
-Environment knobs: BENCH_REPAIR_MB (volume size, default 256),
+Round 2 adds the ON-WIRE leg: the same lost-shard rebuild driven
+through a live in-process cluster (master + 3 volume servers, shards
+spread 5/5/4, `ec.rebuild -batch`), with ACTUAL network bytes read
+from the wire-flow ledger's ec.gather/ec.scatter purposes
+(stats/flows.py) beside the planner's PREDICTED reads — the
+measurement gate ROADMAP item 1 (regenerating codes) needs: a codec
+whose predicted savings don't survive contact with the wire (sidecar
+overhead, retry amplification) is not a savings.
+
+Environment knobs: BENCH_REPAIR_MB (local volume size, default 256),
+BENCH_REPAIR_WIRE_MB (wire-leg volume size, default 16),
 SEAWEEDFS_TPU_CODER (backend; default auto — pallas on TPU).
 
 All diagnostics go to stderr; stdout carries exactly one JSON line.
@@ -29,6 +39,7 @@ import time
 import numpy as np
 
 VOLUME_MB = int(os.environ.get("BENCH_REPAIR_MB", "256"))
+WIRE_MB = int(os.environ.get("BENCH_REPAIR_WIRE_MB", "16"))
 LOST_SHARD = 3  # a data shard inside LRC local group A
 
 
@@ -86,6 +97,123 @@ def bench_codec(name: str, tmp: str, payload: np.ndarray) -> dict:
     return out
 
 
+def bench_codec_wire(name: str) -> dict:
+    """Planner-predicted vs actual on-wire bytes for one lost-shard
+    rebuild through a live cluster, measured by the flow ledger."""
+    import tempfile as _tf
+
+    import numpy as _np
+
+    from seaweedfs_tpu.cluster import rpc
+    from seaweedfs_tpu.cluster.client import WeedClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.codecs import get_codec
+    from seaweedfs_tpu.shell import CommandEnv, run_command
+    from seaweedfs_tpu.stats import flows
+
+    codec = get_codec(name)
+    tmp = _tf.mkdtemp(prefix=f"bench_wire_{name}_")
+    master = MasterServer(volume_size_limit_mb=max(WIRE_MB * 4, 64),
+                          meta_dir=os.path.join(tmp, "meta"),
+                          pulse_seconds=60)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = os.path.join(tmp, f"vs{i}")
+        os.makedirs(d)
+        vs = VolumeServer(master.url(), [d], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    env = None
+    try:
+        client = WeedClient(master.url())
+        col = f"wire{name}"
+        rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}",
+                 "POST")
+        rng = _np.random.default_rng(1)
+        blob = rng.integers(0, 256, 1 << 20, dtype=_np.uint8).tobytes()
+        fid0 = client.upload_data(blob, collection=col)
+        vid = int(fid0.split(",")[0])
+        for _ in range(WIRE_MB - 1):
+            client.upload_data(blob, collection=col)
+        src = client.lookup(vid)[0]["url"]
+        rpc.call_json(f"http://{src}/admin/ec/generate", "POST",
+                      {"volume": vid, "codec": name})
+        spread = [(servers[0], [0, 1, 2, 3, 4]),
+                  (servers[1], [5, 6, 7, 8, 9]),
+                  (servers[2], list(range(10, codec.total_shards)))]
+        for vs, shards in spread:
+            if vs.url() != src:
+                rpc.call_json(
+                    f"http://{vs.url()}/admin/ec/copy_shard", "POST",
+                    {"volume": vid, "source": src, "shards": shards,
+                     "copy_ecx": True})
+        for vs, shards in spread:
+            rpc.call_json(f"http://{vs.url()}/admin/ec/mount", "POST",
+                          {"volume": vid})
+            drop = [s for s in range(codec.total_shards)
+                    if s not in shards]
+            rpc.call_json(
+                f"http://{vs.url()}/admin/ec/delete_shards", "POST",
+                {"volume": vid, "shards": drop})
+        rpc.call_json(f"http://{src}/admin/delete_volume", "POST",
+                      {"volume": vid})
+        for vs in servers:
+            vs._send_heartbeat(full=True)
+
+        env = CommandEnv(master.url())
+        locs = env.ec_shard_locations(vid)
+        survivor = next(s for s in locs if s != LOST_SHARD)
+        shard_bytes = len(bytes(rpc.call(
+            f"http://{locs[survivor][0]}/admin/ec/shard_file"
+            f"?volume={vid}&shard={survivor}")))
+        rpc.call_json(
+            f"http://{locs[LOST_SHARD][0]}/admin/ec/delete_shards",
+            "POST", {"volume": vid, "shards": [LOST_SHARD]})
+        for vs in servers:
+            vs._send_heartbeat(full=True)
+            vs._ec_loc_cache.clear()
+
+        plan = codec.repair_plan(
+            tuple(s for s in range(codec.total_shards)
+                  if s != LOST_SHARD), [LOST_SHARD])[0]
+        predicted = len(plan.reads) * shard_bytes
+
+        flows.LEDGER.reset()
+        run_command(env, "lock")
+        t0 = time.perf_counter()
+        out = run_command(env, "ec.rebuild -batch")
+        wall = time.perf_counter() - t0
+        assert f"volume {vid}: rebuilt shards" in out, out
+        time.sleep(0.3)  # settle: notes land after the last syscall
+        gather, _ops = flows.LEDGER.totals(purpose_="ec.gather",
+                                           direction="in")
+        scatter, _ = flows.LEDGER.totals(purpose_="ec.scatter",
+                                         direction="out")
+        log(f"{name} wire: predicted {predicted / 1e6:.1f} MB, "
+            f"gathered {gather / 1e6:.1f} MB on the wire "
+            f"(+{(gather - predicted) / 1e3:.0f} KB overhead), "
+            f"scattered {scatter / 1e6:.1f} MB in {wall:.2f}s")
+        return {
+            "codec": name,
+            "volume_mb": WIRE_MB,
+            "shard_bytes": shard_bytes,
+            "planned_reads": len(plan.reads),
+            "predicted_read_bytes": int(predicted),
+            "wire_gather_bytes": int(gather),
+            "wire_scatter_bytes": int(scatter),
+            "gather_overhead_bytes": int(gather - predicted),
+            "rebuild_seconds": round(wall, 4),
+        }
+    finally:
+        if env is not None:
+            env.close()
+        for vs in servers:
+            vs.stop()
+        master.stop()
+
+
 def main() -> int:
     out_path = None
     args = sys.argv[1:]
@@ -106,6 +234,16 @@ def main() -> int:
     results["read_savings"] = round(
         1.0 - results["lrc"]["repair_read_bytes"]
         / results["rs"]["repair_read_bytes"], 4)
+    # Round 2: the same comparison measured ON THE WIRE by the flow
+    # ledger — predicted planner reads vs actual ec.gather bytes.
+    results["wire"] = {name: bench_codec_wire(name)
+                       for name in ("rs", "lrc")}
+    results["wire"]["read_savings_predicted"] = round(
+        1.0 - results["wire"]["lrc"]["predicted_read_bytes"]
+        / results["wire"]["rs"]["predicted_read_bytes"], 4)
+    results["wire"]["read_savings_actual"] = round(
+        1.0 - results["wire"]["lrc"]["wire_gather_bytes"]
+        / results["wire"]["rs"]["wire_gather_bytes"], 4)
     line = json.dumps(results)
     print(line)
     if out_path:

@@ -11,6 +11,7 @@ import urllib.parse
 
 from ..core import types as t
 from ..netcore import splice as splice_mod
+from ..stats import flows as _flows
 from ..trace import current_traceparent
 from . import resilience, rpc
 
@@ -79,8 +80,19 @@ class ProxiedBody:
     def read(self, n: int = -1) -> bytes:
         return self._resp.read(n)
 
-    def _splice_to(self, dst) -> None:
+    def _splice_to(self, dst, note=None) -> None:
         resp, conn = self._resp, self._conn
+        # Wire-flow attribution: spliced bytes bypass resp.read(), so
+        # the client leg's "in" note (set by rpc._request) is fed here
+        # with the same syscall totals the downstream "out" note gets.
+        fin = resp.flow_note
+
+        def _both(n: int) -> None:
+            if note is not None:
+                note(n)
+            if fin is not None:
+                fin(n)
+
         left = resp._remaining
         # The buffered reader that parsed the response head almost
         # always pulled the first body bytes along with it; one read1
@@ -90,8 +102,10 @@ class ProxiedBody:
         if head:
             splice_mod._write_all(dst.fileno(), head)
             left -= len(head)
+            _both(len(head))
         if left:
-            splice_mod.copy_fd(conn.sock.fileno(), dst.fileno(), left)
+            splice_mod.copy_fd(conn.sock.fileno(), dst.fileno(), left,
+                               note=_both)
         resp._remaining = 0
         resp._done = True
 
@@ -543,7 +557,11 @@ class WeedClient:
         with self.cache._lock:
             start = self.cache._rr.get(vid, 0)
             self.cache._rr[vid] = start + 1
-        rng = {"Range": f"bytes={offset}-{offset + size - 1}"}
+        # The volume leg of a filer proxy read is `proxy` traffic, not
+        # a user read — the user-facing read is the filer's own
+        # response (stats/flows.py).
+        rng = {"Range": f"bytes={offset}-{offset + size - 1}",
+               **_flows.tag("proxy")}
         for i in range(len(locs)):
             loc = locs[(start + i) % len(locs)]
             try:

@@ -23,6 +23,7 @@ import time
 from ..core.replica_placement import ReplicaPlacement
 from ..core.ttl import TTL
 from ..storage.store import VolumeInfo
+from ..stats import flows as _flows
 from ..topology.topology import Topology, VolumeGrowOption
 from ..topology.volume_growth import VolumeGrowth
 from . import rpc
@@ -184,6 +185,7 @@ class MasterServer:
         s.route("POST", "/cluster/lifecycle/run",
                 self._cluster_lifecycle_run)
         s.route("GET", "/cluster/tenants", self._cluster_tenants)
+        s.route("GET", "/cluster/flows", self._cluster_flows)
         reg = s.enable_metrics("master")
         # SLO plane: declared objectives drive the burn engine behind
         # /cluster/healthz; /debug/slow + /debug/slo expose exemplars
@@ -567,6 +569,28 @@ class MasterServer:
                 # the durable snapshot (cadence-gated inside save()).
                 self.usage_rollup.update_node(dn.url(), hb["tenants"])
                 self.usage_rollup.save()
+            if "flows" in hb:
+                # Wire-flow ledger rows (absolute totals): keep the
+                # previous sample so /cluster/flows can derive rates
+                # from successive beats.  The snapshot was serialized
+                # BEFORE this heartbeat's bytes went on the wire, so
+                # the node's control-sent row lags our live recv
+                # counter by exactly the in-flight report; measure
+                # that gap now and let the conservation check grant
+                # it as slack on this node's control cell.
+                me = f"{self.server.host}:{self.server.port}"
+                rows = hb["flows"].get("rows", [])
+                claimed = sum(r["bytes"] for r in rows
+                              if r["peer"] == me
+                              and r["purpose"] == "control"
+                              and r["direction"] == "out")
+                live, _ops = _flows.LEDGER.totals(
+                    purpose_="control", direction="in", local=me,
+                    peer=dn.url())
+                dn.flows_prev = getattr(dn, "flows", None)
+                dn.flows = {"ts": time.time(), "rows": rows,
+                            "budgets": hb["flows"].get("budgets", {}),
+                            "gap": max(0, live - claimed)}
             seq = hb.get("seq")
             if seq is not None:
                 # The epoch changes when the volume server restarts, so
@@ -1233,6 +1257,29 @@ class MasterServer:
                         f"tenant {t}: hard quota exceeded — "
                         f"{'; '.join(reasons)} (writes rejected "
                         f"with 403 QuotaExceeded)")
+        # Wire-flow budgets: a sustained per-purpose bandwidth breach
+        # is a WARNING (like soft quotas) — background traffic running
+        # hot must not flip the cluster to 503 for a load balancer,
+        # but operators polling healthz should see it.
+        flows_warnings = []
+        flow_budget_rows = []
+        flow_sources = [(dn.url(),
+                         (getattr(dn, "flows", None) or {})
+                         .get("budgets", {}))
+                        for dn in leaves]
+        me_flow = f"{self.server.host}:{self.server.port}"
+        flow_sources.append(
+            (me_flow, _flows.LEDGER.budget_status(local=me_flow)))
+        for node, status in flow_sources:
+            for purpose_name, st in sorted(status.items()):
+                flow_budget_rows.append(dict(st, node=node,
+                                             purpose=purpose_name))
+                if st.get("breached"):
+                    flows_warnings.append(
+                        f"node {node}: {purpose_name} over bandwidth "
+                        f"budget — {st.get('rate_bps', 0):.0f} B/s "
+                        f"sustained against a "
+                        f"{st.get('limit_bps', 0):.0f} B/s limit")
         doc = {"healthy": not problems, "problems": problems,
                "leader": self.leader_url(), "is_leader": self.is_leader(),
                "nodes": nodes, "volumes": volumes,
@@ -1242,7 +1289,9 @@ class MasterServer:
                "lifecycle": self.lifecycle.status(),
                "tenancy": {"rules": len(self.tenant_policy.rules),
                            "warnings": tenancy_warnings,
-                           "tenants": tenancy_rows}}
+                           "tenants": tenancy_rows},
+               "flows": {"budgets": flow_budget_rows,
+                         "warnings": flows_warnings}}
         return not problems, doc
 
     def _cluster_mirror(self, query: dict, body: bytes) -> dict:
@@ -1306,6 +1355,134 @@ class MasterServer:
         return {"tenants": tenants,
                 "rules": self.tenant_policy.to_dict()["rules"],
                 "leader": self.url()}
+
+    # -- wire-flow traffic matrix (stats/flows.py) ---------------------------
+
+    def _flow_samples(self) -> dict:
+        """node -> (current flow sample, previous sample or None) for
+        every flow source: heartbeat-fed volume servers plus this
+        master's own live ledger (the master doesn't heartbeat to
+        itself — snapshot it here, keeping the last poll's snapshot
+        so back-to-back /cluster/flows calls still have a rate base)."""
+        samples: dict[str, tuple] = {}
+        with self.topo._lock:
+            leaves = list(self.topo.leaves())
+        for dn in leaves:
+            cur = getattr(dn, "flows", None)
+            if cur:
+                samples[dn.url()] = (cur,
+                                     getattr(dn, "flows_prev", None))
+        # Scheme-less "host:port", matching the ledger's local
+        # identity and the X-Weed-Node header the peers recorded.
+        me = f"{self.server.host}:{self.server.port}"
+        now = time.time()
+        cur = {"ts": now,
+               "rows": _flows.LEDGER.snapshot(local=me),
+               "budgets": _flows.LEDGER.budget_status(local=me)}
+        prev = getattr(self, "_flows_self_prev", None)
+        if prev is None or now - prev["ts"] >= 1.0:
+            self._flows_self_prev = cur
+        samples[me] = (cur, prev)
+        return samples
+
+    def _cluster_flows(self, query: dict, body: bytes) -> dict:
+        """GET /cluster/flows — the cluster traffic matrix: per
+        (src, dst, purpose) cell, cumulative GB both as sent by the
+        source and as received by the destination, a rate derived
+        from successive ledger samples, per-purpose totals, a
+        top-talker link ranking, the per-node budget rollup, and a
+        conservation verdict (sender's count must match the
+        receiver's within max(1%, 4KB); a reporting node's control
+        cell additionally gets the gap MEASURED at merge time — the
+        heartbeat POST carries a snapshot that can't include its own
+        bytes).  ?purpose= filters to one catalog entry."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/cluster/flows", query,
+                                         body, "GET")
+        want = query.get("purpose", "")
+        if want:
+            _flows.validate(want)
+        samples = self._flow_samples()
+        cells: dict[tuple, dict] = {}
+        for node, (cur, prev) in samples.items():
+            prows: dict[tuple, int] = {}
+            dt = 0.0
+            if prev:
+                dt = max(cur["ts"] - prev["ts"], 1e-9)
+                for r in prev.get("rows", []):
+                    prows[(r["peer"], r["purpose"],
+                           r["direction"])] = r["bytes"]
+            for r in cur.get("rows", []):
+                purpose = r["purpose"]
+                if want and purpose != want:
+                    continue
+                if r["direction"] == "out":
+                    key = (node, r["peer"], purpose)
+                    side = "sent"
+                else:
+                    key = (r["peer"], node, purpose)
+                    side = "recv"
+                c = cells.setdefault(key, {
+                    "src": key[0], "dst": key[1], "purpose": purpose,
+                    "sent_bytes": None, "recv_bytes": None,
+                    "sent_ops": 0, "recv_ops": 0, "rate_bps": 0.0})
+                c[side + "_bytes"] = (c[side + "_bytes"] or 0) \
+                    + r["bytes"]
+                c[side + "_ops"] += r["ops"]
+                if prev and r["direction"] == "out":
+                    delta = r["bytes"] - prows.get(
+                        (r["peer"], purpose, "out"), 0)
+                    if delta > 0:
+                        c["rate_bps"] += delta / dt
+        me = f"{self.server.host}:{self.server.port}"
+        gaps = {node: cur.get("gap", 0)
+                for node, (cur, _p) in samples.items()}
+        paired = 0
+        violations: list[dict] = []
+        purpose_totals: dict[str, int] = {}
+        links: dict[tuple, int] = {}
+        for c in cells.values():
+            sent, recv = c["sent_bytes"], c["recv_bytes"]
+            if sent is not None and recv is not None:
+                paired += 1
+                skew = abs(sent - recv)
+                slack = gaps.get(c["src"], 0) \
+                    if c["dst"] == me and c["purpose"] == "control" \
+                    else 0
+                if skew > max(0.01 * max(sent, recv), 4096 + slack):
+                    violations.append({
+                        "src": c["src"], "dst": c["dst"],
+                        "purpose": c["purpose"], "sent": sent,
+                        "recv": recv, "skew": skew})
+            vol = sent if sent is not None else (recv or 0)
+            c["gb"] = round(vol / float(1 << 30), 6)
+            c["rate_bps"] = round(c["rate_bps"], 1)
+            purpose_totals[c["purpose"]] = \
+                purpose_totals.get(c["purpose"], 0) + vol
+            links[(c["src"], c["dst"])] = \
+                links.get((c["src"], c["dst"]), 0) + vol
+        top = [{"src": s, "dst": d, "bytes": b,
+                "gb": round(b / float(1 << 30), 6)}
+               for (s, d), b in sorted(links.items(),
+                                       key=lambda kv: -kv[1])[:10]]
+        budgets = {node: cur.get("budgets", {})
+                   for node, (cur, _p) in samples.items()
+                   if cur.get("budgets")}
+        rows = sorted(cells.values(),
+                      key=lambda c: -(c["sent_bytes"]
+                                      if c["sent_bytes"] is not None
+                                      else (c["recv_bytes"] or 0)))
+        return {"ts": time.time(), "leader": self.url(),
+                "nodes": sorted(samples),
+                "purposes": {p: {"bytes": b,
+                                 "gb": round(b / float(1 << 30), 6)}
+                             for p, b in sorted(purpose_totals.items(),
+                                                key=lambda kv:
+                                                -kv[1])},
+                "cells": rows, "top_talkers": top, "budgets": budgets,
+                "conservation": {"paired_cells": paired,
+                                 "ok": not violations,
+                                 "violations": violations}}
 
     def _cluster_lifecycle(self, query: dict, body: bytes) -> dict:
         """GET /cluster/lifecycle — the daemon's rules, scan history,

@@ -39,6 +39,7 @@ from ..netcore.bufio import SockReader
 from ..netcore.registry import ConnRegistry, CountedConn, \
     conns_reaped_total
 from ..stats import contention as _contention
+from ..stats import flows as _flows
 from ..stats import phases as _phases
 from ..stats.metrics import Counter, Gauge, Histogram
 from ..tenancy import context as _tenant_ctx
@@ -619,6 +620,10 @@ class BodyReader:
         # Declared size; None for chunked bodies (handlers that want to
         # forward with a Content-Length check this).
         self.length = None if chunked else length
+        # Wire-flow attribution: set by _serve_one so consumed bytes
+        # (handler reads AND the post-dispatch drain) count as the
+        # request's "in" leg.
+        self.flow_note = None
 
     def read(self, n: int = -1) -> bytes:
         if self._chunk_iter is not None:
@@ -634,6 +639,8 @@ class BodyReader:
                     f" bytes missing")
             out += piece
         self._remaining -= len(out)
+        if out and self.flow_note is not None:
+            self.flow_note(len(out))
         return bytes(out)
 
     def _read_chunked(self, n: int) -> bytes:
@@ -647,6 +654,8 @@ class BodyReader:
         if exhausted:
             self._chunk_iter = None
             self._remaining = 0
+        if data and self.flow_note is not None:
+            self.flow_note(len(data))
         return data
 
     def drain(self) -> None:
@@ -728,6 +737,10 @@ class JsonHttpServer:
         self.prefix_routes: list[tuple[str, str, Callable]] = []
         self.metrics = None  # (Registry, Counter, Histogram) when on
         self.slo = None      # stats.slo.SloTracker once metrics are on
+        # Wire-flow attribution (stats/flows.py): the role this server
+        # answers X-Weed-Role with ("master"/"volume"/"filer"/...),
+        # set by enable_metrics from its subsystem name.
+        self.flow_role = ""
         # Service name for the tracing middleware; set by
         # trace.setup_server_tracing — None means no server spans.
         self.trace_service: str | None = None
@@ -743,6 +756,10 @@ class JsonHttpServer:
         # C10k observability on every role (literal routes win over a
         # filer's "/" prefix route, same precedence as /metrics).
         self.route("GET", "/debug/conns", self._debug_conns)
+        # Wire-flow attribution: this process's per-purpose byte
+        # ledger + budget verdicts (admission-exempt via /debug/).
+        self.route("GET", "/debug/flows", lambda q, b: _flows.debug_doc(
+            f"{self.host}:{self.port}", self.flow_role))
 
     def _debug_conns(self, query: dict, body) -> dict:
         """Per-connection state from the live registry: age, lane,
@@ -851,6 +868,15 @@ class JsonHttpServer:
                   ("role", "state"),
                   callback=lambda: self.conns.gauge_values(subsystem))
         reg.register_once(conns_reaped_total)
+        # Wire-flow attribution: every role exposes the per-purpose
+        # wire-byte counter (process-global singleton — both the
+        # client and server choke points observe into it) and
+        # self-identifies on request/response headers so peers'
+        # ledgers attribute links by node, not bare IP.
+        self.flow_role = _flows.role_of(subsystem)
+        _flows.set_process_identity(f"{self.host}:{self.port}",
+                                    self.flow_role)
+        reg.register_once(_flows.wire_bytes_total)
         # Lock-contention metering (stats/contention.py) and the
         # continuous profiler's runnable-threads gauge — process-global
         # singletons like the breaker/fault instruments above.
@@ -1117,6 +1143,22 @@ class JsonHttpServer:
                     fn, stream = pfn, pstream
                     prefix_args = req_path
                     break
+        # Wire-flow attribution (stats/flows.py): resolve the peer's
+        # identity (self-declared node/role headers, else bare IP +
+        # "client") and the transfer purpose (explicit header from our
+        # own client > ?type=replicate > path heuristic) ONCE, bind
+        # this thread's local identity so outbound hops made while
+        # handling attribute to this server, and park the per-request
+        # context for _respond's response-leg note.
+        flow_peer = headers.get("x-weed-node", "") or peer_ip or "?"
+        flow_peer_role = headers.get("x-weed-role", "") or "client"
+        flow_purpose = _flows.resolve(
+            method, req_path, headers.get("x-weed-purpose", ""),
+            query.get("type", ""),
+            headers.get("x-weed-priority", "") == "low")
+        _flows.bind_thread(f"{self.host}:{self.port}",
+                           self.flow_role or "server")
+        _flows.begin_request(flow_peer, flow_peer_role, flow_purpose)
         # Read (or wrap) the body only after routing so a streaming
         # route never sees it buffered.
         if stream:
@@ -1124,6 +1166,14 @@ class JsonHttpServer:
                               None if chunked
                               else int(headers.get("content-length") or 0),
                               chunked)
+            # Streamed request bodies count as the handler (and the
+            # post-dispatch drain) consumes them; the op lands now.
+            body.flow_note = \
+                lambda n: _flows.LEDGER.note(
+                    flow_purpose, "in", n, peer=flow_peer,
+                    peer_role=flow_peer_role, ops=0)
+            _flows.LEDGER.note(flow_purpose, "in", 0, peer=flow_peer,
+                               peer_role=flow_peer_role)
         elif chunked:
             body = _read_chunked(rf)
         else:
@@ -1131,6 +1181,10 @@ class JsonHttpServer:
             body = rf.read(clen) if clen else b""
             if clen and len(body) < clen:
                 return False  # truncated request
+        if not stream:
+            _flows.LEDGER.note(flow_purpose, "in", len(body),
+                               peer=flow_peer,
+                               peer_role=flow_peer_role)
         args = (prefix_args, query, body) if prefix_args is not None \
             else (query, body)
         if fn is None:
@@ -1225,6 +1279,7 @@ class JsonHttpServer:
             # Keep-alive threads serve many requests: a stale
             # principal must not leak into the next one.
             _tenant_ctx.clear_principal()
+            _flows.end_request()
 
     def _observe_request(self, method: str, req_path: str, status: int,
                          seconds: float, trace_id: str = "",
@@ -1382,6 +1437,23 @@ class JsonHttpServer:
         extra = dict(extra or {})
         reason = _REASONS.get(status, "Unknown")
         head = [f"HTTP/1.1 {status} {reason}"]
+        if self.flow_role:
+            # Self-identify so the client's flow ledger labels this
+            # link's peer_role — paired with X-Weed-Node on requests.
+            head.append(f"{_flows.ROLE_HEADER}: {self.flow_role}")
+
+        # Response leg of the flow ledger: body/payload bytes only
+        # (headers + chunked framing excluded on BOTH sides, so A->B
+        # sent matches B<-A received).  Early error responses that
+        # predate purpose resolution (bad request line, 414) have no
+        # request context and are skipped.
+        _req_flow = _flows.current_request()
+
+        def _note_out(n: int, ops: int = 0,
+                      _rq=_req_flow) -> None:
+            if _rq is not None:
+                _flows.LEDGER.note(_rq[2], "out", n, peer=_rq[0],
+                                   peer_role=_rq[1], ops=ops)
 
         if hasattr(payload, "read"):
             # Stream any file-like payload (open file, upstream HTTP
@@ -1410,6 +1482,7 @@ class JsonHttpServer:
             with payload:
                 conn.sendall(("\r\n".join(head) + "\r\n\r\n")
                              .encode("latin-1"))
+                _note_out(0, ops=1)
                 if method != "HEAD":
                     sf = getattr(payload, "sendfile_to", None)
                     if sf is not None and not chunked \
@@ -1418,7 +1491,10 @@ class JsonHttpServer:
                         # spliced proxy body) moves its bytes
                         # kernel-side with os.sendfile/os.splice; TLS
                         # and chunked responses take the read loop.
-                        sf(conn)
+                        # The flow note rides INTO the syscall loop —
+                        # these bytes never transit userspace, so the
+                        # ledger counts the syscall-returned totals.
+                        sf(conn, note=_note_out)
                         nt = getattr(conn, "note_tx", None)
                         if nt is not None:
                             nt(int(size))
@@ -1427,6 +1503,7 @@ class JsonHttpServer:
                             chunk = payload.read(1 << 20)
                             if not chunk:
                                 break
+                            _note_out(len(chunk))
                             if chunked:
                                 conn.sendall(b"%x\r\n" % len(chunk)
                                              + chunk + b"\r\n")
@@ -1453,6 +1530,7 @@ class JsonHttpServer:
         buf = ("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
         if method != "HEAD":
             buf += data
+        _note_out(len(data) if method != "HEAD" else 0, ops=1)
         conn.sendall(buf)
 
 
@@ -1529,13 +1607,18 @@ class _Resp:
 
     __slots__ = ("status", "reason", "headers", "_rf", "_remaining",
                  "_chunks", "_chunk_iter", "_chunk_buf", "will_close",
-                 "_done")
+                 "_done", "flow_note")
 
     def __init__(self, status, reason, headers, rf):
         self.status = status
         self.reason = reason
         self.headers = headers
         self._rf = rf
+        # Wire-flow attribution: set by _request so body bytes count
+        # as the call's "in" leg as the caller consumes them (the
+        # spliced proxy path feeds the same note with its syscall
+        # totals — see client.ProxiedBody._splice_to).
+        self.flow_note = None
         self.will_close = headers.get("connection", "").lower() == "close"
         self._chunks = headers.get("transfer-encoding",
                                    "").lower() == "chunked"
@@ -1559,15 +1642,22 @@ class _Resp:
         if self._done:
             return b""
         if self._chunks:
-            return self._read_chunked_n(n)
+            data = self._read_chunked_n(n)
+            if data and self.flow_note is not None:
+                self.flow_note(len(data))
+            return data
         if self._remaining < 0:  # until close
             data = self._rf.read() if n < 0 else self._rf.read(n)
             if not data or n < 0:
                 self._done = True
+            if data and self.flow_note is not None:
+                self.flow_note(len(data))
             return data
         want = self._remaining if n < 0 else min(n, self._remaining)
         data = self._rf.read(want) if want else b""
         self._remaining -= len(data)
+        if data and self.flow_note is not None:
+            self.flow_note(len(data))
         if self._remaining == 0:
             self._done = True
         elif len(data) < want:
@@ -1590,12 +1680,15 @@ class _Resp:
                 self._chunk_iter = _iter_chunks(self._rf)
             if self._chunk_buf:
                 out, self._chunk_buf = self._chunk_buf, b""
-                return out
-            try:
-                return next(self._chunk_iter)
-            except StopIteration:
-                self._done = True
-                return b""
+            else:
+                try:
+                    out = next(self._chunk_iter)
+                except StopIteration:
+                    self._done = True
+                    return b""
+            if out and self.flow_note is not None:
+                self.flow_note(len(out))
+            return out
         return self.read(65536)
 
     def _read_chunked_n(self, n: int) -> bytes:
@@ -1756,6 +1849,30 @@ def _request(url: str, method: str, body, timeout: float,
         else:
             host = netloc or "127.0.0.1"
             port = 443 if scheme == "https" else 80
+    # Wire-flow attribution: resolve this call's purpose — an explicit
+    # call-site header wins (validated loudly: our own call sites must
+    # not ship typos), else the thread's purpose context, else the
+    # path heuristic — and ALWAYS stamp it, so the server attributes
+    # the same purpose and conservation holds by construction.  The
+    # local identity (this process's server, when it has one) rides
+    # X-Weed-Node/X-Weed-Role so the master's matrix pairs the link.
+    flow_purpose = (req_headers or {}).get(_flows.PURPOSE_HEADER)
+    if flow_purpose is not None:
+        _flows.validate(flow_purpose)
+    else:
+        flow_purpose = _flows.current_purpose()
+    if flow_purpose is None:
+        flow_purpose = _flows.resolve(
+            method, path, "", "",
+            (req_headers or {}).get(PRIORITY_HEADER) == "low")
+    if req_headers is None or _flows.PURPOSE_HEADER not in req_headers:
+        req_headers = {**(req_headers or {}),
+                       _flows.PURPOSE_HEADER: flow_purpose}
+    flow_local = _flows.local_identity()[0]
+    if flow_local and _flows.NODE_HEADER not in req_headers:
+        req_headers = {**req_headers,
+                       _flows.NODE_HEADER: flow_local,
+                       _flows.ROLE_HEADER: _flows.local_identity()[1]}
     extra = ""
     for k, v in (req_headers or {}).items():
         extra += f"{k}: {v}\r\n"
@@ -1824,6 +1941,23 @@ def _request(url: str, method: str, body, timeout: float,
         else:
             breaker.record_success()
         resp = _Resp(status, reason, headers, conn.rf)
+        # Flow ledger, client side: the request body went out (one
+        # op), the response body counts in as the caller reads it.
+        # Error-status bodies count too — their bytes crossed the
+        # wire like any other.  Redirect legs each count separately.
+        flow_peer = f"{host}:{port}"
+        flow_prole = headers.get(_flows.ROLE_HEADER.lower(), "") \
+            or "server"
+        _flows.LEDGER.note(flow_purpose, "out",
+                           len(body) if body else 0, peer=flow_peer,
+                           peer_role=flow_prole, local=flow_local)
+        _flows.LEDGER.note(flow_purpose, "in", 0, peer=flow_peer,
+                           peer_role=flow_prole, local=flow_local)
+        resp.flow_note = \
+            lambda n, _p=flow_purpose, _peer=flow_peer, \
+            _pr=flow_prole, _l=flow_local: \
+            _flows.LEDGER.note(_p, "in", n, peer=_peer, peer_role=_pr,
+                               local=_l, ops=0)
         if status in (301, 302, 307, 308) and max_redirects > 0:
             location = resp.getheader("location")
             if location:

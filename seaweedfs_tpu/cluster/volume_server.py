@@ -34,6 +34,7 @@ from ..ec.volume import EcVolume, NeedleNotFound
 from ..events import emit as emit_event
 from ..fault import registry as _fault
 from ..codecs import get_codec
+from ..stats import flows as _flows
 from ..stats.metrics import (ec_repair_read_bytes_total,
                              needle_repairs_total, observe_ec_stage)
 from ..storage.scrub import ScrubDaemon
@@ -540,6 +541,15 @@ class VolumeServer:
                 # replaces this node's rows wholesale each beat, so a
                 # dropped beat or failover never double-counts.
                 "tenants": self.usage.heartbeat_view(),
+                # Wire-flow ledger rows for THIS server (absolute
+                # totals, idempotent like the tenant rollup): the
+                # master replaces this node's cells wholesale each
+                # beat and derives rates from successive samples.
+                "flows": {
+                    "rows": _flows.LEDGER.snapshot(local=self.url()),
+                    "budgets":
+                        _flows.LEDGER.budget_status(local=self.url()),
+                },
             }
             if self.shipper is not None:
                 # Per-volume replication lag (seq delta + seconds) +
@@ -591,6 +601,10 @@ class VolumeServer:
             self.master_url = self.masters[self._master_idx]
 
     def _heartbeat_loop(self) -> None:
+        # Flow identity for this daemon thread: several servers can
+        # share one process (tests), so the process-wide default is
+        # not enough — outbound beats must attribute to THIS node.
+        _flows.bind_thread(self.url(), "volume")
         ticks = 0
         while not self._stop.wait(self.pulse_seconds):
             ticks += 1
@@ -1112,7 +1126,8 @@ class VolumeServer:
                 head = rpc.call(
                     f"http://{url}/admin/ec/shard_read?volume={ev.vid}"
                     f"&shard=0&offset=0&size=64",
-                    headers=rpc.PRIORITY_LOW)
+                    headers={**rpc.PRIORITY_LOW,
+                             **_flows.tag("ec.gather")})
                 ev._version = SuperBlock.from_bytes(bytes(head)).version
                 return
             except Exception:  # noqa: BLE001
@@ -1302,6 +1317,10 @@ class VolumeServer:
         holder in turn.  Returns None when no source can serve it.
         `traceparent` carries the caller's trace context across the
         fan-out pool's thread boundary."""
+        # Fan-out pool threads carry no flow identity of their own:
+        # bind to this server so the gather's out-bytes attribute here
+        # (idempotent; handler threads rebind per request anyway).
+        _flows.bind_thread(self.url(), "volume")
         local = ev.shards.get(sid)
         if local is not None:
             buf = local.read_at(off, size)
@@ -1310,8 +1329,10 @@ class VolumeServer:
         me = self.url()
         # Shard gathers are internal traffic (low-priority lane at the
         # holder): a rebuild/degraded-read storm must not starve the
-        # holder's user reads.
-        hdrs = dict(rpc.PRIORITY_LOW)
+        # holder's user reads.  Flow-attributed as ec.gather — pool
+        # worker threads carry no purpose context, so the header rides
+        # explicitly.
+        hdrs = {**rpc.PRIORITY_LOW, **_flows.tag("ec.gather")}
         if traceparent:
             hdrs["traceparent"] = traceparent
         for url in locations.get(sid, []):
@@ -1365,6 +1386,8 @@ class VolumeServer:
         repair ticket.  Returns the healed Needle, or None when no
         replica could supply a sound copy."""
         vid = v.vid
+        # May run on the scrub daemon's thread: bind the flow identity.
+        _flows.bind_thread(self.url(), "volume")
         try:
             lookup = self._lookup_volume(vid)
         except Exception:  # noqa: BLE001 — master down: cannot locate
@@ -1377,7 +1400,8 @@ class VolumeServer:
             try:
                 blob = rpc.call(f"http://{url}/admin/needle_raw?"
                                 f"volume={vid}&key={key}",
-                                headers=rpc.PRIORITY_LOW)
+                                headers={**rpc.PRIORITY_LOW,
+                                         **_flows.tag("repair.fetch")})
                 n = Needle.from_bytes(bytes(blob), v.version)
             except Exception:  # noqa: BLE001 — next replica
                 continue
@@ -1841,11 +1865,15 @@ class VolumeServer:
             # Replication fan-out is internal traffic: the sibling's
             # admission control routes it through the low-priority
             # lane so a replication surge can't starve its user reads.
-            send_hdrs = dict(hdrs or {}, **rpc.PRIORITY_LOW)
+            send_hdrs = dict(hdrs or {}, **rpc.PRIORITY_LOW,
+                             **_flows.tag("replicate.fanout"))
             if tp:
                 send_hdrs["traceparent"] = tp
 
             def send(url):
+                # Fresh thread: no flow identity — bind so the
+                # fan-out bytes attribute to this server.
+                _flows.bind_thread(me, "volume")
                 try:
                     if _fault.ARMED:
                         _fault.hit("volume.replicate", replica=url,
@@ -1887,7 +1915,9 @@ class VolumeServer:
                     for url in ok_urls:
                         try:
                             rpc.call(f"http://{url}{path}?{qs}",
-                                     "DELETE")
+                                     "DELETE",
+                                     headers=_flows.tag(
+                                         "replicate.fanout"))
                         except Exception:  # noqa: BLE001
                             pass
                 raise rpc.RpcError(500, "replication failed: " +
@@ -2217,7 +2247,8 @@ class VolumeServer:
             rpc.call_to_file(f"http://{source}/admin/ec/shard_file?"
                              f"volume={vid}&shard={sid}",
                              base + to_ext(sid),
-                             headers=rpc.PRIORITY_LOW)
+                             headers={**rpc.PRIORITY_LOW,
+                                      **_flows.tag("ec.gather")})
         with ecc_lock(base):
             ecc = ShardChecksums.load(base)
             for sid in shard_ids:
@@ -2231,7 +2262,8 @@ class VolumeServer:
                 try:
                     rpc.call_to_file(
                         f"http://{source}/admin/ec/shard_file?"
-                        f"volume={vid}&ext={ext}", base + ext)
+                        f"volume={vid}&ext={ext}", base + ext,
+                        headers=_flows.tag("ec.gather"))
                 except rpc.RpcError:
                     try:
                         os.remove(base + ext)  # don't leave a 0-byte file
@@ -2315,7 +2347,8 @@ class VolumeServer:
                             # nothing behind.
                             rpc.call_to_file(
                                 f"http://{source}/admin/ec/shard_file?"
-                                f"volume={vid}&ext={ext}", base + ext)
+                                f"volume={vid}&ext={ext}", base + ext,
+                                headers=_flows.tag("ec.gather"))
                         except (rpc.RpcError, OSError):
                             pass
         return {"volume": vid, "shard": sid, "bytes": len(body)}
@@ -2552,10 +2585,13 @@ class VolumeServer:
         collection = req.get("collection", "")
         name = f"{collection}_{vid}" if collection else str(vid)
         base = os.path.join(loc.directory, name)
+        # A volume copy restores replication — wire-accounted as
+        # repair.fetch (healthy-copy bytes pulled to heal placement).
         for ext in (".idx", ".dat"):
             rpc.call_to_file(f"http://{source}/admin/volume_file?"
                              f"volume={vid}&ext={ext}", base + ext,
-                             headers=rpc.PRIORITY_LOW)
+                             headers={**rpc.PRIORITY_LOW,
+                                      **_flows.tag("repair.fetch")})
         v = self.store.mount_volume(vid)
         self._send_heartbeat()
         return {"volume": vid, "size": v.dat_size()}

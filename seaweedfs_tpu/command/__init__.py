@@ -238,6 +238,18 @@ def main(argv: list[str] | None = None) -> int:
             flags.get("events.file.keep")
     if "events" in flags and not flags.get_bool("events", True):
         os.environ["SEAWEEDFS_TPU_EVENTS"] = "0"
+    # Wire-flow budget knobs (stats/flows.py reads these lazily):
+    # -flows.budget declares per-purpose bandwidth ceilings
+    # ("repair.fetch=50MB/s,rlog.ship=10MB/s" — 1024-based units,
+    # "/s" optional); a sustained breach emits a flows.budget event
+    # and a /cluster/healthz warning.  -flows.sustain sets how many
+    # seconds over the ceiling count as sustained (default 2).
+    if flags.get("flows.budget"):
+        os.environ["SEAWEEDFS_TPU_FLOWS_BUDGET"] = \
+            flags.get("flows.budget")
+    if flags.get("flows.sustain"):
+        os.environ["SEAWEEDFS_TPU_FLOWS_SUSTAIN"] = \
+            flags.get("flows.sustain")
     # Every cluster-dialing command — servers AND clients (upload,
     # shell, mount, …) — goes through the TLS plane when security.toml
     # configures [grpc.client], matching the reference where each

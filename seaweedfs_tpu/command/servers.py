@@ -13,6 +13,12 @@ command/__init__.py, before the role starts):
   -events.file <path> persist the cluster event journal as JSONL
   -events.buffer <n>  event ring capacity; -events=false unmounts the
                       event endpoints
+  -flows.budget "purpose=RATE,..."
+                      per-purpose bandwidth ceilings for the wire-flow
+                      plane (e.g. "repair.fetch=50MB/s"); sustained
+                      breaches emit flows.budget events and healthz
+                      warnings.  -flows.sustain <s> tunes the breach
+                      window (default 2s)
   -debug.traces / -debug.faults / -faults "point=spec;..."
                       observability and fault-injection opt-ins
 """

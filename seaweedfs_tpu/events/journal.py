@@ -136,6 +136,11 @@ TYPES: dict[str, str] = {
                         "bucket ran dry and its excess is being shed "
                         "with 429 + Retry-After (one row per >=5s "
                         "episode, with the cumulative count)",
+    "flows.budget": "a purpose's wire rate breached its declared "
+                    "-flows.budget ceiling for the sustain window "
+                    "(stats/flows.py); /cluster/healthz warns until "
+                    "the rate drops back under the limit (one row "
+                    "per >=5s episode)",
 }
 
 SEVERITIES = ("info", "warn", "error")

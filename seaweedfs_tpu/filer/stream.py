@@ -68,10 +68,15 @@ class ChunkStreamer:
         enter the cache, so cache hits never re-pay the AES pass and
         the key check happens exactly once per fetch."""
         def pull() -> bytes:
-            return self.client.download(
-                file_id,
-                cipher_key=bytes.fromhex(cipher_key_hex)
-                if cipher_key_hex else b"")
+            # Flow attribution: a chunk pulled to serve a filer read
+            # is `proxy` traffic on the volume leg, whichever thread
+            # (handler or singleflight leader) executes the fetch.
+            from ..stats import flows as _flows
+            with _flows.purpose("proxy"):
+                return self.client.download(
+                    file_id,
+                    cipher_key=bytes.fromhex(cipher_key_hex)
+                    if cipher_key_hex else b"")
 
         gof = getattr(self.cache, "get_or_fetch", None)
         if gof is not None:  # singleflight path

@@ -60,10 +60,14 @@ def _drain_pipe(r: int, dst: int, n: int, timeout: float) -> None:
 
 
 def copy_fd(src: int, dst: int, count: int,
-            timeout: float = 30.0) -> None:
+            timeout: float = 30.0, note=None) -> None:
     """Relay exactly `count` bytes src→dst.  Raises ConnectionError on
     source EOF before count (a truncated upstream body must surface as
-    a failed transfer, mirroring _Resp.read's incomplete-read rule)."""
+    a failed transfer, mirroring _Resp.read's incomplete-read rule).
+
+    `note(n)` is invoked with each syscall-returned byte total — the
+    wire-flow ledger's only window into bytes that never transit
+    userspace (stats/flows.py)."""
     left = count
     if HAVE_SPLICE and left:
         pr, pw = os.pipe()
@@ -81,6 +85,8 @@ def copy_fd(src: int, dst: int, count: int,
                         f"splice: EOF with {left} of {count} bytes unread")
                 _drain_pipe(pr, dst, n, timeout)
                 left -= n
+                if note is not None:
+                    note(n)
         finally:
             os.close(pr)
             os.close(pw)
@@ -95,3 +101,5 @@ def copy_fd(src: int, dst: int, count: int,
                 f"copy: EOF with {left} of {count} bytes unread")
         _write_all(dst, buf, timeout)
         left -= len(buf)
+        if note is not None:
+            note(len(buf))

@@ -44,6 +44,7 @@ import numpy as np
 from ..cluster import rpc
 from ..events import emit as emit_event
 from ..fault import registry as _fault
+from ..stats import flows as _flows
 from ..stats.metrics import observe_batch_stage, stage_attrs
 from ..trace import root_span
 from ..codecs import get_codec
@@ -230,12 +231,13 @@ def _fetch_volume(tmpdir: str, vid: int, locs: list[str]) -> str:
         try:
             if _fault.ARMED:
                 _fault.hit("ec.fetch_shard", holder=url, vid=vid)
+            _h = {**rpc.PRIORITY_LOW, **_flows.tag("ec.gather")}
             rpc.call_to_file(
                 f"http://{url}/admin/volume_file?volume={vid}&ext=.idx",
-                base + ".idx", headers=rpc.PRIORITY_LOW)
+                base + ".idx", headers=_h)
             rpc.call_to_file(
                 f"http://{url}/admin/volume_file?volume={vid}&ext=.dat",
-                base + ".dat", headers=rpc.PRIORITY_LOW)
+                base + ".dat", headers=_h)
             return base
         except Exception as e:  # noqa: BLE001 — next replica
             errors.append(f"{url}: {type(e).__name__}: {e}")
@@ -444,12 +446,14 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
             with open(base + ".vif", "rb") as f:
                 vif = f.read()
             for url in plan:
+                _h = {**rpc.PRIORITY_LOW,
+                      **_flows.tag("ec.scatter")}
                 rpc.call(f"http://{url}/admin/ec/receive_file?"
                          f"volume={vid}&ext=.ecx", "POST", ecx, 600.0,
-                         headers=rpc.PRIORITY_LOW)
+                         headers=_h)
                 rpc.call(f"http://{url}/admin/ec/receive_file?"
                          f"volume={vid}&ext=.vif", "POST", vif, 600.0,
-                         headers=rpc.PRIORITY_LOW)
+                         headers=_h)
                 env.vs_call(url, "/admin/ec/mount", {"volume": vid})
             for url in locs:
                 env.vs_call(url, "/admin/delete_volume", {"volume": vid})
@@ -492,7 +496,8 @@ class _EccOncePush:
                     f"http://{url}/admin/ec/receive_ecc?"
                     f"volume={self._vid}", "POST",
                     json.dumps(doc).encode(), 60.0,
-                    headers=rpc.PRIORITY_LOW)
+                    headers={**rpc.PRIORITY_LOW,
+                             **_flows.tag("ec.scatter")})
             except (rpc.RpcError, OSError):
                 # Best effort: holder recomputes from the body.  OSError
                 # covers connection-level failures (ConnectError,
@@ -538,7 +543,8 @@ def _scatter_shard(url: str, vid: int, sid: int, path: str,
             _fault.hit("ec.scatter", target=url, vid=vid, shard=sid)
         rpc.call(f"http://{url}/admin/ec/receive_shard?"
                  f"volume={vid}&shard={sid}", "POST", payload, 600.0,
-                 headers=rpc.PRIORITY_LOW)
+                 headers={**rpc.PRIORITY_LOW,
+                          **_flows.tag("ec.scatter")})
         return size
     finally:
         budget.release(taken)

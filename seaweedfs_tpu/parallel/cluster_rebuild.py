@@ -31,6 +31,7 @@ from ..ec import SMALL_BLOCK_SIZE
 from ..ec.shard_bits import ShardBits
 from ..events import emit as emit_event
 from ..fault import registry as _fault
+from ..stats import flows as _flows
 from ..stats.metrics import (ec_repair_read_bytes_total,
                              observe_batch_stage, stage_attrs)
 from ..trace import root_span
@@ -193,7 +194,8 @@ def _fetch_shard(holders: list[str], vid: int, sid: int,
                     f"http://{url}/admin/ec/shard_file?volume={vid}"
                     f"&shard={sid}",
                     timeout=min(attempt_timeout, remaining),
-                    headers=rpc.PRIORITY_LOW)
+                    headers={**rpc.PRIORITY_LOW,
+                             **_flows.tag("ec.gather")})
                 if not isinstance(data, (bytes, bytearray)):
                     raise rpc.RpcError(
                         410, f"shard {vid}.{sid}: non-binary reply")
@@ -472,7 +474,9 @@ def _push_shard(vid: int, sid: int, payload: bytes, target: str,
             rpc.call(
                 f"http://{target}/admin/ec/receive_shard?volume={vid}"
                 f"&shard={sid}&ecx_source={src}",
-                "POST", payload, 600.0, headers=rpc.PRIORITY_LOW)
+                "POST", payload, 600.0,
+                headers={**rpc.PRIORITY_LOW,
+                         **_flows.tag("ec.scatter")})
             return
         except rpc.RpcError as e:
             # The target responded: the failure may be its ecx pull

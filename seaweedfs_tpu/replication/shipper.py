@@ -39,6 +39,7 @@ import time
 from ..cluster import resilience, rpc
 from ..events import emit as emit_event
 from ..fault import registry as _fault
+from ..stats import flows as _flows
 from ..stats.metrics import (replication_lag_seconds,
                              replication_lag_seconds_total,
                              replication_resends_total,
@@ -97,6 +98,10 @@ class ReplicationShipper:
         self._wake.set()
 
     def _loop(self) -> None:
+        # Flow identity for this daemon thread (several servers can
+        # share a test process; outbound batches must attribute to
+        # THIS volume server, not the process default).
+        _flows.bind_thread(self.node, "volume")
         while not self._stop.is_set():
             self._wake.wait(self.interval)
             self._wake.clear()
@@ -207,7 +212,9 @@ class ReplicationShipper:
                     _fault.hit("wan.partition", peer=target, vid=vid)
                 out = rpc.call(
                     f"http://{target}/admin/replication/apply", "POST",
-                    payload, timeout=timeout, headers=rpc.PRIORITY_LOW)
+                    payload, timeout=timeout,
+                    headers={**rpc.PRIORITY_LOW,
+                             **_flows.tag("rlog.ship")})
             except Exception as e:  # noqa: BLE001 — classified below
                 status = getattr(e, "status", None)
                 if status is None or status >= 500:
@@ -225,7 +232,8 @@ class ReplicationShipper:
                     rpc.call(f"http://{target}"
                              f"/admin/replication/apply", "POST",
                              payload, timeout=timeout,
-                             headers=rpc.PRIORITY_LOW)
+                             headers={**rpc.PRIORITY_LOW,
+                                      **_flows.tag("rlog.ship")})
             assert isinstance(out, dict)
             return out
 

@@ -268,6 +268,111 @@ class ClusterConns(Command):
 
 
 @register
+class ClusterFlows(Command):
+    name = "cluster.flows"
+    help = ("cluster.flows [-purpose P] [-watch] [-interval S] "
+            "[-count N] — the wire-flow traffic matrix from the "
+            "master's /cluster/flows: per-link per-purpose bytes "
+            "(user.read, replicate.fanout, ec.gather, ...), rates "
+            "from successive heartbeat samples, top-talker links, "
+            "bandwidth-budget status, and the conservation verdict "
+            "(every sender's count must match its receiver within "
+            "1%).  -watch repolls every -interval seconds (default "
+            "2) until interrupted (or -count polls)")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, _rest = self.parse_flags(args)
+        purpose = flags.get("purpose", "")
+        watch = flags.get("watch") == "true"
+        interval = float(flags.get("interval", "2"))
+        count = int(flags.get("count", "0"))
+        q = f"?purpose={purpose}" if purpose else ""
+        if not watch:
+            return self._render(self._fetch(env, q))
+        import time as _time
+        polls = 0
+        out = ""
+        try:
+            while True:
+                out = self._render(self._fetch(env, q))
+                polls += 1
+                if count and polls >= count:
+                    break
+                print(out)
+                print("---")
+                _time.sleep(interval)
+        except KeyboardInterrupt:
+            pass
+        return out
+
+    @staticmethod
+    def _fetch(env: CommandEnv, q: str) -> dict:
+        try:
+            doc = rpc.call(f"{env.master_url}/cluster/flows{q}",
+                           timeout=10.0)
+        except Exception as e:  # noqa: BLE001
+            raise ShellError(
+                f"cannot reach {env.master_url}/cluster/flows: "
+                f"{e}") from None
+        if not isinstance(doc, dict):
+            raise ShellError(f"unexpected /cluster/flows reply: "
+                             f"{doc!r}")
+        return doc
+
+    @staticmethod
+    def _render(doc: dict) -> str:
+        cons = doc.get("conservation", {})
+        lines = [f"nodes={len(doc.get('nodes', []))}  "
+                 f"cells={len(doc.get('cells', []))}  conservation="
+                 + ("OK" if cons.get("ok") else "VIOLATED")
+                 + f" ({cons.get('paired_cells', 0)} paired)"]
+        for v in cons.get("violations", []):
+            lines.append(f"  !! {v['src']} -> {v['dst']} "
+                         f"[{v['purpose']}]: sent={v['sent']} "
+                         f"recv={v['recv']} skew={v['skew']}")
+        purposes = doc.get("purposes", {})
+        if purposes:
+            lines.append("")
+            lines.append(f"{'PURPOSE':18}  {'GB':>12}")
+            for p, ent in purposes.items():
+                lines.append(f"{p:18}  {ent['gb']:12.6f}")
+        cells = doc.get("cells", [])
+        if cells:
+            lines.append("")
+            lines.append(f"{'SRC':21}  {'DST':21}  {'PURPOSE':18}  "
+                         f"{'SENT':>12}  {'RECV':>12}  {'B/S':>10}  "
+                         f"{'OPS':>6}")
+            for c in cells:
+                sent = c.get("sent_bytes")
+                recv = c.get("recv_bytes")
+                ops = max(c.get("sent_ops", 0), c.get("recv_ops", 0))
+                lines.append(
+                    f"{c['src']:21}  {c['dst']:21}  "
+                    f"{c['purpose']:18}  "
+                    f"{'-' if sent is None else sent:>12}  "
+                    f"{'-' if recv is None else recv:>12}  "
+                    f"{c.get('rate_bps', 0.0):10.0f}  {ops:6d}")
+        top = doc.get("top_talkers", [])
+        if top:
+            lines.append("")
+            lines.append("top talkers: " + ", ".join(
+                f"{t['src']}->{t['dst']} ({t['bytes']}B)"
+                for t in top[:5]))
+        breached = []
+        for node, status in sorted(doc.get("budgets", {}).items()):
+            for p, st in sorted(status.items()):
+                state = "BREACH" if st.get("breached") else "ok"
+                breached.append(
+                    f"  {node}  {p}: {st.get('rate_bps', 0):.0f} of "
+                    f"{st.get('limit_bps', 0):.0f} B/s [{state}]")
+        if breached:
+            lines.append("")
+            lines.append("budgets:")
+            lines.extend(breached)
+        return "\n".join(lines)
+
+
+@register
 class ClusterCheck(Command):
     name = "cluster.check"
     help = ("cluster.check — health rollup from the master's "

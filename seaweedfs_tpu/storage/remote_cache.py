@@ -90,6 +90,11 @@ class RemoteBlockCache:
             t0 = time.perf_counter()
             blk = backend.read_range(key, lo, n)
             self.fetch_latency.observe(time.perf_counter() - t0)
+            # Read-through block fetches are remote-tier wire traffic
+            # outside the rpc plane: feed the flow ledger directly.
+            from ..stats import flows as _flows
+            _flows.LEDGER.note("tier.down", "in", len(blk),
+                               peer=backend.spec, peer_role="remote")
         except BaseException:
             with self._lock:
                 self._inflight.pop(ck, None)

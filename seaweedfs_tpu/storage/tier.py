@@ -105,8 +105,13 @@ def move_dat_to_remote(volume: Volume, dest_spec: str,
     if not keep_local:
         os.remove(base + ".dat")
     from ..events import emit as emit_event
+    from ..stats import flows as _flows
     from ..stats import metrics as _metrics
     _metrics.tier_moved_bytes_total.inc(size, direction="upload")
+    # Tier transfers bypass the rpc plane (backend SDK / file copy):
+    # feed the wire-flow ledger directly, peer = the backend spec.
+    _flows.LEDGER.note("tier.up", "out", size, peer=dest_spec,
+                       peer_role="remote")
     emit_event("tier.move", vid=volume.vid, direction="upload",
                dest=dest_spec, bytes=size, keep_local=keep_local)
     return info
@@ -166,8 +171,11 @@ def move_dat_from_remote(volume: Volume, keep_remote: bool = False,
     if not keep_remote:
         backend.delete(fdesc["key"])
     from ..events import emit as emit_event
+    from ..stats import flows as _flows
     from ..stats import metrics as _metrics
     _metrics.tier_moved_bytes_total.inc(got, direction="download")
+    _flows.LEDGER.note("tier.down", "in", got,
+                       peer=fdesc["backend_spec"], peer_role="remote")
     emit_event("tier.move", vid=volume.vid, direction="download",
                source=fdesc["backend_spec"],
                bytes=fdesc.get("file_size", 0),

@@ -150,8 +150,11 @@ class NeedleSlice:
         self._pos += len(data)
         return data
 
-    def sendfile_to(self, sock) -> None:
-        """Zero-copy the remaining payload into a plaintext socket."""
+    def sendfile_to(self, sock, note=None) -> None:
+        """Zero-copy the remaining payload into a plaintext socket.
+        `note(n)` receives each syscall-returned byte total — these
+        bytes never transit userspace, so the wire-flow ledger
+        (stats/flows.py) counts them here or not at all."""
         sock_fd = sock.fileno()
         end = self.offset + self.size
         off = self.offset + self._pos
@@ -161,6 +164,8 @@ class NeedleSlice:
             if sent == 0:
                 raise ConnectionError("peer closed during sendfile")
             off += sent
+            if note is not None:
+                note(sent)
         self._pos = self.size
 
     def close(self) -> None:

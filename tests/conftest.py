@@ -41,9 +41,13 @@ def _hermetic_resilience_state():
     colliding fid, so it resets too."""
     from seaweedfs_tpu import fault
     from seaweedfs_tpu.cluster import resilience
+    from seaweedfs_tpu.stats import flows
     from seaweedfs_tpu.storage import chunk_cache
     resilience.reset_breakers()
     chunk_cache.CACHE.reset()
+    # The wire-flow ledger is process-global; rows from one test's
+    # cluster must not leak into the next test's conservation math.
+    flows.LEDGER.reset()
     yield
     fault.disarm_all()
     resilience.reset_breakers()

@@ -763,6 +763,20 @@ def _drive_tenant_throttled(cl):
     assert retry > 0.0, "1 rps bucket never throttled a 50-call burst"
 
 
+def _drive_flows_budget(cl):
+    """An over-budget purpose through the real ledger pacing path: a
+    1 B/s repair.fetch ceiling with a zero sustain window breaches on
+    the first megabyte noted."""
+    from seaweedfs_tpu.stats import flows as _fl
+    _fl.LEDGER.set_budgets({"repair.fetch": 1.0}, sustain=0.0)
+    try:
+        _fl.LEDGER.note("repair.fetch", "in", 1 << 20,
+                        peer="evpeer:0", peer_role="volume",
+                        local="evflows:0")
+    finally:
+        _fl.LEDGER.set_budgets({})
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -804,6 +818,7 @@ DRIVERS = {
     "volume.expired": _drive_volume_expired,
     "quota.exceeded": _drive_quota_exceeded,
     "tenant.throttled": _drive_tenant_throttled,
+    "flows.budget": _drive_flows_budget,
 }
 
 
@@ -818,8 +833,8 @@ def test_driver_catalog_matches_registry():
     # slo.burn + 4 cross-cluster mirror types: replication.ship/ack/
     # lag/cutover + 3 data-lifecycle types: lifecycle.tier/promote +
     # volume.expired + 2 tenancy types: quota.exceeded +
-    # tenant.throttled).
-    assert len(TYPES) == 40
+    # tenant.throttled + 1 wire-flow type: flows.budget).
+    assert len(TYPES) == 41
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))

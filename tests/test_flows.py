@@ -1,0 +1,496 @@
+"""Wire-flow attribution plane (stats/flows.py + the rpc choke point).
+
+Covers ISSUE 16's acceptance gates: the purpose catalog is closed and
+anti-rot tested, bytes counted by a sender match the receiver within
+1% on every paired (link, purpose) cell of a live multi-node cluster
+(including the zero-copy sendfile and splice legs), an EC rebuild's
+traffic lands under ec.gather/ec.scatter — never user.* — a budget
+breach produces the flows.budget event plus a healthz WARNING (never a
+503), the legacy per-subsystem byte counters cross-check against the
+ledger, and SeaweedFS_wire_bytes_total scrapes promcheck-clean on all
+three roles."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu import fault
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.events.journal import JOURNAL
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.stats import flows
+from seaweedfs_tpu.stats.metrics import ec_repair_read_bytes_total
+from seaweedfs_tpu.stats.promcheck import validate_exposition
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+pytestmark = pytest.mark.flows
+
+
+def _wait(cond, timeout=20.0, msg="condition never held"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError(msg)
+
+
+# -- catalog + ledger units --------------------------------------------------
+
+def test_purpose_catalog_anti_rot():
+    """The catalog is CLOSED: exactly the documented purposes exist,
+    each validates, tags, and notes cleanly; anything else raises
+    loudly at the call site (like the event catalog)."""
+    expected = {"user.read", "user.write", "replicate.fanout",
+                "ec.gather", "ec.scatter", "repair.fetch", "rlog.ship",
+                "tier.up", "tier.down", "proxy", "control"}
+    assert set(flows.PURPOSES) == expected
+    led = flows.FlowLedger()
+    for p in flows.PURPOSES:
+        assert flows.validate(p) == p
+        assert flows.tag(p) == {flows.PURPOSE_HEADER: p}
+        assert flows.PURPOSES[p], f"purpose {p} has no description"
+        led.note(p, "out", 10, peer="x:1", peer_role="volume",
+                 local="me:0")
+    assert led.totals()[0] == 10 * len(expected)
+    for bad in ("user.delete", "gossip", "", "USER.READ"):
+        with pytest.raises(ValueError):
+            flows.validate(bad)
+        with pytest.raises(ValueError):
+            flows.tag(bad)
+        with pytest.raises(ValueError):
+            led.note(bad, "out", 1, local="me:0")
+    with pytest.raises(ValueError):
+        led.note("user.read", "sideways", 1, local="me:0")
+
+
+def test_resolve_heuristics_and_header_priority():
+    """A valid explicit header always wins; without one, replication
+    POSTs, control-plane paths, and plain GET/PUT fall out of the
+    method+path heuristic — never an exception."""
+    r = flows.resolve
+    assert r("GET", "/3,01abc", flows.tag("ec.gather")[
+        flows.PURPOSE_HEADER]) == "ec.gather"
+    assert r("POST", "/3,01abc",
+             query_type="replicate") == "replicate.fanout"
+    assert r("GET", "/dir/assign") == "control"
+    assert r("POST", "/heartbeat") == "control"
+    assert r("GET", "/3,01abc", low_priority=True) == "control"
+    assert r("GET", "/3,01abc") == "user.read"
+    assert r("POST", "/3,01abc") == "user.write"
+    # A garbage header from a foreign client must degrade to the
+    # heuristic, not 500 the request.
+    assert r("GET", "/3,01abc", "not.a.purpose") == "user.read"
+
+
+def test_rate_and_budget_grammar():
+    assert flows.parse_rate("50MB/s") == 50 * 1024 * 1024
+    assert flows.parse_rate("1.5GB/s") == 1.5 * 1024 ** 3
+    assert flows.parse_rate("800KB/s") == 800 * 1024
+    b = flows.parse_budgets("repair.fetch=50MB/s,tier.up=1GB/s")
+    assert b == {"repair.fetch": 50 * 1024 * 1024,
+                 "tier.up": float(1024 ** 3)}
+    for bad in ("repair.fetch", "bogus.purpose=1MB/s",
+                "repair.fetch=fast"):
+        with pytest.raises(ValueError):
+            flows.parse_budgets(bad)
+
+
+def test_budget_breach_emits_event_and_status():
+    """Over-budget traffic flips budget_status to breached and lands
+    exactly one flows.budget event per dedup window (sustain=0 makes a
+    single oversized note an immediate breach — the events driver
+    path)."""
+    led = flows.FlowLedger()
+    led.set_budgets({"repair.fetch": 1024.0}, sustain=0.0)
+    seq0 = JOURNAL._seq
+    led.note("repair.fetch", "in", 1 << 20, peer="peer:1",
+             peer_role="volume", local="bdg:0")
+    st = led.budget_status(local="bdg:0")
+    assert st["repair.fetch"]["breached"] is True
+    assert st["repair.fetch"]["limit_bps"] == 1024.0
+    assert st["repair.fetch"]["rate_bps"] > 1024.0
+    evs = [e for e in JOURNAL.snapshot(type_="flows.budget")
+           if e["seq"] > seq0]
+    assert evs and evs[-1]["severity"] == "warn"
+    assert evs[-1]["attrs"]["purpose"] == "repair.fetch"
+    assert evs[-1]["attrs"]["rate_bps"] > evs[-1]["attrs"]["limit_bps"]
+    # Within budget: status clean, no fresh event.
+    led2 = flows.FlowLedger()
+    led2.set_budgets({"repair.fetch": float(1 << 30)}, sustain=0.0)
+    led2.note("repair.fetch", "in", 1024, peer="peer:1",
+              peer_role="volume", local="bdg2:0")
+    assert not led2.budget_status()["repair.fetch"]["breached"]
+
+
+# -- live cluster ------------------------------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path / "meta"),
+                          pulse_seconds=60)
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)],
+                          max_volume_counts=[50], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    # The in-process WeedClient's legs attribute to the thread-local
+    # identity; under a full pytest run the process DEFAULT identity
+    # belongs to whichever server started first in the process (an
+    # earlier test's, long dead and never heartbeating), so bind this
+    # thread to our master — its ledger self-merges into the matrix
+    # and the client legs pair deterministically.
+    flows.bind_thread(master.url().replace("http://", ""), "master")
+    yield master, servers
+    flows.clear_thread()
+    fault.disarm_all()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def _freshen(servers):
+    for vs in servers:
+        vs._send_heartbeat(full=True)
+        vs._ec_loc_cache.clear()
+
+
+def _matrix(master, servers, q=""):
+    """Heartbeat-merge the volume servers' ledgers and fetch the
+    traffic matrix.  A forced beat can race the last post-sendfile
+    ledger note by microseconds (the note runs after the syscall
+    returns, on the server thread), so settle first."""
+    time.sleep(0.3)
+    _freshen(servers)
+    time.sleep(0.1)
+    return rpc.call(f"{master.url()}/cluster/flows{q}")
+
+
+def test_conservation_live_multinode(cluster):
+    """THE acceptance gate: every paired (link, purpose) cell of the
+    live matrix conserves — sender's bytes == receiver's within 1% —
+    across a workload covering replicated writes, zero-copy sendfile
+    reads, and the request legs themselves."""
+    master, servers = cluster
+    client = WeedClient(master.url())
+    payload = b"conserve me " * 25_000          # ~300KB > SENDFILE_MIN
+    fid = client.upload(payload, replication="001")["fid"]
+    assert client.download(fid) == payload      # sendfile leg, holder A
+    assert client.download(fid) == payload      # sendfile leg, holder B
+
+    doc = _matrix(master, servers)
+    cons = doc["conservation"]
+    assert cons["ok"], cons["violations"]
+    assert cons["paired_cells"] >= 8, doc["cells"]
+    by = {(c["src"], c["dst"], c["purpose"]): c for c in doc["cells"]}
+    vs_urls = {vs.url() for vs in servers}
+
+    # The replicated write fanned the full payload to exactly one
+    # replica link, byte-conserved.
+    fan = [c for c in doc["cells"] if c["purpose"] == "replicate.fanout"
+           and (c["sent_bytes"] or 0) >= len(payload)]
+    assert len(fan) == 1, doc["cells"]
+    assert fan[0]["src"] in vs_urls and fan[0]["dst"] in vs_urls
+    assert fan[0]["sent_bytes"] == fan[0]["recv_bytes"]
+
+    # Both sendfile response legs show up as conserved user.read cells
+    # whose bytes are the served body, not zero (the zero-copy path
+    # must count syscall-returned totals).
+    reads = [c for c in doc["cells"] if c["purpose"] == "user.read"
+             and c["src"] in vs_urls
+             and (c["sent_bytes"] or 0) >= len(payload)]
+    assert {c["src"] for c in reads} == vs_urls, doc["cells"]
+    for c in reads:
+        assert c["sent_bytes"] == c["recv_bytes"] == len(payload)
+
+    # Matrix trimmings: totals, ranking, and GB fields are coherent.
+    assert doc["purposes"]["user.read"]["bytes"] >= 2 * len(payload)
+    assert doc["top_talkers"] and "gb" in doc["top_talkers"][0]
+    assert by, "matrix empty"
+
+
+def test_conservation_covers_splice_proxy_leg(cluster, tmp_path):
+    """Filer front door: a big single-chunk GET streams volume->client
+    through ProxiedBody (the splice leg).  The filer->volume pull is
+    attributed `proxy` and the volume server's side of that link
+    conserves once merged."""
+    import os
+    master, servers = cluster
+    filer = FilerServer(master.url(), chunk_size=1 << 20)
+    filer.start()
+    try:
+        big = os.urandom(400 * 1024)
+        rpc.call(filer.url() + "/flows.bin", "PUT", big)
+        assert rpc.call(filer.url() + "/flows.bin") == big
+        time.sleep(0.3)
+        # The filer doesn't heartbeat rows into the master matrix —
+        # its own ledger is the authority for its legs.
+        proxy_in, _ops = flows.LEDGER.totals(
+            purpose_="proxy", direction="in",
+            local=filer.url().replace("http://", ""))
+        assert proxy_in >= len(big), \
+            "filer's proxied pull not attributed to `proxy`"
+        doc = _matrix(master, servers)
+        assert doc["conservation"]["ok"], \
+            doc["conservation"]["violations"]
+        # The volume side of the proxied pull is tagged by header.
+        vs_proxy = [c for c in doc["cells"] if c["purpose"] == "proxy"
+                    and (c["sent_bytes"] or 0) >= len(big)]
+        assert vs_proxy, doc["cells"]
+    finally:
+        filer.stop()
+
+
+def test_debug_flows_surface_and_matrix_filter(cluster):
+    master, servers = cluster
+    client = WeedClient(master.url())
+    fid = client.upload(b"debug surface " * 2000)["fid"]
+    client.download(fid)
+    doc = rpc.call(f"http://{servers[0].url()}/debug/flows")
+    assert doc["role"] == "volume" and doc["node"] == servers[0].url()
+    assert set(doc["purposes"]) == set(flows.PURPOSES)
+    assert isinstance(doc["rows"], list)
+    # ?purpose= filters the matrix to one catalog entry; an unknown
+    # purpose is refused, not silently empty.
+    doc = _matrix(master, servers, "?purpose=user.write")
+    assert doc["cells"] and all(c["purpose"] == "user.write"
+                                for c in doc["cells"])
+    with pytest.raises(rpc.RpcError):
+        rpc.call(f"{master.url()}/cluster/flows?purpose=nonsense")
+
+
+def test_budget_breach_healthz_warning_not_problem(cluster):
+    """A sustained budget breach is a WARNING on /cluster/healthz —
+    visibility, not an outage: the endpoint stays 200/healthy."""
+    master, servers = cluster
+    flows.LEDGER.set_budgets({"user.write": 1024.0}, sustain=0.0)
+    try:
+        client = WeedClient(master.url())
+        client.upload(b"budget breaker " * 20_000)  # ~300KB >> 1KB/s
+        _freshen(servers)
+        status, doc = rpc.call_status(f"{master.url()}/cluster/healthz")
+        assert status == 200 and doc["healthy"], doc
+        warnings = doc["flows"]["warnings"]
+        assert any("user.write" in w for w in warnings), doc["flows"]
+        assert any(b["purpose"] == "user.write" and b["breached"]
+                   for b in doc["flows"]["budgets"]), doc["flows"]
+        # The breach also reaches the matrix's budget rollup.
+        mdoc = _matrix(master, servers)
+        assert any("user.write" in budgets
+                   for budgets in mdoc["budgets"].values()), \
+            mdoc["budgets"]
+    finally:
+        flows.LEDGER.set_budgets({})
+
+
+# -- EC rebuild + repair attribution -----------------------------------------
+
+def _make_ec_volume(master, servers):
+    """One EC volume spread 5/5/4 across three holders (the
+    test_batch_rebuild recipe, single volume)."""
+    client = WeedClient(master.url())
+    rpc.call_json(f"{master.url()}/vol/grow?count=1", "POST")
+    fids = [client.upload_data(f"flows-ec-{i}".encode() * (i % 7 + 1))
+            for i in range(8)]
+    vid = int(fids[0].split(",")[0])
+    spread = [(servers[0], [0, 1, 2, 3, 4]),
+              (servers[1], [5, 6, 7, 8, 9]),
+              (servers[2], [10, 11, 12, 13])]
+    src = client.lookup(vid)[0]["url"]
+    rpc.call_json(f"http://{src}/admin/ec/generate", "POST",
+                  {"volume": vid})
+    for vs, shards in spread:
+        if vs.url() != src:
+            rpc.call_json(f"http://{vs.url()}/admin/ec/copy_shard",
+                          "POST", {"volume": vid, "source": src,
+                                   "shards": shards,
+                                   "copy_ecx": True})
+    for vs, shards in spread:
+        rpc.call_json(f"http://{vs.url()}/admin/ec/mount", "POST",
+                      {"volume": vid})
+        drop = [s for s in range(14) if s not in shards]
+        rpc.call_json(f"http://{vs.url()}/admin/ec/delete_shards",
+                      "POST", {"volume": vid, "shards": drop})
+    rpc.call_json(f"http://{src}/admin/delete_volume", "POST",
+                  {"volume": vid})
+    _freshen(servers)
+    return client, vid, fids
+
+
+@pytest.fixture
+def ec_cluster(tmp_path):
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path),
+                          pulse_seconds=60)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path / f"vs{i}"
+        d.mkdir()
+        vs = VolumeServer(master.url(), [str(d)], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+    yield master, servers
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+def test_ec_rebuild_attributed_not_user_traffic(ec_cluster):
+    """Acceptance: /cluster/flows attributes a rebuild's bytes to
+    ec.gather (survivor fan-in) and ec.scatter (rebuilt fan-out), with
+    NO user.* traffic — and the legacy ec_repair_read_bytes_total
+    counter can never exceed the wire truth it is a view of."""
+    master, servers = ec_cluster
+    _client, vid, _fids = _make_ec_volume(master, servers)
+    env = CommandEnv(master.url())
+    holder = env.ec_shard_locations(vid)[1][0]
+    rpc.call_json(f"http://{holder}/admin/ec/delete_shards", "POST",
+                  {"volume": vid, "shards": [1]})
+    _freshen(servers)
+
+    flows.LEDGER.reset()
+    legacy0 = ec_repair_read_bytes_total.value(codec="rs")
+    run_command(env, "lock")
+    out = run_command(env, "ec.rebuild -batch")
+    assert f"volume {vid}: rebuilt shards" in out
+
+    gather_in, gops = flows.LEDGER.totals(purpose_="ec.gather",
+                                          direction="in")
+    scatter_out, sops = flows.LEDGER.totals(purpose_="ec.scatter",
+                                            direction="out")
+    assert gather_in > 0 and gops >= 10, "survivor fan-in unattributed"
+    assert scatter_out > 0 and sops >= 1, "rebuilt fan-out unattributed"
+    # Legacy counter == payload bytes only; the ledger's ec.gather
+    # additionally carries sidecars, so wire >= legacy always.
+    legacy_read = ec_repair_read_bytes_total.value(codec="rs") - legacy0
+    assert 0 < legacy_read <= gather_in
+
+    doc = _matrix(master, servers)
+    assert "ec.gather" in doc["purposes"], doc["purposes"]
+    assert "ec.scatter" in doc["purposes"], doc["purposes"]
+    assert "user.read" not in doc["purposes"], \
+        "rebuild traffic leaked into user.read"
+    assert "user.write" not in doc["purposes"], \
+        "rebuild traffic leaked into user.write"
+    assert doc["conservation"]["ok"], doc["conservation"]["violations"]
+    env.close()
+
+
+def test_degraded_read_attributes_repair_fetch(cluster):
+    """An inline needle heal (CRC-failing GET repaired from the
+    sibling replica) moves its bytes under repair.fetch."""
+    master, servers = cluster
+    col = "flowsheal"
+    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}"
+             f"&replication=001", "POST")
+    a = rpc.call(f"{master.url()}/dir/assign?collection={col}"
+                 f"&replication=001")
+    payload = b"rot target " * 64
+    fault.arm("volume.corrupt", "fail*1")
+    try:
+        rpc.call(f"http://{a['url']}/{a['fid']}", "POST", payload)
+    finally:
+        fault.disarm_all()
+    flows.LEDGER.reset()
+    assert bytes(rpc.call(f"http://{a['url']}/{a['fid']}")) == payload
+    fetched, ops = flows.LEDGER.totals(purpose_="repair.fetch",
+                                       direction="in")
+    assert fetched >= len(payload) and ops >= 1, \
+        "replica heal not attributed to repair.fetch"
+    doc = _matrix(master, servers)
+    assert "repair.fetch" in doc["purposes"], doc["purposes"]
+
+
+# -- rlog shipping cross-assert ----------------------------------------------
+
+def test_rlog_ship_cross_asserts_legacy_counter(tmp_path):
+    """replication_shipped_bytes_total counts blob payload bytes; the
+    ledger's rlog.ship leg counts the wire body (JSON envelope
+    included).  wire >= legacy > 0, same traffic, two views."""
+    from seaweedfs_tpu.stats.metrics import \
+        replication_shipped_bytes_total
+    sb_master = MasterServer(volume_size_limit_mb=16,
+                             meta_dir=str(tmp_path / "sbmeta"),
+                             pulse_seconds=60)
+    sb_master.start()
+    (tmp_path / "sb").mkdir()
+    sb_vs = VolumeServer(sb_master.url(), [str(tmp_path / "sb")],
+                         max_volume_counts=[200], pulse_seconds=60)
+    sb_vs.start()
+    pport = rpc.free_port()
+    pr_master = MasterServer(port=pport, volume_size_limit_mb=16,
+                             meta_dir=str(tmp_path / "prmeta"),
+                             pulse_seconds=60,
+                             peers=[f"http://127.0.0.1:{pport}"])
+    pr_master.start()
+    _wait(pr_master.is_leader, 15, "single-node raft never elected")
+    (tmp_path / "pr").mkdir()
+    pr_vs = VolumeServer(pr_master.url(), [str(tmp_path / "pr")],
+                         max_volume_counts=[200], pulse_seconds=60,
+                         replicate_peer=sb_master.url(),
+                         replicate_interval=0.05)
+    pr_vs.start()
+    try:
+        rpc.call(f"{pr_master.url()}/vol/grow?count=1&collection=fl",
+                 "POST")
+        a = rpc.call(f"{pr_master.url()}/dir/assign?collection=fl")
+        vid = int(a["fid"].split(",")[0])
+        v = pr_vs.store.find_volume(vid)
+        if v.rlog is None:
+            v.enable_rlog()
+        legacy0 = replication_shipped_bytes_total.value()
+        wire0, _ = flows.LEDGER.totals(purpose_="rlog.ship",
+                                       direction="out")
+        rpc.call(f"http://{a['url']}/{a['fid']}", "POST",
+                 b"ship these bytes " * 64)
+
+        def shipped():
+            st = (rpc.call(f"http://{pr_vs.url()}/debug/replication")
+                  .get("rlog") or {}).get(str(vid))
+            return bool(st) and st["pending"] == 0 and \
+                st["last_seq"] > 0
+        _wait(shipped, 20, "change log never shipped")
+        legacy = replication_shipped_bytes_total.value() - legacy0
+        wire, ops = flows.LEDGER.totals(purpose_="rlog.ship",
+                                        direction="out")
+        wire -= wire0
+        assert 0 < legacy <= wire, (legacy, wire)
+        assert ops >= 1
+    finally:
+        pr_vs.stop()
+        pr_master.stop()
+        sb_vs.stop()
+        sb_master.stop()
+
+
+# -- promcheck: wire_bytes_total scrapes clean on every role -----------------
+
+def test_promcheck_wire_bytes_all_roles(cluster):
+    master, servers = cluster
+    filer = FilerServer(master.url())
+    filer.start()
+    try:
+        rpc.call(filer.url() + "/prom.bin", "PUT", b"w" * 8192)
+        assert rpc.call(filer.url() + "/prom.bin") == b"w" * 8192
+        mtext = bytes(rpc.call(f"{master.url()}/metrics")).decode()
+        vtext = bytes(rpc.call(
+            f"http://{servers[0].url()}/metrics")).decode()
+        ftext = filer.metrics_registry.expose()
+        for text, who in ((mtext, "master"), (vtext, "volume"),
+                          (ftext, "filer")):
+            assert validate_exposition(text) == [], \
+                f"{who} scrape dirty"
+            assert "SeaweedFS_wire_bytes_total" in text, who
+        assert 'purpose="user.write"' in ftext
+        assert 'direction="out"' in ftext
+    finally:
+        filer.stop()

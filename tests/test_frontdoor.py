@@ -620,6 +620,26 @@ def test_large_read_proxies_and_matches(tmp_path):
         assert rpc.call(base + "/stream.bin") == big
         assert CACHE.stats()["used_bytes"] == used0, \
             "proxied big read must not populate the chunk cache"
+        # Flow-ledger byte identity on the splice leg: the filer's
+        # volume pull is attributed `proxy` and carries the whole
+        # chunk; its response leg to the client is `user.read` with
+        # exactly the served body — counted inside the splice/sendfile
+        # syscall loop, settled briefly to dodge the note-vs-read race.
+        from seaweedfs_tpu.stats import flows
+        filer_id = base.replace("http://", "")
+
+        def flow(purpose, direction):
+            return flows.LEDGER.totals(purpose_=purpose,
+                                       direction=direction,
+                                       local=filer_id)[0]
+        deadline = time.time() + 5.0
+        while flow("user.read", "out") != len(big) and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert flow("user.read", "out") == len(big), \
+            "spliced response leg != served body bytes"
+        assert flow("proxy", "in") >= len(big), \
+            "filer's volume pull not attributed to `proxy`"
         st, h, body = _raw_get(base + "/stream.bin",
                                {"Range": "bytes=65536-458751"})
         assert st == 206 and body == big[65536:458752]

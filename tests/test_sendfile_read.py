@@ -3,6 +3,7 @@
 after a CRC check — same check here, without a userspace payload copy)."""
 
 import os
+import time
 
 import pytest
 
@@ -11,6 +12,7 @@ from seaweedfs_tpu.cluster.client import WeedClient
 from seaweedfs_tpu.cluster.master import MasterServer
 from seaweedfs_tpu.cluster.volume_server import VolumeServer
 from seaweedfs_tpu.core.needle import Needle
+from seaweedfs_tpu.stats import flows
 from seaweedfs_tpu.storage.volume import NotFoundError, Volume, VolumeError
 
 
@@ -71,6 +73,22 @@ def test_large_get_end_to_end_sendfile(tmp_path):
         fid = client.upload_data(BIG)
         out = rpc.call(f"http://{vs.url()}/{fid}")
         assert bytes(out) == BIG
+        # Flow-ledger byte identity: the sendfile bytes never transit
+        # userspace, so the server's user.read response leg must carry
+        # the syscall-returned totals — exactly the served body.  (The
+        # note lands on the serving thread right after os.sendfile
+        # returns; settle briefly so the assert can't race it.)
+        def served():
+            return flows.LEDGER.totals(purpose_="user.read",
+                                       direction="out",
+                                       local=vs.url())[0]
+        deadline = time.time() + 5.0
+        while served() != len(BIG) and time.time() < deadline:
+            time.sleep(0.05)
+        assert served() == len(BIG), \
+            "sendfile response leg != served body bytes"
+        assert flows.LEDGER.totals(purpose_="user.read",
+                                   direction="in")[0] == len(BIG)
         # a compressible payload stored gzipped must still round-trip
         # (slice path declines compressed needles)
         text = (b"the quick brown fox " * 40_000)  # > SENDFILE_MIN
