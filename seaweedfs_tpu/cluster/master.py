@@ -19,6 +19,7 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 
 from ..core.replica_placement import ReplicaPlacement
 from ..core.ttl import TTL
@@ -81,7 +82,13 @@ class MasterServer:
                  lifecycle_rules: str = "",
                  lifecycle_interval: float = 60.0,
                  lifecycle_mbps: float = 32.0,
-                 tenant_rules: str = ""):
+                 tenant_rules: str = "",
+                 geo_cluster_id: str = "",
+                 geo_vid_stride: int = 1,
+                 geo_vid_offset: int = 0,
+                 steer_peer: str | None = None,
+                 steer_reads: bool = False,
+                 steer_refresh: float = 2.0):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
@@ -110,10 +117,15 @@ class MasterServer:
             os.makedirs(meta_dir, exist_ok=True)
         seq_path = f"{meta_dir}/seq.dat" if meta_dir else None
         from ..topology.sequence import MemorySequencer
+        # Active/active regions must mint volume ids from disjoint
+        # residue classes (-geo.vid.stride / -geo.vid.offset): a vid
+        # collision would make the regions' lease planes fence each
+        # other's unrelated volumes.
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             sequencer=MemorySequencer(seq_path),
-            pulse_seconds=pulse_seconds)
+            pulse_seconds=pulse_seconds,
+            vid_stride=geo_vid_stride, vid_offset=geo_vid_offset)
         self.vg = VolumeGrowth()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
@@ -225,6 +237,19 @@ class MasterServer:
                   callback=lambda: {
                       (t,): float(e["objects"]) for t, e in
                       self.usage_rollup.totals().items()})
+        # Geo locality steering (-replicate.steer): when this region's
+        # replica of a mirrored volume is lagging past the lag SLO (or
+        # a tenant's home= hint points at the peer region), /dir/lookup
+        # reorders its locations list so clients read from the peer
+        # cluster's replica first.  Lookup-time only — clients already
+        # re-lookup on 429/503, so no read path changes are needed.
+        self.geo_cluster_id = geo_cluster_id
+        self.steer_peer = steer_peer
+        self.steer_reads = steer_reads and bool(steer_peer)
+        self.steer_refresh = steer_refresh
+        self._steer_lock = threading.Lock()
+        self._steer_mirror: tuple[float, dict] = (0.0, {})
+        self._steer_locs: dict[int, tuple[float, list]] = {}
         self._grow_lock = threading.Lock()
         self._hb_apply_lock = threading.Lock()  # guards the lock table
         self._hb_node_locks: dict[str, threading.Lock] = {}
@@ -365,8 +390,9 @@ class MasterServer:
             # it could re-issue the previous leader's last volume id.
             self.raft.barrier()
             with self.topo._lock:
-                target = max(self.topo._max_volume_id,
-                             self.topo.max_volume_id) + 1
+                target = self.topo.stride_align(
+                    max(self.topo._max_volume_id,
+                        self.topo.max_volume_id) + 1)
             self.raft.propose({"op": "max_volume_id", "value": target})
             return target
 
@@ -563,6 +589,11 @@ class MasterServer:
                 # pairing config from the node's shipper — the health
                 # rollup's lag-SLO input and /cluster/mirror's rows.
                 dn.replication = hb["replication"]
+            if "leases" in hb:
+                # Geo write-lease rows (cluster_id/epoch per mirrored
+                # volume): cluster.lease.ls and the mirror rollup read
+                # these; steering keys off the mirror lag, not these.
+                dn.leases = hb["leases"]
             if "tenants" in hb:
                 # Absolute per-(tenant, collection) stored usage:
                 # replace this node's rollup rows and write through to
@@ -951,9 +982,14 @@ class MasterServer:
         collection = query.get("collection", "")
         locs = self.topo.lookup(collection, vid)
         if locs:
-            out = {"volumeId": vid, "locations": [
-                {"url": dn.url(), "publicUrl": dn.public_url}
-                for dn in locs]}
+            locations = [{"url": dn.url(), "publicUrl": dn.public_url}
+                         for dn in locs]
+            # steered=1 marks a peer master's own steering fetch: never
+            # steer it back (two masters steering each other would
+            # recurse until a timeout).
+            if self.steer_reads and query.get("steered") != "1":
+                locations = self._steer_locations(vid, query, locations)
+            out = {"volumeId": vid, "locations": locations}
             # Write token for delete/update of an existing fid
             # (operation/delete_content.go fetches a lookup jwt).
             if self.jwt_signing_key and query.get("fileId"):
@@ -969,6 +1005,90 @@ class MasterServer:
                            for dn in dns]
                 for sid, dns in ec.locations.items() if dns}}
         raise rpc.RpcError(404, f"volume {vid} not found")
+
+    # -- geo locality steering ----------------------------------------------
+
+    def _peer_mirror_rows(self) -> dict:
+        """Per-volume mirror rows from the PEER master's
+        /cluster/mirror, cached for `steer_refresh` seconds.  The
+        peer's shipper lag for a volume IS our local replica's
+        staleness (the peer ships volumes it holds to us), so this map
+        answers "is my local copy of vid within the lag SLO?"."""
+        with self._steer_lock:
+            ts, rows = self._steer_mirror
+            if time.time() - ts < self.steer_refresh:
+                return rows
+        try:
+            doc = rpc.call(f"http://{self.steer_peer}/cluster/mirror",
+                           timeout=2.0)
+            rows = {int(r["volume"]): r
+                    for r in doc.get("volumes", [])
+                    if "volume" in r}
+        except (rpc.RpcError, OSError, ConnectionError, ValueError,
+                TypeError):
+            rows = {}
+        with self._steer_lock:
+            self._steer_mirror = (time.time(), rows)
+        return rows
+
+    def _peer_locations(self, vid: int, collection: str) -> list:
+        """The peer cluster's replica locations for `vid`, from the
+        peer master's /dir/lookup, cached for `steer_refresh`
+        seconds.  Empty on any failure — steering degrades to
+        unsteered, it never breaks a lookup."""
+        with self._steer_lock:
+            hit = self._steer_locs.get(vid)
+            if hit is not None and \
+                    time.time() - hit[0] < self.steer_refresh:
+                return hit[1]
+        locs: list = []
+        try:
+            qs = urllib.parse.urlencode(
+                {"volumeId": vid, "collection": collection,
+                 "steered": 1})
+            doc = rpc.call(
+                f"http://{self.steer_peer}/dir/lookup?{qs}",
+                timeout=2.0)
+            locs = list(doc.get("locations", []))
+        except (rpc.RpcError, OSError, ConnectionError,
+                ValueError, TypeError):
+            locs = []
+        with self._steer_lock:
+            self._steer_locs[vid] = (time.time(), locs)
+        return locs
+
+    def _steer_locations(self, vid: int, query: dict,
+                         locations: list) -> list:
+        """Reorder a /dir/lookup answer for geo locality: prepend the
+        peer cluster's replicas when (a) the requesting tenant's
+        quota rule pins a home= region that isn't ours, or (b) our
+        local replica is mirrored FROM the peer and its lag exceeds
+        the lag SLO (reads here would see stale data).  Clients walk
+        the list in order and re-lookup on 429/503, so steering is
+        advisory and self-healing; any steering failure returns the
+        unsteered list."""
+        prefer_peer = False
+        tenant = query.get("tenant", "")
+        if tenant and self.geo_cluster_id:
+            rule = self.tenant_policy.rule_for(tenant)
+            if rule is not None and rule.home and \
+                    rule.home != self.geo_cluster_id:
+                prefer_peer = True
+        if not prefer_peer and self.replication_lag_slo is not None:
+            row = self._peer_mirror_rows().get(vid)
+            if row is not None and \
+                    float(row.get("lag_seconds", 0.0) or 0.0) > \
+                    self.replication_lag_slo:
+                prefer_peer = True
+        if not prefer_peer:
+            return locations
+        peer_locs = self._peer_locations(
+            vid, query.get("collection", ""))
+        if not peer_locs:
+            return locations
+        seen = {loc.get("url") for loc in peer_locs}
+        return peer_locs + [loc for loc in locations
+                            if loc.get("url") not in seen]
 
     def _status(self, query: dict, body: bytes) -> dict:
         if not self.is_leader() and self.raft.leader():
@@ -1280,11 +1400,29 @@ class MasterServer:
                         f"budget — {st.get('rate_bps', 0):.0f} B/s "
                         f"sustained against a "
                         f"{st.get('limit_bps', 0):.0f} B/s limit")
+        # Geo lease rollup (info-only: a moving or remote-held lease
+        # is a normal operating state, not a health problem — the
+        # fencing failure mode is 409s on the ship path, and those
+        # surface as replication lag here).
+        lease_doc = {"volumes": 0, "held_local": 0, "moving": 0}
+        for dn in leaves:
+            lhb = getattr(dn, "leases", None)
+            if not lhb:
+                continue
+            for lrow in (lhb.get("volumes") or {}).values():
+                lease_doc["volumes"] += 1
+                if lrow.get("holder_is_local"):
+                    lease_doc["held_local"] += 1
+                if lrow.get("moving"):
+                    lease_doc["moving"] += 1
         doc = {"healthy": not problems, "problems": problems,
                "leader": self.leader_url(), "is_leader": self.is_leader(),
                "nodes": nodes, "volumes": volumes,
                "ec_volumes": ec_volumes, "slo": slo_doc,
                "replication": {"lag_slo": self.replication_lag_slo,
+                               "cluster_id": self.geo_cluster_id
+                               or None,
+                               "leases": lease_doc,
                                "volumes": replication_rows},
                "lifecycle": self.lifecycle.status(),
                "tenancy": {"rules": len(self.tenant_policy.rules),
@@ -1306,9 +1444,15 @@ class MasterServer:
         rows = []
         peers = set()
         paused = []
+        leases: dict[str, dict] = {}
         with self.topo._lock:
             leaves = list(self.topo.leaves())
         for dn in leaves:
+            lhb = getattr(dn, "leases", None)
+            if lhb:
+                for vid, lrow in sorted(
+                        (lhb.get("volumes") or {}).items()):
+                    leases[vid] = dict(lrow, node=dn.url())
             repl = getattr(dn, "replication", None)
             if not repl:
                 continue
@@ -1326,6 +1470,8 @@ class MasterServer:
                 "lag_slo": self.replication_lag_slo,
                 "caught_up": bool(rows) and all(
                     not r.get("lag_seq") for r in rows),
+                "cluster_id": self.geo_cluster_id or None,
+                "leases": leases,
                 "volumes": rows}
 
     def _cluster_tenants(self, query: dict, body: bytes) -> dict:

@@ -82,7 +82,9 @@ class VolumeServer:
                  tier_promote_window: float = 60.0,
                  transport: str | None = None,
                  sendfile_min: int | None = None,
-                 tenant_rules: str = ""):
+                 tenant_rules: str = "",
+                 geo_cluster_id: str = "",
+                 replicate_compress: bool = False):
         # Seed master list; heartbeats follow leader hints and rotate
         # seeds on failure (volume_grpc_client_to_master.go:60-85).
         self.masters = list(master_url) if isinstance(master_url, list) \
@@ -188,13 +190,24 @@ class VolumeServer:
         # volume's durable change log and streams batches to the peer;
         # the receive side (the standby's _replication_apply) applies
         # idempotently against per-volume applied-seq watermarks.
+        # Geo active/active (-geo.cluster.id): names THIS cluster in
+        # the lease plane.  Per-volume `.lease` sidecars make exactly
+        # one cluster the write home; non-holders forward writes and
+        # the apply path fences stale epochs (replication/lease.py).
+        self.geo_cluster_id = geo_cluster_id
+        self.leases = None
+        if geo_cluster_id:
+            from ..replication.lease import LeaseTable
+            self.leases = LeaseTable(self.store, geo_cluster_id)
         self.shipper = None
         if replicate_peer:
             from ..replication.shipper import ReplicationShipper
             self.shipper = ReplicationShipper(
                 self.store, replicate_peer, node=self.url(),
                 collections=replicate_collections,
-                interval=replicate_interval)
+                interval=replicate_interval,
+                cluster_id=geo_cluster_id,
+                compress=replicate_compress, leases=self.leases)
         self._replication_applied: dict[int, object] = {}
         self._replication_apply_lock = threading.Lock()
         s = self.server
@@ -242,6 +255,9 @@ class VolumeServer:
         s.route("POST", "/admin/replication/resume",
                 self._replication_resume)
         s.route("GET", "/debug/replication", self._debug_replication)
+        s.route("GET", "/admin/lease/status", self._lease_status)
+        s.route("POST", "/admin/lease/acquire", self._lease_acquire)
+        s.route("POST", "/admin/lease/move", self._lease_move)
         s.route("POST", "/admin/tier_upload", self._tier_upload)
         s.route("POST", "/admin/tier_download", self._tier_download)
         s.route("GET", "/debug/tier", self._debug_tier)
@@ -556,6 +572,12 @@ class VolumeServer:
                 # pairing config: the master folds this into
                 # /cluster/healthz and its lag-SLO verdict.
                 hb["replication"] = self.shipper.lag_view()
+            if self.leases is not None:
+                # Geo lease rows (holder cluster + fencing epoch per
+                # mirrored volume): the master's /cluster/mirror
+                # rollup and healthz geo section.
+                hb["leases"] = {"cluster_id": self.geo_cluster_id,
+                                "volumes": self.leases.snapshot()}
             if full:
                 hb["volumes"] = [
                     vinfo_to_dict(v) for v in
@@ -1532,10 +1554,33 @@ class VolumeServer:
 
         Accepted while draining: like ?type=replicate traffic, an
         inbound mirror batch is the tail of writes the PRIMARY already
-        committed and acked."""
+        committed and acked.
+
+        Geo active/active adds three gates (all 4xx — the sender must
+        not treat them as a WAN failure): a zlib `codec` batch is
+        inflated first and its raw/wire sizes ride the ack; a batch
+        stamped `(cluster_id, epoch)` is fenced against the local
+        `.lease` (stale epochs are the old holder talking — 409); and
+        a batch whose first NEW seq leaves a gap above the applied
+        watermark is refused UNACKED (409), because acking it would
+        let reordered delivery skip the missing records forever."""
         import base64
+        import zlib
         req = json.loads(body)
         vid = int(req["volume"])
+        records = req.get("records", [])
+        raw_bytes = wire_bytes = 0
+        if req.get("codec") == "zlib":
+            comp = base64.b64decode(req.get("records_z") or "")
+            wire_bytes = len(comp)
+            try:
+                raw = zlib.decompress(comp)
+            except zlib.error as e:
+                raise rpc.RpcError(
+                    400, f"volume {vid}: bad zlib batch: {e}") \
+                    from None
+            raw_bytes = len(raw)
+            records = json.loads(raw)
         v = self.store.find_volume(vid)
         if v is None:
             # First batch for a volume the standby doesn't host yet:
@@ -1558,11 +1603,35 @@ class VolumeServer:
                 self._send_heartbeat(full=True)
             except Exception:  # noqa: BLE001 — master down: lookup
                 pass           # resolves after the next pulse
+        sender = str(req.get("cluster_id") or "")
+        if sender and self.leases is not None:
+            # Epoch fence: the geo safety invariant's receive half.
+            # A stale-epoch batch is a partitioned old holder still
+            # talking — refuse it so two clusters can never both
+            # commit a write for this volume.
+            reason = self.leases.check_batch(
+                vid, sender, int(req.get("epoch", 0)))
+            if reason is not None:
+                emit_event("lease.fence", node=self.url(),
+                           severity="warn", vid=vid, sender=sender,
+                           epoch=int(req.get("epoch", 0)),
+                           reason=reason)
+                raise rpc.RpcError(409, f"volume {vid}: {reason}")
         wm = self._replication_watermark(v)
         applied = skipped = 0
         last = wm.value
-        for rec in sorted(req.get("records", []),
-                          key=lambda r: r["seq"]):
+        recs_sorted = sorted(records, key=lambda r: r["seq"])
+        fresh = [r for r in recs_sorted if int(r["seq"]) > last]
+        if fresh and int(fresh[0]["seq"]) > last + 1:
+            # Gap above the watermark: batch n+1 arrived before batch
+            # n (wan.reorder, or a lost prefix).  Refuse WITHOUT
+            # acking — the sender's watermark holds and it re-ships
+            # in order.
+            raise rpc.RpcError(
+                409, f"volume {vid}: gap — first new seq "
+                     f"{fresh[0]['seq']} > applied {last} + 1 "
+                     f"(reordered batch refused unacked)")
+        for rec in recs_sorted:
             seq = int(rec["seq"])
             if seq <= last:
                 skipped += 1
@@ -1582,8 +1651,15 @@ class VolumeServer:
             last = seq
             applied += 1
         wm.set(last)
-        return {"acked_seq": last, "applied": applied,
-                "skipped": skipped}
+        out = {"acked_seq": last, "applied": applied,
+               "skipped": skipped}
+        if req.get("codec") == "zlib":
+            # Per-batch compression accounting rides the ack: the
+            # sender's shipped{raw,wire} totals and the geo bench's
+            # compressed-vs-raw WAN spend both come from here.
+            out["raw_bytes"] = raw_bytes
+            out["wire_bytes"] = wire_bytes
+        return out
 
     def _replication_pause(self, query: dict, body: bytes) -> dict:
         if self.shipper is None:
@@ -1617,7 +1693,145 @@ class VolumeServer:
         if applied:
             doc["role"].append("standby")
         doc["applied"] = applied
+        if self.leases is not None:
+            doc["cluster_id"] = self.geo_cluster_id
+            doc["leases"] = self.leases.snapshot()
         return doc
+
+    def _lease_status(self, query: dict, body: bytes) -> dict:
+        """GET /admin/lease/status[?volume=V] — this node's lease
+        table: per-volume `{cluster_id, epoch, acquired_ts,
+        holder_is_local, moving}` rows.  The peer's shipper reads this
+        on a 409 fence to adopt the authoritative epoch."""
+        if self.leases is None:
+            return {"node": self.url(), "cluster_id": None,
+                    "leases": {}}
+        rows = self.leases.snapshot()
+        if query.get("volume"):
+            want = str(int(query["volume"]))
+            rows = {k: v for k, v in rows.items() if k == want}
+        return {"node": self.url(),
+                "cluster_id": self.geo_cluster_id, "leases": rows}
+
+    def _lease_acquire(self, query: dict, body: bytes) -> dict:
+        """POST /admin/lease/acquire {volume, cluster_id?, epoch?} —
+        fence `cluster_id` (default: this cluster) as the volume's
+        holder.  Epoch defaults to one past what this node knows, so a
+        bare acquire always fences prior holders; an explicit epoch is
+        the transfer protocol's second half (the new holder adopting
+        the epoch the old holder demoted at).  Monotonic: a stale
+        epoch is a no-op returning the current lease."""
+        if self.leases is None:
+            raise rpc.RpcError(
+                400, "no -geo.cluster.id configured on this node")
+        req = json.loads(body) if body else {}
+        vid = int(req.get("volume", query.get("volume", 0)) or 0)
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise rpc.RpcError(404, f"volume {vid} not on this server")
+        v.enable_rlog()  # geo volumes always journal
+        cluster = str(req.get("cluster_id") or self.geo_cluster_id)
+        epoch = int(req["epoch"]) if "epoch" in req \
+            else self.leases.epoch(vid) + 1
+        lease = self.leases.fence(vid, cluster, epoch)
+        emit_event("lease.acquire", node=self.url(), vid=vid,
+                   cluster_id=lease.cluster_id, epoch=lease.epoch)
+        try:
+            self._send_heartbeat(full=True)
+        except Exception:  # noqa: BLE001 — master down: the rollup
+            pass           # catches up on the next pulse
+        out = lease.to_doc()
+        out["volume"] = vid
+        out["holder_is_local"] = \
+            lease.cluster_id == self.geo_cluster_id
+        return out
+
+    def _lease_move(self, query: dict, body: bytes) -> dict:
+        """POST /admin/lease/move {volume, to, timeout?} — transfer
+        the write lease to cluster `to`.  The order IS the safety
+        argument: (1) refuse new local writes (`begin_move`), (2)
+        drain — kick the shipper until the rlog has nothing pending,
+        (3) DEMOTE FIRST: fence ourselves out by writing `to` at
+        epoch+1 into our own sidecar, (4) best-effort tell the peer to
+        acquire at that exact epoch.  A partition between (3) and (4)
+        leaves NO holder — writes 503 everywhere until heal (the peer
+        also learns the new epoch from the next shipped batch) —
+        fail-closed, never split-brained.  A drain timeout aborts
+        BEFORE step 3: the lease did not move."""
+        if self.leases is None:
+            raise rpc.RpcError(
+                400, "no -geo.cluster.id configured on this node")
+        if self.shipper is None:
+            raise rpc.RpcError(
+                400, "no -replicate.peer configured (cannot drain or "
+                     "reach the target cluster)")
+        req = json.loads(body) if body else {}
+        vid = int(req.get("volume", 0) or 0)
+        to = str(req.get("to") or "")
+        if not to or to == self.geo_cluster_id:
+            raise rpc.RpcError(
+                400, f"bad target cluster {to!r} (want the peer's "
+                     f"-geo.cluster.id, not our own)")
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise rpc.RpcError(404, f"volume {vid} not on this server")
+        if not self.leases.is_holder(vid):
+            raise rpc.RpcError(
+                409, f"volume {vid}: lease held by "
+                     f"{self.leases.holder(vid)} at epoch "
+                     f"{self.leases.epoch(vid)} — not ours to move")
+        v.enable_rlog()
+        old_epoch = self.leases.epoch(vid)
+        timeout = float(req.get("timeout", 10.0) or 10.0)
+        deadline = time.monotonic() + timeout
+        self.leases.begin_move(vid)
+        try:
+            # Drain: every committed write must reach the new holder
+            # BEFORE it takes over, or the epoch fence would orphan
+            # the tail.  begin_move already refuses new writes, so
+            # pending() is strictly decreasing from here.
+            while v.rlog is not None and v.rlog.pending() > 0:
+                if time.monotonic() > deadline:
+                    raise rpc.RpcError(
+                        503, f"volume {vid}: drain timed out with "
+                             f"{v.rlog.pending()} records pending — "
+                             f"lease NOT moved",
+                        headers={"Retry-After": "1"})
+                self.shipper.kick()
+                time.sleep(0.02)
+        except rpc.RpcError:
+            self.leases.abort_move(vid)
+            raise
+        target = self.shipper._resolve_target(vid)
+        new_epoch = old_epoch + 1
+        # DEMOTE FIRST (fence() also clears the moving flag): from
+        # this instant we forward writes instead of committing them.
+        self.leases.fence(vid, to, new_epoch)
+        peer_acquired = False
+        if target is not None:
+            try:
+                rpc.call_json(
+                    f"http://{target}/admin/lease/acquire",
+                    payload={"volume": vid, "cluster_id": to,
+                             "epoch": new_epoch})
+                peer_acquired = True
+            except (rpc.RpcError, OSError, ConnectionError):
+                pass  # the peer adopts the epoch from the data path
+        emit_event("lease.move", node=self.url(), vid=vid,
+                   to=to, epoch=new_epoch,
+                   peer_acquired=peer_acquired)
+        try:
+            self._send_heartbeat(full=True)
+        except Exception:  # noqa: BLE001
+            pass
+        out = {"volume": vid, "to": to, "epoch": new_epoch,
+               "peer_acquired": peer_acquired}
+        if not peer_acquired:
+            out["warning"] = (
+                "target cluster not reachable for the explicit "
+                "acquire; it adopts the new epoch from the next "
+                "shipped batch (writes 503 there until then)")
+        return out
 
     def _debug_hot(self, query: dict, body: bytes) -> dict:
         """GET /debug/hot — heavy-hitter snapshot: top-k hot volumes,
@@ -1707,6 +1921,58 @@ class VolumeServer:
                 503, f"volume server {self.url()} is draining",
                 headers={"Retry-After": "1"})
 
+    def _forward_if_not_holder(self, path: str, query: dict,
+                               body: bytes, method: str,
+                               vid: int) -> dict | None:
+        """Geo write fencing at the door: a write landing at a
+        non-holder cluster NEVER commits locally — it forwards to the
+        lease holder's volume server (resolved through the peer
+        master, like a shipped batch) and relays the holder's answer.
+        Intra-cluster replica fan-outs (?type=replicate) are exempt:
+        they are the tail of a write the local holder-check already
+        admitted.  A forward that cannot reach a writable holder
+        fails CLOSED with 503 + Retry-After — during a partition or a
+        mid-move window the volume is unavailable for writes, never
+        split-brained."""
+        if self.leases is None or query.get("type") == "replicate" \
+                or self.leases.is_holder(vid):
+            return None
+        holder = self.leases.holder(vid)
+        if query.get("geo") == "fwd":
+            # Already a forward (both sides think the other holds —
+            # a contested or mid-move lease): refuse instead of
+            # bouncing the write between clusters forever.
+            raise rpc.RpcError(
+                503, f"volume {vid}: no writable lease holder "
+                     f"(lease contested or mid-move, epoch "
+                     f"{self.leases.epoch(vid)})",
+                headers={"Retry-After": "1"})
+        target = self.shipper._resolve_target(vid) \
+            if self.shipper is not None else None
+        if target is None:
+            raise rpc.RpcError(
+                503, f"volume {vid}: lease held by cluster "
+                     f"{holder}, no route to it from here",
+                headers={"Retry-After": "1"})
+        fwd = {k: v for k, v in query.items()
+               if not k.startswith("_")}
+        fwd["geo"] = "fwd"
+        qs = urllib.parse.urlencode(fwd)
+        hdrs = dict(_flows.tag("replicate.fanout"))
+        if "gzip" in query.get("_content_encoding", ""):
+            hdrs["Content-Encoding"] = "gzip"
+        try:
+            out = rpc.call(f"http://{target}{path}?{qs}", method,
+                           body, headers=hdrs)
+        except rpc.RpcError as e:
+            if e.status < 500:
+                raise  # the holder's own verdict (quota, jwt, 404…)
+            raise rpc.RpcError(
+                503, f"volume {vid}: lease holder {holder} "
+                     f"unreachable ({e.message})",
+                headers={"Retry-After": "1"}) from None
+        return out if isinstance(out, dict) else {}
+
     def _post_needle(self, path: str, query: dict, body: bytes) -> dict:
         self._check_write_jwt(path, query)
         self._refuse_if_draining(query)
@@ -1718,6 +1984,10 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             raise rpc.RpcError(404, f"volume {vid} not on this server")
+        fwd = self._forward_if_not_holder(path, query, body, "POST",
+                                          vid)
+        if fwd is not None:
+            return fwd
         mime = query.get("mime", query.get("_content_type", ""))
         gzipped = "gzip" in query.get("_content_encoding", "")
         if mime == "image/jpeg" and not gzipped and \
@@ -1806,6 +2076,10 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is None:
             raise rpc.RpcError(404, f"volume {vid} not on this server")
+        fwd = self._forward_if_not_holder(path, query, b"", "DELETE",
+                                          vid)
+        if fwd is not None:
+            return fwd
         freed = self.store.delete_needle(vid, key)
         if freed > 0:
             # Deletes decrement at tombstone time (not vacuum time):

@@ -196,6 +196,21 @@ def run_master(flags: Flags, args: list[str]) -> int:
         # (line grammar or TOML) — hard quotas reject at /dir/assign,
         # rps/bw limits throttle with 429, weights drive DRR fairness.
         tenant_rules=flags.get("tenant.rules", ""),
+        # Geo active/active: -geo.cluster.id names THIS region;
+        # -replicate.steer (with -replicate.steer.peer = the peer
+        # region's master) reorders /dir/lookup toward the freshest
+        # in-SLO replica, refreshed every -replicate.steer.refresh s.
+        geo_cluster_id=flags.get("geo.cluster.id", ""),
+        # Disjoint vid residue classes per region (e.g. stride=2 with
+        # offset 0 on one region, 1 on the other): active/active
+        # masters must never mint the same volume id.
+        geo_vid_stride=int(flags.get("geo.vid.stride", "1")),
+        geo_vid_offset=int(flags.get("geo.vid.offset", "0")),
+        steer_peer=(_norm_master(flags.get("replicate.steer.peer"))
+                    .removeprefix("http://")
+                    if flags.get("replicate.steer.peer") else None),
+        steer_reads=flags.get_bool("replicate.steer", False),
+        steer_refresh=flags.get_float("replicate.steer.refresh", 2.0),
         **_slo_flags(flags))
     m.start()
     glog.infof("master serving at %s", m.server.url())
@@ -260,6 +275,13 @@ def run_volume(flags: Flags, args: list[str]) -> int:
                         if flags.get("replicate.peer") else None),
         replicate_collections=flags.get("replicate.collections", ""),
         replicate_interval=flags.get_float("replicate.interval", 0.5),
+        # Geo active/active: -geo.cluster.id names THIS region and
+        # turns on the per-volume `.lease` fencing plane (writes at a
+        # non-holder forward to the holder; stale-epoch batches 409);
+        # -replicate.compress zlib-compresses shipped batches so the
+        # rlog.ship flow purpose meters actual WAN bytes.
+        geo_cluster_id=flags.get("geo.cluster.id", ""),
+        replicate_compress=flags.get_bool("replicate.compress", False),
         # Remote-tier knobs: -tier.cache.mb bounds the read-through
         # block cache for tiered volumes; -tier.promote.hits (>0) turns
         # on auto-promotion — a tiered volume whose cache sees that
@@ -492,7 +514,11 @@ register(Command("master", "master -port=9333 -mdir=/tmp/meta"
                  " [-replicate.lag.slo=30(s)]"
                  " [-lifecycle.rules=rules.txt]"
                  " [-lifecycle.interval=60] [-lifecycle.mbps=32]"
-                 " [-tenant.rules=tenants.txt]",
+                 " [-tenant.rules=tenants.txt]"
+                 " [-geo.cluster.id=A] [-geo.vid.stride=2]"
+                 " [-geo.vid.offset=0] [-replicate.steer]"
+                 " [-replicate.steer.peer=peer-master:9333]"
+                 " [-replicate.steer.refresh=2]",
                  "start a master server", run_master))
 register(Command("volume",
                  "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
@@ -503,6 +529,7 @@ register(Command("volume",
                  " [-slo.read.p99=0.05] [-slo.availability=99.9]"
                  " [-replicate.peer=standby-master:9333]"
                  " [-replicate.collections=a,b] [-replicate.interval=0.5]"
+                 " [-geo.cluster.id=A] [-replicate.compress]"
                  " [-tier.cache.mb=64] [-tier.promote.hits=0]"
                  " [-tier.promote.window=60] [-tenant.rules=tenants.txt]",
                  "start a volume server", run_volume))

@@ -141,6 +141,19 @@ TYPES: dict[str, str] = {
                     "(stats/flows.py); /cluster/healthz warns until "
                     "the rate drops back under the limit (one row "
                     "per >=5s episode)",
+    "lease.acquire": "a cluster fenced itself in as a mirrored "
+                     "volume's write-lease holder (epoch recorded in "
+                     "the .lease sidecar); writes arriving at other "
+                     "clusters now forward here",
+    "lease.move": "a lease transfer completed on the old holder: rlog "
+                  "drained, the sidecar demoted to the target cluster "
+                  "at epoch+1 (fail-closed if the peer's explicit "
+                  "acquire is unreachable — it adopts the epoch from "
+                  "the data path)",
+    "lease.fence": "an epoch fence fired: a shipped batch (or lease "
+                   "probe) carried a stale epoch and was refused with "
+                   "409 — the partitioned old holder's writes cannot "
+                   "land",
 }
 
 SEVERITIES = ("info", "warn", "error")

@@ -97,6 +97,11 @@ POINTS: dict[str, str] = {
                      "send — an armed fail makes the shipper deliver "
                      "the SAME batch twice; the receiver's applied-seq "
                      "watermark must no-op the replay",
+    "wan.reorder": "cross-cluster ship path, before a batch send — an "
+                   "armed fail makes the shipper deliver batch n+1 "
+                   "BEFORE batch n; the receiver must refuse the "
+                   "gapped batch unacked so in-order re-delivery "
+                   "converges with nothing skipped",
     "tier.read": "remote-tier ranged GET (the block-cache fetch leg) "
                  "— an armed fail is a WAN-partitioned backend; the "
                  "needle read path must answer a bounded 503, never "

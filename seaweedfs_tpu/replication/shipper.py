@@ -25,16 +25,31 @@ each log as one batch:
   which the receiver no-ops.
 
 WAN fault points on the ship path (`wan.partition`, `wan.delay`,
-`wan.duplicate` — fault/registry.py) shape the chaos suite; the
-`wan.duplicate` hook makes the shipper send the SAME batch twice, so
-duplicate delivery is a first-class tested scenario, not an accident.
+`wan.duplicate`, `wan.reorder` — fault/registry.py) shape the chaos
+suite; the `wan.duplicate` hook makes the shipper send the SAME batch
+twice and the `wan.reorder` hook delivers batch n+1 BEFORE batch n, so
+duplicate and out-of-order delivery are first-class tested scenarios,
+not accidents.
+
+Geo active/active (replication/lease.py): when the owning volume
+server carries a `-geo.cluster.id`, the shipper runs keyed by lease
+ownership — it ships only volumes whose `.lease` sidecar names THIS
+cluster (the peer's shipper covers the opposite direction), stamps
+every batch with `(cluster_id, epoch)` so the receiver can fence stale
+holders, and adopts the receiver's lease on a 409 (a fenced old holder
+demotes itself on heal).  `-replicate.compress` zlib-compresses the
+record list; the receiver acks with per-batch raw/wire byte counts and
+the compressed bytes are what the `rlog.ship` flow purpose meters, so
+`-flows.budget rlog.ship=...` governs actual WAN spend.
 """
 
 from __future__ import annotations
 
 import base64
+import json
 import threading
 import time
+import zlib
 
 from ..cluster import resilience, rpc
 from ..events import emit as emit_event
@@ -56,10 +71,22 @@ class ReplicationShipper:
 
     def __init__(self, store, peer: str, node: str = "",
                  collections: str = "", interval: float = 0.5,
-                 batch_records: int = 128):
+                 batch_records: int = 128, cluster_id: str = "",
+                 compress: bool = False, leases=None):
         self.store = store
         self.peer = peer if peer.startswith("http") else f"http://{peer}"
         self.node = node
+        # Geo identity + the lease table that keys shipping direction
+        # (replication/lease.py); both empty/None = PR 11
+        # active/passive mode (ship everything, unfenced).
+        self.cluster_id = cluster_id
+        self.leases = leases
+        self.compress = compress
+        # Cumulative ship accounting (raw vs wire bytes): the
+        # compressed-vs-raw WAN spend number /debug/replication and
+        # the geo bench report.
+        self.shipped = {"batches": 0, "records": 0,
+                        "raw_bytes": 0, "wire_bytes": 0}
         # Per-collection opt-in: empty = mirror everything; the
         # default collection opts in as "" (spelled `default` too).
         names = {c.strip() for c in collections.split(",") if c.strip()}
@@ -129,6 +156,13 @@ class ReplicationShipper:
 
     def tick(self) -> None:
         for v in self._volumes():
+            if self.leases is not None and \
+                    not self.leases.ships(v.vid):
+                # The lease names the PEER as holder: its shipper
+                # covers this volume in the opposite direction, and
+                # shipping our (fenced, apply-only) copy back would
+                # be rejected traffic at best.
+                continue
             if v.rlog is None:
                 v.enable_rlog()
             try:
@@ -150,9 +184,21 @@ class ReplicationShipper:
             target = self._resolve_target(v.vid)
             if target is None:
                 return
+            if _fault.ARMED:
+                self._maybe_reorder(v, rlog, recs, target)
             t0 = time.perf_counter()
             try:
                 out = self._post(target, v.vid, body)
+            except rpc.RpcError as e:
+                if e.status == 409:
+                    # The receiver's fencing plane spoke: a holder
+                    # with a newer epoch exists.  Adopt its lease (a
+                    # partitioned old holder demotes on heal) and stop
+                    # shipping this volume.
+                    self._fence_from_peer(v.vid, target, e.message)
+                    return
+                self._targets.pop(v.vid, None)  # re-resolve next tick
+                raise
             except Exception:
                 self._targets.pop(v.vid, None)  # re-resolve next tick
                 raise
@@ -160,6 +206,12 @@ class ReplicationShipper:
             if acked > rlog.acked_seq:
                 rlog.set_acked(acked)
             replication_shipped_bytes_total.inc(nbytes)
+            raw_b = int(out.get("raw_bytes", 0) or 0)
+            wire_b = int(out.get("wire_bytes", 0) or 0)
+            self.shipped["batches"] += 1
+            self.shipped["records"] += len(recs)
+            self.shipped["raw_bytes"] += raw_b or nbytes
+            self.shipped["wire_bytes"] += wire_b or nbytes
             emit_event("replication.ship", node=self.node, vid=v.vid,
                        peer=target, records=len(recs), bytes=nbytes,
                        first_seq=recs[0].seq, last_seq=recs[-1].seq,
@@ -167,8 +219,48 @@ class ReplicationShipper:
             emit_event("replication.ack", node=self.node, vid=v.vid,
                        peer=target, acked_seq=acked,
                        applied=out.get("applied", 0),
-                       skipped=out.get("skipped", 0))
+                       skipped=out.get("skipped", 0),
+                       raw_bytes=raw_b, wire_bytes=wire_b)
             self._observe_lag(v.vid, rlog)
+
+    def _maybe_reorder(self, v, rlog, recs, target: str) -> None:
+        """`wan.reorder` chaos hook: deliver batch n+1 BEFORE batch n.
+        The receiver must refuse the gapped batch WITHOUT acking it —
+        accepting would advance its watermark past batch n's seqs and
+        those records would be skipped as duplicates forever.  The
+        refusal is swallowed here; the normal loop then ships n and
+        n+1 in order and everything converges."""
+        try:
+            _fault.hit("wan.reorder", peer=target, vid=v.vid)
+        except _fault.FaultInjected:
+            nxt = rlog.read_from(recs[-1].seq + 1, self.batch_records)
+            if not nxt:
+                return  # nothing after batch n: no reorder to inject
+            nbody, _nb = self._encode_batch(v, nxt)
+            replication_resends_total.inc(reason="reorder")
+            try:
+                self._post(target, v.vid, nbody)
+            except rpc.RpcError:
+                pass  # the receiver refused the gap — the invariant
+
+    def _fence_from_peer(self, vid: int, target: str,
+                         detail: str) -> None:
+        """Adopt the receiver's lease after a 409: fetch its
+        `.lease` row and fence our own table forward (monotonic, so a
+        racing local acquire at a higher epoch still wins)."""
+        row = None
+        try:
+            doc = rpc.call(
+                f"http://{target}/admin/lease/status?volume={vid}")
+            row = (doc.get("leases") or {}).get(str(vid))
+        except Exception:  # noqa: BLE001 — peer gone mid-fence: the
+            pass           # 409 will recur and we retry then
+        if row and self.leases is not None:
+            self.leases.fence(vid, str(row["cluster_id"]),
+                              int(row["epoch"]))
+            emit_event("lease.fence", node=self.node, severity="warn",
+                       vid=vid, holder=str(row["cluster_id"]),
+                       epoch=int(row["epoch"]), detail=detail)
 
     def _encode_batch(self, v, recs) -> tuple[dict, int]:
         out = []
@@ -187,14 +279,31 @@ class ReplicationShipper:
                     # needle (or the repair plane) converges the pair.
                     rec["blob"] = None
             out.append(rec)
-        return ({"volume": v.vid, "collection": v.collection,
-                 "version": v.version,
-                 "replication": str(v.super_block.replica_placement),
-                 "ttl": str(v.super_block.ttl),
-                 "records": out}, nbytes)
+        body = {"volume": v.vid, "collection": v.collection,
+                "version": v.version,
+                "replication": str(v.super_block.replica_placement),
+                "ttl": str(v.super_block.ttl),
+                "records": out}
+        if self.cluster_id:
+            # Geo fencing stamp: the receiver rejects this batch when
+            # its own `.lease` knows a newer epoch for the volume.
+            body["cluster_id"] = self.cluster_id
+            body["epoch"] = self.leases.epoch(v.vid) \
+                if self.leases is not None else 0
+        if self.compress:
+            # Delta-compressed shipping: the record list (blobs and
+            # all) rides as one zlib stream; what goes on the WAN —
+            # and what the `rlog.ship` flow purpose meters — is the
+            # compressed payload.
+            raw = json.dumps(out).encode()
+            del body["records"]
+            body["codec"] = "zlib"
+            body["records_z"] = base64.b64encode(
+                zlib.compress(raw)).decode()
+            body["raw_bytes"] = len(raw)
+        return body, nbytes
 
     def _post(self, target: str, vid: int, body: dict) -> dict:
-        import json
         payload = json.dumps(body).encode()
         breaker = resilience.breaker_for(target)
 
@@ -250,7 +359,13 @@ class ReplicationShipper:
             return hit[1]
         url = None
         try:
-            out = rpc.call(f"{self.peer}/dir/lookup?volumeId={vid}")
+            # steered=1: ask for the peer's RAW placement.  Steering is
+            # a client-read feature — a steering peer master would
+            # prepend OUR region's replica the moment it sees our lag
+            # cross the SLO, and the shipper would ship the backlog to
+            # itself (self-apply gap-409s, shipping stalls forever).
+            out = rpc.call(
+                f"{self.peer}/dir/lookup?volumeId={vid}&steered=1")
             locs = out.get("locations") or []
             if locs:
                 url = locs[0].get("url") or locs[0].get("publicUrl")
@@ -325,4 +440,7 @@ class ReplicationShipper:
         doc["collections"] = (sorted(c or "default"
                                      for c in self.collections)
                               if self.collections is not None else [])
+        doc["cluster_id"] = self.cluster_id
+        doc["compress"] = self.compress
+        doc["shipped"] = dict(self.shipped)
         return doc

@@ -70,12 +70,37 @@ def _shipper_nodes(env: CommandEnv) -> list[tuple[str, dict]]:
 @register
 class ClusterMirrorStatus(Command):
     name = "cluster.mirror.status"
-    help = ("cluster.mirror.status — per-volume mirror state from the "
-            "master's /cluster/mirror: change-log watermarks, ship lag "
-            "(records + seconds), pause state, and the lag SLO")
+    help = ("cluster.mirror.status [-watch] [-interval S] [-count N] "
+            "— per-volume mirror state from the master's "
+            "/cluster/mirror: change-log watermarks, ship lag "
+            "(records + seconds), pause state, geo lease holders, and "
+            "the lag SLO.  -watch repolls every -interval seconds "
+            "(default 2) until interrupted (or -count polls)")
 
     def do(self, args: list[str], env: CommandEnv) -> str:
-        doc = _mirror_doc(env)
+        flags, _rest = self.parse_flags(args)
+        watch = flags.get("watch") == "true"
+        interval = float(flags.get("interval", "2"))
+        count = int(flags.get("count", "0"))
+        if not watch:
+            return self._render(_mirror_doc(env))
+        polls = 0
+        out = ""
+        try:
+            while True:
+                out = self._render(_mirror_doc(env))
+                polls += 1
+                if count and polls >= count:
+                    break
+                print(out)
+                print("---")
+                time.sleep(interval)
+        except KeyboardInterrupt:
+            pass
+        return out
+
+    @staticmethod
+    def _render(doc: dict) -> str:
         if not doc.get("paired"):
             return ("not paired: no volume server reports a "
                     "-replicate.peer")
@@ -84,22 +109,31 @@ class ClusterMirrorStatus(Command):
                     if doc.get("lag_slo") is not None else "")
                  + ("  CAUGHT UP" if doc.get("caught_up")
                     else "  SHIPPING")]
+        if doc.get("cluster_id"):
+            lines[0] += f"  cluster: {doc['cluster_id']}"
         if doc.get("paused_nodes"):
             lines.append("paused: "
                          + ", ".join(doc["paused_nodes"]))
+        leases = doc.get("leases") or {}
         rows = doc.get("volumes", [])
         if rows:
             lines.append("")
             lines.append(f"{'VOLUME':>6}  {'NODE':21}  {'LAST':>8}  "
-                         f"{'ACKED':>8}  {'LAG':>6}  {'LAG SEC':>8}")
+                         f"{'ACKED':>8}  {'LAG':>6}  {'LAG SEC':>8}  "
+                         f"{'LEASE':12}")
             for r in sorted(rows, key=lambda r: (r["volume"],
                                                  r["node"])):
+                lr = leases.get(str(r["volume"]))
+                lease = (f"{lr['cluster_id']}@e{lr['epoch']}"
+                         + ("*" if lr.get("moving") else "")
+                         if lr else "-")
                 lines.append(
                     f"{r['volume']:6d}  {r['node']:21}  "
                     f"{r.get('last_seq', 0):8d}  "
                     f"{r.get('acked_seq', 0):8d}  "
                     f"{r.get('lag_seq', 0):6d}  "
-                    f"{r.get('lag_seconds', 0.0):8.1f}")
+                    f"{r.get('lag_seconds', 0.0):8.1f}  "
+                    f"{lease:12}")
         return "\n".join(lines)
 
 
@@ -250,3 +284,81 @@ class ClusterMirrorCutover(Command):
                    if any(peers) else "")
                 + ".  Verify convergence: volume.fsck -crc -json "
                   "against both clusters")
+
+
+@register
+class ClusterLeaseLs(Command):
+    name = "cluster.lease.ls"
+    help = ("cluster.lease.ls — per-volume geo write leases from the "
+            "master's /cluster/mirror rollup: holding cluster, fencing "
+            "epoch, and whether a transfer is mid-drain")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        doc = _mirror_doc(env)
+        leases = doc.get("leases") or {}
+        if not leases:
+            return ("no geo leases: no volume server reports a "
+                    ".lease sidecar (active/passive mirroring, or "
+                    "-geo.cluster.id unset)")
+        lines = []
+        if doc.get("cluster_id"):
+            lines.append(f"this cluster: {doc['cluster_id']}")
+        lines.append(f"{'VOLUME':>6}  {'NODE':21}  {'HOLDER':10}  "
+                     f"{'EPOCH':>6}  {'LOCAL':>5}  {'MOVING':>6}")
+        for vid, lr in sorted(leases.items(), key=lambda kv:
+                              int(kv[0])):
+            lines.append(
+                f"{int(vid):6d}  {lr.get('node', '-'):21}  "
+                f"{lr.get('cluster_id', '?'):10}  "
+                f"{lr.get('epoch', 0):6d}  "
+                f"{'yes' if lr.get('holder_is_local') else 'no':>5}  "
+                f"{'yes' if lr.get('moving') else 'no':>6}")
+        return "\n".join(lines)
+
+
+@register
+class ClusterLeaseMove(Command):
+    name = "cluster.lease.move"
+    help = ("cluster.lease.move -volume V -to CLUSTER [-timeout N] — "
+            "transfer a volume's geo write lease to the named peer "
+            "cluster: the holder refuses new writes, drains its "
+            "change log to the peer, then demotes itself at epoch+1 "
+            "BEFORE the peer acquires (a partition mid-move leaves NO "
+            "holder — fail-closed, never split-brained).  Requires "
+            "`lock`")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        env.confirm_is_locked()
+        flags, _rest = self.parse_flags(args)
+        if not flags.get("volume") or not flags.get("to"):
+            raise ShellError("usage: cluster.lease.move -volume V "
+                             "-to CLUSTER [-timeout N]")
+        vid = int(flags["volume"])
+        to = flags["to"]
+        timeout = float(flags.get("timeout", "10"))
+        try:
+            out = rpc.call(
+                f"{env.master_url}/dir/lookup?volumeId={vid}",
+                timeout=10.0)
+            locs = out.get("locations") or []
+        except Exception as e:  # noqa: BLE001
+            raise ShellError(f"lookup of volume {vid} failed: {e}") \
+                from None
+        if not locs:
+            raise ShellError(f"volume {vid}: no locations known to "
+                             f"{env.master_url}")
+        node = locs[0].get("url") or locs[0].get("publicUrl")
+        try:
+            doc = env.vs_call(node, "/admin/lease/move",
+                              payload={"volume": vid, "to": to,
+                                       "timeout": timeout},
+                              timeout=timeout + 10.0)
+        except rpc.RpcError as e:
+            raise ShellError(
+                f"lease move failed on {node}: {e.message}") from None
+        msg = (f"volume {vid}: lease moved to cluster {to} at epoch "
+               f"{doc.get('epoch')} (drained on {node})")
+        if not doc.get("peer_acquired"):
+            msg += ("\nwarning: " + doc.get(
+                "warning", "peer did not confirm the acquire"))
+        return msg

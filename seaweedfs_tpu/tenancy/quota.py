@@ -71,6 +71,7 @@ class QuotaRule:
     max_mbps: float = 0.0    # write bandwidth, MB/s (0 = unlimited)
     soft: bool = False       # soft: warn + events, never reject
     weight: float = 1.0      # DRR share when the lane queue backs up
+    home: str = ""           # geo home cluster id ("" = no preference)
 
     def matches(self, tenant: str) -> bool:
         return self.tenant == "*" or self.tenant == tenant
@@ -89,6 +90,8 @@ class QuotaRule:
             d["soft"] = True
         if self.weight != 1.0:
             d["weight"] = self.weight
+        if self.home:
+            d["home"] = self.home
         return d
 
 
@@ -107,7 +110,7 @@ def _build_rule(tenant: str, kv: dict) -> QuotaRule:
     if not tenant:
         raise QuotaError("rule needs a tenant name (or *)")
     known = {"max_bytes", "max_objects", "max_rps", "max_mbps",
-             "soft", "weight"}
+             "soft", "weight", "home"}
     bad = set(kv) - known
     if bad:
         raise QuotaError(f"unknown rule keys {sorted(bad)}")
@@ -117,17 +120,19 @@ def _build_rule(tenant: str, kv: dict) -> QuotaRule:
     max_mbps = float(kv.get("max_mbps", 0.0))
     soft = _parse_bool(kv.get("soft", False))
     weight = float(kv.get("weight", 1.0))
+    home = str(kv.get("home", "")).strip()
     if max_bytes < 0 or max_objects < 0 or max_rps < 0 or max_mbps < 0:
         raise QuotaError("quota limits must be >= 0")
     if weight <= 0:
         raise QuotaError(f"weight must be > 0: {weight}")
-    if not (max_bytes or max_objects or max_rps or max_mbps):
+    if not (max_bytes or max_objects or max_rps or max_mbps or home):
         raise QuotaError(
             "rule needs at least one of max_bytes=/max_objects=/"
-            "max_rps=/max_mbps=")
+            "max_rps=/max_mbps=/home=")
     return QuotaRule(tenant=tenant, max_bytes=max_bytes,
                      max_objects=max_objects, max_rps=max_rps,
-                     max_mbps=max_mbps, soft=soft, weight=weight)
+                     max_mbps=max_mbps, soft=soft, weight=weight,
+                     home=home)
 
 
 def parse_rules_text(text: str) -> "QuotaPolicy":

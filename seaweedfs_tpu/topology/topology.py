@@ -83,7 +83,8 @@ class Topology(Node):
     def __init__(self, id_: str = "topo",
                  volume_size_limit: int = 30 * 1024 * 1024 * 1024,
                  sequencer: MemorySequencer | None = None,
-                 pulse_seconds: int = 5):
+                 pulse_seconds: int = 5,
+                 vid_stride: int = 1, vid_offset: int = 0):
         super().__init__(id_)
         self.volume_size_limit = volume_size_limit
         self.collections: dict[str, Collection] = {}
@@ -91,6 +92,12 @@ class Topology(Node):
         self.sequencer = sequencer or MemorySequencer()
         self.pulse_seconds = pulse_seconds
         self._max_volume_id = 0
+        # Geo id-space partitioning: with stride > 1 this master only
+        # mints volume ids ≡ offset (mod stride), so two active/active
+        # regions can never allocate the same id for different volumes
+        # (a collision would make their lease planes fence each other).
+        self.vid_stride = max(1, int(vid_stride))
+        self.vid_offset = int(vid_offset) % self.vid_stride
         self._lock = threading.RLock()
 
     # -- tree helpers --------------------------------------------------------
@@ -105,12 +112,22 @@ class Topology(Node):
     # agrees on the high-water mark.
     next_volume_id_hook = None
 
+    def stride_align(self, vid: int) -> int:
+        """Smallest id >= vid in this master's residue class (identity
+        when unstrided).  Learned ids from heartbeats or mirrored
+        volumes raise the high-water mark across BOTH classes, so the
+        classes stay disjoint even as each region hosts the other's
+        volumes."""
+        if self.vid_stride <= 1:
+            return vid
+        return vid + (self.vid_offset - vid) % self.vid_stride
+
     def next_volume_id(self) -> int:
         if self.next_volume_id_hook is not None:
             return self.next_volume_id_hook()
         with self._lock:
-            self._max_volume_id = max(self._max_volume_id,
-                                      self.max_volume_id) + 1
+            self._max_volume_id = self.stride_align(
+                max(self._max_volume_id, self.max_volume_id) + 1)
             self.up_adjust_max_volume_id(self._max_volume_id)
             return self._max_volume_id
 

@@ -777,6 +777,71 @@ def _drive_flows_budget(cl):
         _fl.LEDGER.set_budgets({})
 
 
+def _lease_vs(cl, cluster_id="A"):
+    """Throwaway geo volume server (its own -geo.cluster.id + a
+    self-pair shipper, like the cutover driver) hosting one volume —
+    the lease emit sites live on the geo-enabled write/apply paths."""
+    master, _s, _st, _c, tmp = cl
+    _COLLECTION_N[0] += 1
+    d = tmp / f"geovs{_COLLECTION_N[0]}"
+    d.mkdir()
+    vs = VolumeServer(master.url(), [str(d)], max_volume_counts=[5],
+                      pulse_seconds=60, replicate_peer=master.url(),
+                      geo_cluster_id=cluster_id)
+    vs.start()
+    vid = 9000 + _COLLECTION_N[0]
+    vs.store.add_volume(vid, f"geocol{_COLLECTION_N[0]}", "000", "")
+    return vs, vid
+
+
+def _drive_lease_acquire(cl):
+    """Acquire through the real handler: the node fences itself in as
+    the volume's holder at epoch 1."""
+    vs, vid = _lease_vs(cl)
+    try:
+        with root_span("drive.lease_acquire", "test"):
+            out = rpc.call_json(
+                f"http://{vs.url()}/admin/lease/acquire",
+                payload={"volume": vid})
+        assert out["holder_is_local"] and out["epoch"] == 1, out
+    finally:
+        vs.stop()
+
+
+def _drive_lease_move(cl):
+    """Transfer through the real handler: drain (trivially empty rlog),
+    demote-first to cluster B at epoch 2."""
+    vs, vid = _lease_vs(cl)
+    try:
+        rpc.call_json(f"http://{vs.url()}/admin/lease/acquire",
+                      payload={"volume": vid})
+        with root_span("drive.lease_move", "test"):
+            out = rpc.call_json(
+                f"http://{vs.url()}/admin/lease/move",
+                payload={"volume": vid, "to": "B"})
+        assert out["epoch"] == 2, out
+        assert not vs.leases.is_holder(vid)
+    finally:
+        vs.stop()
+
+
+def _drive_lease_fence(cl):
+    """Fence through the real apply path: a batch stamped with a stale
+    epoch is refused 409 and journaled."""
+    vs, vid = _lease_vs(cl)
+    try:
+        rpc.call_json(f"http://{vs.url()}/admin/lease/acquire",
+                      payload={"volume": vid})
+        with root_span("drive.lease_fence", "test"):
+            status, out = rpc.call_status(
+                f"http://{vs.url()}/admin/replication/apply", "POST",
+                json.dumps({"volume": vid, "cluster_id": "STALE",
+                            "epoch": 0, "records": []}).encode())
+        assert status == 409, (status, out)
+    finally:
+        vs.stop()
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -819,6 +884,9 @@ DRIVERS = {
     "quota.exceeded": _drive_quota_exceeded,
     "tenant.throttled": _drive_tenant_throttled,
     "flows.budget": _drive_flows_budget,
+    "lease.acquire": _drive_lease_acquire,
+    "lease.move": _drive_lease_move,
+    "lease.fence": _drive_lease_fence,
 }
 
 
@@ -833,8 +901,9 @@ def test_driver_catalog_matches_registry():
     # slo.burn + 4 cross-cluster mirror types: replication.ship/ack/
     # lag/cutover + 3 data-lifecycle types: lifecycle.tier/promote +
     # volume.expired + 2 tenancy types: quota.exceeded +
-    # tenant.throttled + 1 wire-flow type: flows.budget).
-    assert len(TYPES) == 41
+    # tenant.throttled + 1 wire-flow type: flows.budget + 3 geo lease
+    # types: lease.acquire/move/fence).
+    assert len(TYPES) == 44
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))

@@ -413,6 +413,32 @@ def _drive_wan_delay(cl):
     assert out["acked_seq"] == 0
 
 
+def _drive_wan_reorder(cl):
+    """Out-of-order delivery on purpose: with batch n in hand and a
+    batch n+1 pending behind it, the armed hook posts n+1 FIRST and
+    counts the resend.  The receiver-side invariant — a gapped batch
+    is refused 409 WITHOUT acking, so in-order re-delivery converges
+    with nothing skipped — is proven end-to-end in test_geo.py."""
+    from seaweedfs_tpu.core.needle import Needle
+    from seaweedfs_tpu.stats.metrics import replication_resends_total
+    _master, servers, stub, _client = cl
+    vs = servers[0]
+    vid = 7777
+    v = vs.store.add_volume(vid, "reordercol", "000", "")
+    v.enable_rlog()
+    for key in (1, 2):  # two journaled writes -> two 1-record batches
+        v.write_needle(Needle(cookie=0x7, id=key, data=b"reorder me"))
+    sh = ReplicationShipper(vs.store, "127.0.0.1:1", batch_records=1)
+    before = replication_resends_total.value(reason="reorder")
+    n0 = _APPLY_CALLS[0]
+    fault.arm("wan.reorder", "fail*1")
+    recs = v.rlog.read_from(1, 1)  # batch n, about to be sent
+    sh._maybe_reorder(v, v.rlog, recs, f"127.0.0.1:{stub.port}")
+    assert _APPLY_CALLS[0] - n0 == 1, "batch n+1 must go out first"
+    assert replication_resends_total.value(
+        reason="reorder") - before == 1
+
+
 def _drive_wan_duplicate(cl):
     """Duplicate delivery on purpose: the shipper sends the SAME batch
     twice and counts the resend — the receiver's applied watermark
@@ -476,6 +502,7 @@ DRIVERS = {
     "wan.partition": _drive_wan_partition,
     "wan.delay": _drive_wan_delay,
     "wan.duplicate": _drive_wan_duplicate,
+    "wan.reorder": _drive_wan_reorder,
     "tier.read": _drive_tier_read,
 }
 

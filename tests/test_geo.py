@@ -1,0 +1,628 @@
+"""Geo active/active: epoch-fenced write leases, delta-compressed
+bidirectional shipping, and locality-steered reads.
+
+Two full regions (A and B — master + volume server each) run against
+each other: each volume server ships its change logs to the OTHER
+region's master (`-replicate.peer`), carries a `-geo.cluster.id`, and
+compresses batches (`-replicate.compress`).  Per-volume `.lease`
+sidecars key the shipping direction and fence writes by epoch.
+
+The two PR acceptance gates live here:
+
+- `test_split_brain_fencing_gate` — with `wan.partition` armed during
+  a forced lease contest, at no point do both clusters ack a write
+  for the same volume (a contested lease fails CLOSED with 503 on
+  both sides), and a fenced stale-epoch batch is refused with 409.
+- `test_partition_heal_converges_fsck_map_equality` — a partition
+  strands acked writes on the holder; after heal the backlog drains
+  and `volume.fsck -crc -json` returns byte-identical per-volume maps
+  through both masters.
+
+Plus the satellites: `wan.reorder` end-to-end (seq-idempotent apply
+refuses the gapped batch unacked, then everything converges),
+`rlog.compact()` racing an in-flight shipper tick (injected barrier),
+locality steering (lag-SLO breach and tenant `home=` hints reorder
+/dir/lookup), the `cluster.lease.*` / `cluster.mirror.status -watch`
+shell verbs, and the flows cross-assert that compressed ship bytes
+land under the `rlog.ship` purpose within budget.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import fault
+from seaweedfs_tpu.cluster import resilience, rpc
+from seaweedfs_tpu.cluster.client import WeedClient
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer.client import FilerProxy
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.replication import rlog as rl
+from seaweedfs_tpu.replication.rlog import ReplicationLog
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.stats import flows as _fl
+from seaweedfs_tpu.stats.metrics import replication_resends_total
+from seaweedfs_tpu.tenancy.quota import QuotaRule
+
+pytestmark = pytest.mark.geo
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fault.disarm_all()
+    resilience.reset_breakers()
+    yield
+    fault.disarm_all()
+    resilience.reset_breakers()
+
+
+def _wait(cond, timeout=20.0, msg="condition never held"):
+    deadline = time.time() + timeout
+    while not cond():
+        if time.time() > deadline:
+            raise TimeoutError(msg)
+        time.sleep(0.05)
+
+
+# -- the two-region fixture --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def geo(tmp_path_factory):
+    """Regions A and B, fully active/active: each side's volume server
+    ships to the OTHER side's master, compressed, with geo cluster ids
+    and lease tables.  Both masters steer reads (peer = the other
+    master) with a deliberately tight 50ms lag SLO and a short steer
+    cache so steering tests are fast."""
+    tmp = tmp_path_factory.mktemp("geo")
+    pa = rpc.free_port()
+    pb = rpc.free_port()
+    while pb == pa:
+        pb = rpc.free_port()
+    ma = MasterServer(port=pa, volume_size_limit_mb=16,
+                      meta_dir=str(tmp / "ma"), pulse_seconds=60,
+                      replication_lag_slo=0.05, geo_cluster_id="A",
+                      geo_vid_stride=2, geo_vid_offset=1,
+                      steer_peer=f"127.0.0.1:{pb}", steer_reads=True,
+                      steer_refresh=0.2)
+    ma.start()
+    mb = MasterServer(port=pb, volume_size_limit_mb=16,
+                      meta_dir=str(tmp / "mb"), pulse_seconds=60,
+                      replication_lag_slo=0.05, geo_cluster_id="B",
+                      geo_vid_stride=2, geo_vid_offset=0,
+                      steer_peer=f"127.0.0.1:{pa}", steer_reads=True,
+                      steer_refresh=0.2)
+    mb.start()
+    (tmp / "a").mkdir()
+    (tmp / "b").mkdir()
+    va = VolumeServer(ma.url(), [str(tmp / "a")],
+                      max_volume_counts=[200], pulse_seconds=60,
+                      replicate_peer=mb.url(), replicate_interval=0.05,
+                      geo_cluster_id="A", replicate_compress=True)
+    va.start()
+    vb = VolumeServer(mb.url(), [str(tmp / "b")],
+                      max_volume_counts=[200], pulse_seconds=60,
+                      replicate_peer=ma.url(), replicate_interval=0.05,
+                      geo_cluster_id="B", replicate_compress=True)
+    vb.start()
+    yield ma, va, mb, vb, tmp
+    vb.stop()
+    va.stop()
+    mb.stop()
+    ma.stop()
+
+
+_GEO_COL_N = [0]
+
+
+def _geo_put(master, vs, data, collection=None):
+    """A home-region write: grow-if-new collection, enable the change
+    log, ACQUIRE the lease (epoch 1) before the first byte lands, then
+    raw POST.  Returns (vid, fid, collection)."""
+    if collection is None:
+        _GEO_COL_N[0] += 1
+        collection = f"geocol{_GEO_COL_N[0]}"
+        rpc.call(f"{master.url()}/vol/grow?count=1"
+                 f"&collection={collection}", "POST")
+    a = rpc.call(f"{master.url()}/dir/assign?collection={collection}")
+    vid = int(a["fid"].split(",")[0])
+    v = vs.store.find_volume(vid)
+    if v.rlog is None:
+        v.enable_rlog()
+    if vs.leases.get(vid) is None:
+        rpc.call_json(f"http://{vs.url()}/admin/lease/acquire",
+                      payload={"volume": vid})
+    rpc.call(f"http://{a['url']}/{a['fid']}", "POST", data)
+    return vid, a["fid"], collection
+
+
+def _rlog_status(vs, vid):
+    doc = rpc.call(f"http://{vs.url()}/debug/replication")
+    return (doc.get("rlog") or {}).get(str(vid))
+
+
+def _wait_shipped(vs, vid, timeout=20.0):
+    def ok():
+        st = _rlog_status(vs, vid)
+        return bool(st) and st["pending"] == 0 and st["last_seq"] > 0
+    _wait(ok, timeout, f"volume {vid} never fully shipped: "
+                       f"{_rlog_status(vs, vid)}")
+
+
+# -- bidirectional convergence + forwarding ----------------------------------
+
+def test_bidirectional_compressed_convergence(geo):
+    """Both directions at once: A-held volumes ship A->B, B-held ship
+    B->A, zlib-compressed, and each region reads the other's writes
+    byte-identically.  The receiver's lease table learns the sender's
+    lease from the first fenced batch."""
+    ma, va, mb, vb, _tmp = geo
+    pay_a = b"region A payload " * 64
+    vid_a, fid_a, _ = _geo_put(ma, va, pay_a)
+    pay_b = b"region B payload " * 64
+    vid_b, fid_b, _ = _geo_put(mb, vb, pay_b)
+    _wait_shipped(va, vid_a)
+    _wait_shipped(vb, vid_b)
+    assert WeedClient(mb.url()).download(fid_a) == pay_a
+    assert WeedClient(ma.url()).download(fid_b) == pay_b
+    # Compression won: the acked wire bytes are the zlib payload.
+    for vs in (va, vb):
+        sh = vs.shipper.shipped
+        assert sh["batches"] >= 1
+        assert 0 < sh["wire_bytes"] < sh["raw_bytes"]
+    # B learned A's lease from the batch stamp (and vice versa): the
+    # mirrored copies are fenced, apply-only, and never ship back.
+    _wait(lambda: vb.leases.get(vid_a) is not None, 10)
+    _wait(lambda: va.leases.get(vid_b) is not None, 10)
+    assert vb.leases.holder(vid_a) == "A"
+    assert vb.leases.epoch(vid_a) == 1
+    assert not vb.leases.is_holder(vid_a)
+    assert not vb.leases.ships(vid_a)
+    assert va.leases.holder(vid_b) == "B"
+    assert not va.leases.ships(vid_b)
+
+
+def test_write_at_non_holder_forwards_to_lease_holder(geo):
+    """A write landing at the non-holder region never commits there:
+    it forwards to the lease holder, commits exactly once, and the
+    mirror ships it back."""
+    ma, va, mb, vb, _tmp = geo
+    v1 = b"forward v1 " * 32
+    vid, fid, _col = _geo_put(ma, va, v1)
+    _wait_shipped(va, vid)
+    va.shipper.paused = True
+    try:
+        v2 = b"forward v2 " * 32
+        out = rpc.call(f"http://{vb.url()}/{fid}", "POST", v2)
+        assert out.get("size", 0) > 0
+        # Committed at the holder (A) immediately...
+        assert WeedClient(ma.url()).download(fid) == v2
+        # ...and journaled there: the non-holder (B) did NOT apply it
+        # out-of-band — its applied watermark still sits at the
+        # pre-forward record.
+        st = _rlog_status(va, vid)
+        assert st["pending"] >= 1
+        wm = vb._replication_watermark(vb.store.find_volume(vid))
+        assert wm.value == 1
+    finally:
+        va.shipper.paused = False
+    va.shipper.kick()
+    _wait_shipped(va, vid)
+    _wait(lambda: WeedClient(mb.url()).download(fid) == v2, 10,
+          "forwarded write never shipped back to region B")
+
+
+# -- wan.reorder end-to-end --------------------------------------------------
+
+def test_wan_reorder_refused_unacked_then_converges(geo):
+    """Out-of-order delivery: the `wan.reorder` hook ships batch n+1
+    BEFORE batch n.  The receiver's gap check refuses the early batch
+    WITHOUT acking (409), the sender's watermark holds, the normal
+    loop re-ships in order, and both regions end byte-identical."""
+    ma, va, mb, vb, _tmp = geo
+    pays = [b"reorder zero " * 40]
+    vid, fid0, col = _geo_put(ma, va, pays[0])
+    _wait_shipped(va, vid)
+    fids = [fid0]
+    old_batch = va.shipper.batch_records
+    va.shipper.paused = True
+    try:
+        for i in (1, 2, 3):
+            a = rpc.call(f"{ma.url()}/dir/assign?collection={col}")
+            assert int(a["fid"].split(",")[0]) == vid
+            pay = f"reorder {i} ".encode() * 40
+            rpc.call(f"http://{a['url']}/{a['fid']}", "POST", pay)
+            fids.append(a["fid"])
+            pays.append(pay)
+        va.shipper.batch_records = 1  # several batches to reorder
+        before = replication_resends_total.value(reason="reorder")
+        fault.arm("wan.reorder", "fail*1")
+        va.shipper.paused = False
+        va.shipper.kick()
+        _wait_shipped(va, vid)
+        assert replication_resends_total.value(reason="reorder") \
+            == before + 1
+    finally:
+        va.shipper.paused = False
+        va.shipper.batch_records = old_batch
+        fault.disarm_all()
+    # Nothing skipped, nothing double-applied: every record landed.
+    bc = WeedClient(mb.url())
+    for fid, pay in zip(fids, pays):
+        assert bc.download(fid) == pay
+    wm = vb._replication_watermark(vb.store.find_volume(vid))
+    assert wm.value == _rlog_status(va, vid)["last_seq"]
+
+
+# -- rlog.compact() vs an in-flight shipper tick -----------------------------
+
+def test_compact_racing_inflight_tick_never_reships_or_skips(
+        tmp_path, monkeypatch):
+    """The shipper's read-batch / receiver-ack window is lock-free
+    against `compact()`.  An injected barrier lands the ack at the
+    nastiest instant — after compact rewrote the log, before the file
+    swap — and the invariants must hold anyway: the concurrent ack is
+    never regressed, no unacked record is dropped (nothing skipped),
+    and nothing below the watermark becomes pending again (nothing
+    re-shipped)."""
+    base = str(tmp_path / "race")
+    log = ReplicationLog(base)
+    for i in range(6):
+        log.append(rl.OP_WRITE, 100 + i, 0, 32)
+    log.set_acked(3)
+    # The in-flight tick: records 4..6 were read and shipped; the ack
+    # has not landed yet when compact starts.
+    inflight = log.read_from(log.acked_seq + 1, 100)
+    assert [r.seq for r in inflight] == [4, 5, 6]
+    in_swap = threading.Event()
+    ack_done = threading.Event()
+    real_replace = os.replace
+
+    def barriered_replace(src, dst):
+        # Barrier only on the compacted-log swap (the watermark file
+        # uses os.replace too — an unguarded patch would deadlock the
+        # acker against itself).
+        if dst.endswith(".rlog") and not in_swap.is_set():
+            in_swap.set()
+            assert ack_done.wait(10), "acker never ran"
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(rl.os, "replace", barriered_replace)
+
+    def acker():
+        assert in_swap.wait(10)
+        log.set_acked(6)  # the receiver's ack for the in-flight batch
+        ack_done.set()
+
+    t = threading.Thread(target=acker)
+    t.start()
+    dropped = log.compact()
+    t.join(15)
+    assert not t.is_alive()
+    assert dropped == 3, "exactly the pre-ack acked prefix drops"
+    assert log.acked_seq == 6, "the concurrent ack must survive"
+    # Never re-ship: nothing above the watermark is a data record
+    # (only the vacuum marker compact appended).
+    tail = log.read_from(log.acked_seq + 1, 100)
+    assert all(r.op == rl.OP_VACUUM for r in tail)
+    assert log.pending() == len(tail)
+    # Never skip: every seq unacked when compact STARTED survived the
+    # swap (compact may retain acked records; it must not drop these).
+    seqs = {r.seq for r in log.read_from(1, 100)}
+    assert {4, 5, 6} <= seqs
+    # And the log still works: reopen sees the same durable state.
+    log.close()
+    log2 = ReplicationLog(base)
+    assert log2.acked_seq == 6
+    assert {4, 5, 6} <= {r.seq for r in log2.read_from(1, 100)}
+    log2.close()
+
+
+# -- flows cross-assert ------------------------------------------------------
+
+@pytest.mark.flows
+def test_compressed_ship_bytes_land_under_rlog_ship_budget(geo):
+    """The WAN spend the flow ledger meters for `rlog.ship` is the
+    COMPRESSED payload: ledger out-bytes for the shipper's node grow
+    by at least the acked wire bytes (and those are smaller than raw),
+    and a generous `-flows.budget rlog.ship=...` stays unbreached."""
+    ma, va, _mb, _vb, _tmp = geo
+    me = va.url()
+    _fl.LEDGER.set_budgets(_fl.parse_budgets("rlog.ship=8MB/s"))
+    try:
+        b0, o0 = _fl.LEDGER.totals(purpose_="rlog.ship",
+                                   direction="out", local=me)
+        w0 = va.shipper.shipped["wire_bytes"]
+        r0 = va.shipper.shipped["raw_bytes"]
+        vid, _fid, _col = _geo_put(ma, va,
+                                   b"budget geo payload " * 512)
+        _wait_shipped(va, vid)
+        b1, o1 = _fl.LEDGER.totals(purpose_="rlog.ship",
+                                   direction="out", local=me)
+        dwire = va.shipper.shipped["wire_bytes"] - w0
+        draw = va.shipper.shipped["raw_bytes"] - r0
+        assert 0 < dwire < draw, "compression must shrink the batch"
+        # The HTTP body carries the compressed stream (plus envelope):
+        # at least the wire bytes must be attributed to rlog.ship.
+        assert b1 - b0 >= dwire
+        assert o1 - o0 >= 1
+        st = _fl.LEDGER.budget_status(local=me).get("rlog.ship")
+        assert st is not None and st["limit_bps"] > 0
+        assert not st["breached"]
+    finally:
+        _fl.LEDGER.set_budgets({})
+
+
+# -- THE acceptance gate: split-brain fencing --------------------------------
+
+def test_split_brain_fencing_gate(geo):
+    """`wan.partition` armed during a forced lease contest: at no
+    point do both clusters ack a write for the same volume.
+
+    1. A mid-partition lease move fails CLOSED (drain timeout, lease
+       NOT moved) — the holder keeps committing, the peer keeps
+       forwarding.
+    2. A contested lease (the demote half of a move landed, the
+       acquire never crossed the partition) leaves NO holder: both
+       regions refuse writes with 503, nothing commits anywhere.
+    3. After heal, the runbook re-fences one holder at a bumped
+       epoch; the stranded backlog drains; a stale-epoch batch from
+       the fenced identity is refused with 409; a PROPER
+       drain-demote-acquire move then succeeds end to end."""
+    ma, va, mb, vb, _tmp = geo
+    base = b"fence base " * 32
+    vid, fid, col = _geo_put(ma, va, base)
+    _wait_shipped(va, vid)
+    _wait(lambda: vb.leases.get(vid) is not None, 10)
+
+    fault.arm("wan.partition", "fail*1000")
+    try:
+        # An acked write on the holder that can no longer ship: the
+        # drain below can never finish.
+        w1 = b"during partition " * 16
+        rpc.call(f"http://{va.url()}/{fid}", "POST", w1)
+        st, out = rpc.call_status(
+            f"http://{va.url()}/admin/lease/move", "POST",
+            json.dumps({"volume": vid, "to": "B",
+                        "timeout": 0.5}).encode())
+        assert st == 503
+        assert "NOT moved" in json.dumps(out)
+        assert va.leases.is_holder(vid), "a failed move must not demote"
+        assert va.leases.epoch(vid) == 1
+
+        # Force the contested mid-move window: A's sidecar says B@2
+        # (the demote), but B never heard the acquire (still A@1).
+        rpc.call_json(f"http://{va.url()}/admin/lease/acquire",
+                      payload={"volume": vid, "cluster_id": "B",
+                               "epoch": 2})
+        assert not va.leases.is_holder(vid)
+        assert not vb.leases.is_holder(vid)
+        # The drain attempt's partition failures tripped the per-host
+        # breakers; reset so the gate below sees lease verdicts, not
+        # breaker fast-fails (the partition itself stays armed).
+        resilience.reset_breakers()
+        # THE GATE: neither region acks a write now.  Each forwards
+        # to the cluster it believes holds the lease; the forward
+        # arrives marked geo=fwd at another non-holder and is refused
+        # — fail closed, no bouncing, no split brain.
+        st_a, _ = rpc.call_status(f"http://{va.url()}/{fid}", "POST",
+                                  b"split brain A " * 8)
+        st_b, _ = rpc.call_status(f"http://{vb.url()}/{fid}", "POST",
+                                  b"split brain B " * 8)
+        assert st_a >= 500, f"region A acked a contested write: {st_a}"
+        assert st_b >= 500, f"region B acked a contested write: {st_b}"
+        # Nothing committed anywhere: A still serves the pre-contest
+        # write, B never applied past the shipped base record.
+        assert WeedClient(ma.url()).download(fid) == w1
+        wm = vb._replication_watermark(vb.store.find_volume(vid))
+        assert wm.value == 1
+    finally:
+        fault.disarm_all()
+        resilience.reset_breakers()
+
+    # Heal.  Runbook: the side with stranded acked writes re-fences
+    # as holder at an epoch above anything either side saw; the other
+    # side fences to match.  The backlog then drains.
+    for node in (va, vb):
+        rpc.call_json(f"http://{node.url()}/admin/lease/acquire",
+                      payload={"volume": vid, "cluster_id": "A",
+                               "epoch": 3})
+    assert va.leases.is_holder(vid)
+    assert not vb.leases.is_holder(vid)
+    va.shipper.kick()
+    _wait_shipped(va, vid)
+    _wait(lambda: WeedClient(mb.url()).download(fid)
+          == b"during partition " * 16, 10,
+          "stranded partition-era write never reached region B")
+
+    # A batch from the fenced old identity (B@2 < A@3) is refused.
+    st, out = rpc.call_status(
+        f"http://{vb.url()}/admin/replication/apply", "POST",
+        json.dumps({"volume": vid, "collection": col,
+                    "cluster_id": "B", "epoch": 2,
+                    "records": []}).encode())
+    assert st == 409, f"stale-epoch batch admitted: {st} {out}"
+    assert "stale" in json.dumps(out)
+
+    # And a PROPER move (drain -> demote@4 -> peer acquire) succeeds.
+    out = rpc.call_json(f"http://{va.url()}/admin/lease/move",
+                        payload={"volume": vid, "to": "B",
+                                 "timeout": 10.0})
+    assert out["epoch"] == 4
+    assert out["peer_acquired"] is True
+    assert vb.leases.is_holder(vid)
+    assert not va.leases.is_holder(vid)
+    final = b"post-move final " * 16
+    rpc.call(f"http://{vb.url()}/{fid}", "POST", final)
+    _wait_shipped(vb, vid)
+    _wait(lambda: WeedClient(ma.url()).download(fid) == final, 10,
+          "post-move write never shipped back to region A")
+
+
+# -- acceptance: partition + heal => fsck map equality -----------------------
+
+def test_partition_heal_converges_fsck_map_equality(geo):
+    """Filer-level proof of byte-identical convergence: writes land
+    through a filer on region A, a partition strands one of them,
+    heal drains the backlog, and `volume.fsck -crc -json` through
+    BOTH masters returns the same per-volume needle map."""
+    ma, va, mb, _vb, _tmp = geo
+    filer = FilerServer(ma.url())
+    filer.start()
+    try:
+        rpc.call(f"{ma.url()}/vol/grow?count=2", "POST")
+        vids = []
+        for loc in va.store.locations:
+            for v in list(loc.volumes.values()):
+                if (v.collection or "") == "":
+                    if v.rlog is None:
+                        v.enable_rlog()
+                    if va.leases.get(v.vid) is None:
+                        rpc.call_json(
+                            f"http://{va.url()}/admin/lease/acquire",
+                            payload={"volume": v.vid})
+                    vids.append(v.vid)
+        assert vids, "default collection never grew on region A"
+        fp = FilerProxy(filer.url())
+        fp.put("/geo/one.bin", b"geo fsck one " * 128)
+        fault.arm("wan.partition", "fail*1000")
+        try:
+            fp.put("/geo/two.bin", b"geo fsck two " * 200)
+        finally:
+            fault.disarm_all()
+            resilience.reset_breakers()
+        va.shipper.kick()
+        _wait(lambda: all((_rlog_status(va, vid) or
+                           {"pending": 0})["pending"] == 0
+                          for vid in vids), 20,
+              "backlog never drained after heal")
+        env_a = CommandEnv(ma.url(), filer_url=filer.url())
+        env_b = CommandEnv(mb.url(), filer_url=filer.url())
+        try:
+            fa = json.loads(run_command(env_a,
+                                        "volume.fsck -crc -json"))
+            fb = json.loads(run_command(env_b,
+                                        "volume.fsck -crc -json"))
+        finally:
+            env_a.close()
+            env_b.close()
+        assert fa["volumes"] == fb["volumes"], \
+            "regions diverged after partition + heal"
+    finally:
+        filer.stop()
+
+
+# -- locality-steered reads --------------------------------------------------
+
+def test_locality_steering_on_lag_and_tenant_home(geo):
+    """/dir/lookup reordering: a B-held volume read through region A
+    serves the local mirrored replica while it is in-SLO, steers to
+    region B's replica when the mirror lag breaches the SLO, recovers
+    when the mirror catches up, and honors a tenant `home=` hint even
+    in-SLO.  Clients already re-lookup on 429/503, so this is
+    lookup-time only."""
+    ma, va, mb, vb, _tmp = geo
+    pay = b"steer me " * 64
+    vid, fid, _col = _geo_put(mb, vb, pay)
+    _wait_shipped(vb, vid)
+    _wait(lambda: rpc.call_status(
+        f"{ma.url()}/dir/lookup?volumeId={vid}")[0] == 200, 10,
+        "region A never learned the mirrored replica")
+    vb._send_heartbeat(full=True)
+    time.sleep(0.25)  # let region A's steer caches refresh to lag=0
+    doc = rpc.call(f"{ma.url()}/dir/lookup?volumeId={vid}")
+    assert doc["locations"][0]["url"] == va.url(), \
+        "in-SLO read must stay local"
+
+    vb.shipper.paused = True
+    try:
+        rpc.call(f"http://{vb.url()}/{fid}", "POST",
+                 b"stale now " * 64)
+
+        def lag_breached():
+            vb._send_heartbeat(full=True)
+            rows = {int(r["volume"]): r for r in rpc.call(
+                f"{mb.url()}/cluster/mirror").get("volumes", [])}
+            row = rows.get(vid)
+            return bool(row) and \
+                float(row.get("lag_seconds", 0) or 0) > 0.05
+        _wait(lag_breached, 10, "lag never breached the SLO")
+        time.sleep(0.25)  # region A's cached peer-mirror row expires
+        doc = rpc.call(f"{ma.url()}/dir/lookup?volumeId={vid}")
+        assert doc["locations"][0]["url"] == vb.url(), \
+            "out-of-SLO read must steer to the fresh replica"
+        assert any(loc["url"] == va.url()
+                   for loc in doc["locations"]), \
+            "steering reorders, it must not drop the local replica"
+    finally:
+        vb.shipper.paused = False
+    vb.shipper.kick()
+    _wait_shipped(vb, vid)
+    vb._send_heartbeat(full=True)
+    time.sleep(0.3)
+    doc = rpc.call(f"{ma.url()}/dir/lookup?volumeId={vid}")
+    assert doc["locations"][0]["url"] == va.url(), \
+        "recovered mirror must un-steer"
+
+    # Tenant home hint: pinned-to-B tenants read B even in-SLO.
+    ma.tenant_policy.rules.append(
+        QuotaRule(tenant="geo-steer-bob", home="B"))
+    try:
+        doc = rpc.call(f"{ma.url()}/dir/lookup?volumeId={vid}"
+                       f"&tenant=geo-steer-bob")
+        assert doc["locations"][0]["url"] == vb.url()
+        doc = rpc.call(f"{ma.url()}/dir/lookup?volumeId={vid}")
+        assert doc["locations"][0]["url"] == va.url()
+    finally:
+        ma.tenant_policy.rules = [
+            r for r in ma.tenant_policy.rules
+            if r.tenant != "geo-steer-bob"]
+
+
+# -- shell verbs + rollup surfaces -------------------------------------------
+
+def test_shell_lease_verbs_and_surfaces(geo):
+    """cluster.lease.ls / cluster.lease.move / cluster.mirror.status
+    -watch, plus the lease rollups in /cluster/mirror and
+    /cluster/healthz."""
+    ma, va, mb, vb, _tmp = geo
+    vid, _fid, _col = _geo_put(ma, va, b"shell lease " * 32)
+    _wait_shipped(va, vid)
+    va._send_heartbeat(full=True)
+    env = CommandEnv(ma.url())
+    try:
+        out = run_command(env, "cluster.lease.ls")
+        assert "this cluster: A" in out
+        assert str(vid) in out
+        assert "HOLDER" in out and "EPOCH" in out
+        out = run_command(env, "cluster.mirror.status")
+        assert "cluster: A" in out
+        assert "LEASE" in out
+        assert "A@e1" in out
+        # -watch with a poll budget returns (no endless loop to ^C).
+        out = run_command(env, "cluster.mirror.status -watch "
+                               "-interval 0.05 -count 1")
+        assert "LEASE" in out
+        # The move verb requires the operator lock, drains, then
+        # hands the lease to B at epoch 2.
+        run_command(env, "lock")
+        out = run_command(env, f"cluster.lease.move -volume {vid} "
+                               f"-to B")
+        assert "moved to cluster B at epoch 2" in out
+        run_command(env, "unlock")
+    finally:
+        env.close()
+    assert vb.leases.is_holder(vid)
+    assert not va.leases.is_holder(vid)
+    assert va.leases.epoch(vid) == 2
+    # healthz: info-only geo lease counters under the replication
+    # section (a remote-held lease is a fact, not a problem).
+    va._send_heartbeat(full=True)
+    _status, doc = rpc.call_status(f"{ma.url()}/cluster/healthz")
+    repl = doc["replication"]
+    assert repl["cluster_id"] == "A"
+    assert repl["leases"]["volumes"] >= 1
+    assert repl["leases"]["moving"] == 0

@@ -16,6 +16,39 @@ from seaweedfs_tpu.replication import (FileQueue, LocalSink, MemoryQueue,
 from seaweedfs_tpu.replication.sink import sink_for_spec
 
 
+def test_filer_event_plane_is_quarantined():
+    """The filer-event replication port (replicator/sink/notification)
+    is deliberately OUT of the package's supported surface: `__all__`
+    pins exactly the live change-log mirror + geo lease plane, while
+    the legacy names stay importable through lazy `__getattr__` (this
+    file exercises them above).  Growing `__all__` — or wiring the
+    quarantined modules into a server role — must consciously touch
+    this pin."""
+    import seaweedfs_tpu.replication as repl
+    assert repl.__all__ == ["LeaseTable", "ReplicationLog",
+                            "ReplicationShipper", "VolumeLease",
+                            "Watermark"]
+    # Lazy quarantine: importing the package in a fresh process does
+    # NOT import the legacy modules as a side effect (checked in a
+    # subprocess so this test can't disturb the shared module cache —
+    # this very file imported them eagerly above)...
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import seaweedfs_tpu.replication as r; "
+         "bad = [m for m in sys.modules if m.endswith(("
+         "'.replicator', '.sink', '.notification'))]; "
+         "assert not bad, bad; "
+         "assert r.Replicator is not None; "  # lazy resolve works
+         "print('quarantine-ok')"],
+        capture_output=True, text=True, timeout=60)
+    assert "quarantine-ok" in out.stdout, (out.stdout, out.stderr)
+    # ...and unknown names still raise through the lazy hook.
+    with pytest.raises(AttributeError):
+        repl.NoSuchName  # noqa: B018
+
+
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("repl")
