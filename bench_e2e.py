@@ -492,6 +492,41 @@ def multichip_row(n_devices: int = 8,
     log(f"wrote {out_path}: {tail.strip()}")
 
 
+def _emit_roofline() -> None:
+    """Device roofline columns from the passes above: the device
+    encode and the streamed wire-to-wire run both went through the
+    production call sites, so the process ledger already holds their
+    fenced kernel rows and pipeline occupancy — publish the headline
+    numbers (full table: BENCH_roofline_r01.json via
+    `python bench_schemes.py --roofline`)."""
+    try:
+        from seaweedfs_tpu.stats import roofline as rl
+        table = rl.LEDGER.kernel_table()
+        if not table:
+            return
+        cons = rl.LEDGER.conservation()
+        for row in table:
+            ach = row["achieved_p50"]
+            emit(f"roofline {row['kernel']} {row['codec']}/"
+                 f"{row['dtype']} {row['geometry']}",
+                 ach if ach is not None else 0.0,
+                 "fraction of probed roofline", None,
+                 f"{row['count']} fenced calls, {row['seconds']}s, "
+                 f"conservation "
+                 f"{'OK' if cons['ok'] else 'VIOLATED'}")
+        occ = rl.LEDGER.occupancy_summary()
+        for kind, ent in sorted(occ["latest"].items()):
+            if ent["fraction"] is None:
+                continue
+            emit(f"roofline {kind} pipeline device occupancy",
+                 ent["fraction"], "fraction", None,
+                 f"starved by {ent['starving_stage'] or '-'}"
+                 + (" [COLLAPSED]" if occ["collapsed"].get(kind)
+                    else ""))
+    except Exception as e:  # noqa: BLE001
+        log(f"roofline rollup skipped: {type(e).__name__}: {e}")
+
+
 def main() -> None:
     vol_mb = int(os.environ.get("BENCH_E2E_VOL_MB", "1024"))
     n = int(os.environ.get("BENCH_E2E_N", "20000"))
@@ -539,6 +574,8 @@ def main() -> None:
             multichip_row()
         except Exception as e:  # noqa: BLE001
             log(f"multichip row failed: {type(e).__name__}: {e}")
+
+    _emit_roofline()
 
     wr, rd = bench_weed_benchmark(n)
     emit("weed benchmark write req/s", wr["req_per_sec"], "req/s",

@@ -198,7 +198,18 @@ class MasterServer:
                 self._cluster_lifecycle_run)
         s.route("GET", "/cluster/tenants", self._cluster_tenants)
         s.route("GET", "/cluster/flows", self._cluster_flows)
+        s.route("GET", "/cluster/device", self._cluster_device)
         reg = s.enable_metrics("master")
+        # Device roofline instruments (process-global singletons): the
+        # master runs no EC kernels itself in the deployed topology,
+        # but in-process multi-role stacks do, and register_once keeps
+        # the scrape single-family either way.
+        from ..stats import roofline as _roofline
+        for m in (_roofline.kernel_seconds_total,
+                  _roofline.kernel_bytes_total,
+                  _roofline.kernel_work_total,
+                  _roofline.device_occupancy):
+            reg.register_once(m)
         # SLO plane: declared objectives drive the burn engine behind
         # /cluster/healthz; /debug/slow + /debug/slo expose exemplars
         # and live quantiles like on the other roles.
@@ -622,6 +633,12 @@ class MasterServer:
                 dn.flows = {"ts": time.time(), "rows": rows,
                             "budgets": hb["flows"].get("budgets", {}),
                             "gap": max(0, live - claimed)}
+            if "device" in hb:
+                # Device roofline rollup (absolute kernel rows +
+                # occupancy summary): replaced wholesale each beat,
+                # read by /cluster/device and the healthz
+                # occupancy-collapse warning.
+                dn.device = {"ts": time.time(), **hb["device"]}
             seq = hb.get("seq")
             if seq is not None:
                 # The epoch changes when the volume server restarts, so
@@ -1400,6 +1417,32 @@ class MasterServer:
                         f"budget — {st.get('rate_bps', 0):.0f} B/s "
                         f"sustained against a "
                         f"{st.get('limit_bps', 0):.0f} B/s limit")
+        # Device roofline: sustained pipeline-occupancy collapse on a
+        # node is a WARNING (like flow budgets) — a starved device
+        # wastes the accelerator but serves data fine, so it must
+        # never flip healthz to 503.
+        device_warnings = []
+        device_rows = []
+        for dn in leaves:
+            dev = getattr(dn, "device", None)
+            if not dev:
+                continue
+            occ = (dev.get("occupancy") or {})
+            for kind, row in sorted((occ.get("latest") or {}).items()):
+                device_rows.append(dict(row, node=dn.url(),
+                                        pipeline=kind))
+            for kind, bad in sorted((occ.get("collapsed")
+                                     or {}).items()):
+                if bad:
+                    latest = (occ.get("latest") or {}).get(kind, {})
+                    frac = latest.get("fraction")
+                    starving = latest.get("starving_stage") or "?"
+                    device_warnings.append(
+                        f"node {dn.url()}: {kind} pipeline device "
+                        f"occupancy collapsed"
+                        + (f" to {frac:.0%}" if frac is not None
+                           else "")
+                        + f" — starved by {starving}")
         # Geo lease rollup (info-only: a moving or remote-held lease
         # is a normal operating state, not a health problem — the
         # fencing failure mode is 409s on the ship path, and those
@@ -1429,7 +1472,9 @@ class MasterServer:
                            "warnings": tenancy_warnings,
                            "tenants": tenancy_rows},
                "flows": {"budgets": flow_budget_rows,
-                         "warnings": flows_warnings}}
+                         "warnings": flows_warnings},
+               "device": {"occupancy": device_rows,
+                          "warnings": device_warnings}}
         return not problems, doc
 
     def _cluster_mirror(self, query: dict, body: bytes) -> dict:
@@ -1629,6 +1674,78 @@ class MasterServer:
                 "conservation": {"paired_cells": paired,
                                  "ok": not violations,
                                  "violations": violations}}
+
+    def _cluster_device(self, query: dict, body: bytes) -> dict:
+        """GET /cluster/device — the device roofline rollup: every
+        node's heartbeat-carried kernel rows merged into one cluster
+        table keyed by (kernel, codec, dtype, geometry), per-node
+        pipeline occupancy with collapse verdicts, and this master's
+        own probed peaks.  ?codec= / ?kernel= filter the table."""
+        from ..stats import roofline as _roofline
+        if not self.is_leader():
+            return self._proxy_to_leader("/cluster/device", query,
+                                         body, "GET")
+        want_kernel = query.get("kernel", "")
+        if want_kernel:
+            _roofline.validate(want_kernel)
+        want_codec = query.get("codec", "")
+        with self.topo._lock:
+            leaves = list(self.topo.leaves())
+        nodes: dict[str, dict] = {}
+        merged: dict[tuple, dict] = {}
+        warnings: list[str] = []
+        for dn in leaves:
+            dev = getattr(dn, "device", None)
+            if not dev:
+                continue
+            occ = dev.get("occupancy") or {}
+            nodes[dn.url()] = {"ts": dev.get("ts"),
+                               "occupancy": occ,
+                               "kernels": dev.get("kernels", [])}
+            if occ.get("any_collapsed"):
+                slow = [k for k, v in
+                        (occ.get("collapsed") or {}).items() if v]
+                warnings.append(
+                    f"{dn.url()}: device occupancy collapsed on "
+                    f"{','.join(sorted(slow)) or 'pipeline'}")
+            for row in dev.get("kernels", []):
+                if want_kernel and row["kernel"] != want_kernel:
+                    continue
+                if want_codec and row["codec"] != want_codec:
+                    continue
+                key = (row["kernel"], row["codec"], row["dtype"],
+                       row["geometry"])
+                m = merged.setdefault(key, {
+                    "kernel": key[0], "codec": key[1],
+                    "dtype": key[2], "geometry": key[3], "count": 0,
+                    "seconds": 0.0, "bytes": 0, "work": 0,
+                    "achieved_p50": None, "nodes": 0})
+                m["count"] += row.get("count", 0)
+                m["seconds"] = round(
+                    m["seconds"] + row.get("seconds", 0.0), 6)
+                m["bytes"] += row.get("bytes", 0)
+                m["work"] += row.get("work", 0)
+                m["nodes"] += 1
+                p50 = row.get("achieved_p50")
+                if p50 is not None:
+                    # Worst node's median: the headline should surface
+                    # the laggard, not average it away.
+                    cur = m["achieved_p50"]
+                    m["achieved_p50"] = p50 if cur is None \
+                        else min(cur, p50)
+        # In-process multi-role stacks run kernels in the master
+        # process itself; fold the local ledger in under our own url.
+        local = _roofline.LEDGER.heartbeat_view()
+        if local["kernels"] and self.url() not in nodes:
+            nodes[self.url()] = {"ts": time.time(),
+                                 "occupancy": local["occupancy"],
+                                 "kernels": local["kernels"]}
+        table = sorted(merged.values(),
+                       key=lambda m: (-m["seconds"], m["kernel"]))
+        return {"ts": time.time(), "leader": self.url(),
+                "peaks": _roofline.probe_peaks(),
+                "nodes": nodes, "kernels": table,
+                "warnings": warnings}
 
     def _cluster_lifecycle(self, query: dict, body: bytes) -> dict:
         """GET /cluster/lifecycle — the daemon's rules, scan history,

@@ -760,6 +760,15 @@ class JsonHttpServer:
         # ledger + budget verdicts (admission-exempt via /debug/).
         self.route("GET", "/debug/flows", lambda q, b: _flows.debug_doc(
             f"{self.host}:{self.port}", self.flow_role))
+        # Device roofline (stats/roofline.py): per-kernel achieved
+        # fractions, pipeline occupancy, probed peaks — on every role
+        # (any process can run EC kernels in-process).
+        self.route("GET", "/debug/device", self._debug_device)
+
+    def _debug_device(self, query: dict, body) -> dict:
+        from ..stats import roofline as _roofline
+        return _roofline.debug_doc(f"{self.host}:{self.port}",
+                                   self.flow_role)
 
     def _debug_conns(self, query: dict, body) -> dict:
         """Per-connection state from the live registry: age, lane,

@@ -35,6 +35,7 @@ from ..events import emit as emit_event
 from ..fault import registry as _fault
 from ..codecs import get_codec
 from ..stats import flows as _flows
+from ..stats import roofline as _roofline
 from ..stats.metrics import (ec_repair_read_bytes_total,
                              needle_repairs_total, observe_ec_stage)
 from ..storage.scrub import ScrubDaemon
@@ -291,6 +292,10 @@ class VolumeServer:
         self.hot = HotKeyTracker()
         s.route("GET", "/debug/hot", self._debug_hot)
         s.route("GET", "/debug/tenants", self._debug_tenants)
+        # Device roofline plane (stats/roofline.py): per-kernel
+        # achieved-fraction table, pipeline occupancy gantts, probed
+        # peaks and device memory stats.
+        s.route("GET", "/debug/device", self._debug_device)
         s.route("GET", "/admin/volume_file", self._volume_file)
         s.route("POST", "/admin/copy_volume", self._copy_volume)
         s.route("POST", "/admin/mount", self._admin_mount)
@@ -412,6 +417,15 @@ class VolumeServer:
         # regression in tests/test_slo.py).
         reg.register_once(ec_stage_seconds)
         reg.register_once(ec_stage_bytes)
+        # Device roofline instruments (stats/roofline.py): per-kernel
+        # fenced seconds / analytic bytes / GF(2) work, plus the
+        # streamed-pipeline occupancy gauge — process-global
+        # singletons, register_once for the same promcheck reason.
+        for m in (_roofline.kernel_seconds_total,
+                  _roofline.kernel_bytes_total,
+                  _roofline.kernel_work_total,
+                  _roofline.device_occupancy):
+            reg.register_once(m)
         # Scrub + self-healing instruments (process-global singletons,
         # storage/scrub.py) on this server's scrape.
         from ..stats.metrics import (scrub_bytes_total,
@@ -566,6 +580,11 @@ class VolumeServer:
                     "budgets":
                         _flows.LEDGER.budget_status(local=self.url()),
                 },
+                # Device roofline rollup (stats/roofline.py): absolute
+                # per-kernel rows + pipeline occupancy summary — the
+                # master's /cluster/device and its occupancy-collapse
+                # healthz warning.
+                "device": _roofline.LEDGER.heartbeat_view(),
             }
             if self.shipper is not None:
                 # Per-volume replication lag (seq delta + seconds) +
@@ -1856,6 +1875,14 @@ class VolumeServer:
         out["node"] = self.url()
         out["admission"] = self.server.admission.snapshot()
         return out
+
+    def _debug_device(self, query: dict, body: bytes) -> dict:
+        """GET /debug/device — the device roofline plane: probed
+        peaks, per-kernel achieved-fraction table, recent invocations,
+        pipeline occupancy gantts with bubble attribution, the
+        analytic-vs-measured byte conservation verdict, and
+        jax.local_devices() memory stats."""
+        return _roofline.debug_doc(self.url(), "volume")
 
     def _ui(self, query: dict, body: bytes):
         """Status page (the reference's volume UI, server/volume_ui)."""

@@ -238,6 +238,14 @@ def main(argv: list[str] | None = None) -> int:
             flags.get("events.file.keep")
     if "events" in flags and not flags.get_bool("events", True):
         os.environ["SEAWEEDFS_TPU_EVENTS"] = "0"
+    # Device roofline kill switch (stats/roofline.py reads it at
+    # import and via set_armed): -roofline=false disarms per-kernel
+    # work accounting and the pipeline occupancy recorder — the
+    # disarmed path is a single flag check per kernel call.
+    if "roofline" in flags and not flags.get_bool("roofline", True):
+        os.environ["SEAWEEDFS_TPU_ROOFLINE"] = "0"
+        from ..stats import roofline
+        roofline.set_armed(False)
     # Wire-flow budget knobs (stats/flows.py reads these lazily):
     # -flows.budget declares per-purpose bandwidth ceilings
     # ("repair.fetch=50MB/s,rlog.ship=10MB/s" — 1024-based units,

@@ -154,6 +154,10 @@ TYPES: dict[str, str] = {
                    "probe) carried a stale epoch and was refused with "
                    "409 — the partitioned old holder's writes cannot "
                    "land",
+    "device.slow": "device roofline collapse: a streamed EC pipeline's "
+                   "device-occupancy fraction stayed below threshold "
+                   "for consecutive batch groups — attrs name the "
+                   "starving stage and bubble seconds",
 }
 
 SEVERITIES = ("info", "warn", "error")

@@ -31,8 +31,24 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..stats import roofline as _roofline
 from ..stats.metrics import observe_ec_stage
 from . import crc_fold
+
+
+def _record_roofline(kernel: str, coder, *, out_rows: int,
+                     in_rows: int, n: int, crc: bool,
+                     seconds: float, measured_bytes: int) -> None:
+    """Feed one execution-fenced kernel wall into the roofline ledger.
+    Accounting must never take the encode path down; the ARMED check
+    stays at the call site so the disarmed cost is one flag read."""
+    try:
+        _roofline.LEDGER.record(
+            kernel, coder.codec.name, coder.mm, out_rows=out_rows,
+            in_rows=in_rows, n=n, crc=crc, seconds=seconds,
+            measured_bytes=measured_bytes)
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _prof_on() -> bool:
@@ -316,10 +332,31 @@ class PallasCoder:
         padded = pad_to_block(n, self.block_n)
         if padded != n:
             data = jnp.pad(data, ((0, 0), (0, padded - n)))
+        if not _prof_on():
+            parity, partials = apply_bitmatrix_crc_pallas(
+                self._parity_pm, data, *consts, self.parity_shards,
+                self.data_shards, interpret=self.interpret,
+                block_n=self.block_n, mm=self.mm)
+            return parity[:, :n], partials
+        # Execution-fenced wall (fencing audit: this leg used to
+        # return unfenced async handles with no timing at all — a
+        # dispatch-only wall would flatter the fused kernel).
+        t0 = time.perf_counter()
         parity, partials = apply_bitmatrix_crc_pallas(
             self._parity_pm, data, *consts, self.parity_shards,
             self.data_shards, interpret=self.interpret,
             block_n=self.block_n, mm=self.mm)
+        parity = jax.block_until_ready(parity)
+        partials = jax.block_until_ready(partials)
+        dt = time.perf_counter() - t0
+        observe_ec_stage("encode_crc_kernel", dt, self.data_shards * n)
+        if _roofline.ARMED:
+            _record_roofline(
+                "encode_crc_kernel", self,
+                out_rows=self.parity_shards, in_rows=self.data_shards,
+                n=int(n), crc=True, seconds=dt,
+                measured_bytes=(self.data_shards
+                                + self.parity_shards) * int(n))
         return parity[:, :n], partials
 
     def encode(self, data) -> jax.Array:
@@ -332,8 +369,16 @@ class PallasCoder:
         t0 = time.perf_counter()
         out = jax.block_until_ready(
             self._apply(self._parity_pm, data, self.parity_shards))
-        observe_ec_stage("encode_kernel", time.perf_counter() - t0,
+        dt = time.perf_counter() - t0
+        observe_ec_stage("encode_kernel", dt,
                          data.shape[0] * data.shape[1])
+        if _roofline.ARMED:
+            n = int(data.shape[1])
+            _record_roofline(
+                "encode_kernel", self, out_rows=self.parity_shards,
+                in_rows=int(data.shape[0]), n=n, crc=False, seconds=dt,
+                measured_bytes=(int(data.shape[0])
+                                + self.parity_shards) * n)
         return out
 
     def encode_all(self, data) -> jax.Array:
@@ -365,8 +410,17 @@ class PallasCoder:
         t0 = time.perf_counter()
         rec = jax.block_until_ready(
             self._apply(mat_pm, stacked, len(wanted)))
-        observe_ec_stage("reconstruct_kernel", time.perf_counter() - t0,
+        dt = time.perf_counter() - t0
+        observe_ec_stage("reconstruct_kernel", dt,
                          stacked.shape[0] * stacked.shape[1])
+        if _roofline.ARMED:
+            n = int(stacked.shape[1])
+            _record_roofline(
+                "reconstruct_kernel", self, out_rows=len(wanted),
+                in_rows=int(stacked.shape[0]), n=n, crc=False,
+                seconds=dt,
+                measured_bytes=(int(stacked.shape[0])
+                                + len(wanted)) * n)
         return {w: rec[i] for i, w in enumerate(wanted)}
 
     def verify(self, shards) -> bool:

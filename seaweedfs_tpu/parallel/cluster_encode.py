@@ -53,9 +53,11 @@ from ..ec.encoder import (DEFAULT_CHUNK, _chunk_reader,
                           write_sorted_file_from_idx)
 from ..ec.volume_info import update_volume_info
 from ..ops import crc_fold
+from ..stats import roofline as _roofline
 from .cluster_rebuild import _pad_to, make_mesh
-from .sharded_codec import batched_encode, batched_encode_with_crc
-from .stream_pipeline import run_pipeline
+from .sharded_codec import (batched_encode, batched_encode_with_crc,
+                            record_fenced_batch)
+from .stream_pipeline import PipelineRecorder, run_pipeline
 
 # Column padding granularity — matches cluster_rebuild: keeps the
 # jitted matmul's N lane-aligned and divisible by any col axis <= 16,
@@ -321,6 +323,9 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
         buffers = _BufferPool(max(2, depth + 1),
                               (v_cap, DATA_SHARDS, n_cap),
                               cancel=cancel)
+        # Always-on (bounded) production recorder: per-batch stage
+        # spans feed the roofline plane's occupancy/gantt surfaces.
+        rec = PipelineRecorder(maxlen=1024) if _roofline.ARMED else None
         try:
             iters = [
                 _chunk_reader(d, os.path.getsize(b + ".dat"),
@@ -330,6 +335,7 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
 
             def produce():
                 active = list(range(len(iters)))
+                bi = 0
                 while active:
                     t_stack = time.perf_counter()
                     chunks, produced = [], []
@@ -348,23 +354,32 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
                     # yet) is pipeline idle time, not stacking work —
                     # keep it out of the batch_stack histogram or a
                     # device-bound run reads as stack-bound.
-                    t_wait = time.perf_counter()
+                    t_wait0 = time.perf_counter()
                     buf = buffers.acquire()
-                    t_wait = time.perf_counter() - t_wait
+                    t_wait1 = time.perf_counter()
+                    t_wait = t_wait1 - t_wait0
                     stacked = buf[:v_pad, :, :n_pad]
                     for j, c in enumerate(chunks):
                         stacked[j, :, :c.shape[1]] = c
                         stacked[j, :, c.shape[1]:] = 0
                     stacked[len(chunks):] = 0
+                    t_end = time.perf_counter()
                     observe_batch_stage(
                         stages, "batch_stack",
-                        time.perf_counter() - t_stack - t_wait,
+                        t_end - t_stack - t_wait,
                         sum(widths) * DATA_SHARDS)
-                    yield (buf, stacked, list(produced), widths)
+                    if rec is not None:
+                        # Two segments: the buffer-pool wait between
+                        # them is idle backpressure, not stack work.
+                        rec.note_span("stack", bi, t_stack, t_wait0)
+                        rec.note_span("stack", bi, t_wait1, t_end)
+                    yield (buf, stacked, list(produced), widths, bi)
+                    bi += 1
                     active = produced
 
             def dispatch(item):
-                buf, stacked, active, widths = item
+                buf, stacked, active, widths, bi = item
+                t_d0 = time.perf_counter()
                 if fused:
                     parity, crcs = batched_encode_with_crc(
                         stacked, mesh, codec=codec.name)
@@ -372,10 +387,15 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
                     parity = batched_encode(stacked, mesh,
                                             codec=codec.name)
                     crcs = None
-                return buf, parity, crcs, active, widths, stacked.nbytes
+                t_d1 = time.perf_counter()
+                if rec is not None:
+                    rec.note_span("dispatch", bi, t_d0, t_d1)
+                return (buf, parity, crcs, active, widths,
+                        stacked.nbytes, bi, t_d0, t_d1)
 
             def drain(handle):
-                buf, parity, crcs, active, widths, nbytes = handle
+                (buf, parity, crcs, active, widths, nbytes, bi,
+                 t_d0, t_d1) = handle
                 # np.asarray fences the dispatch (device->host copy):
                 # this stage is the EXPOSED device+transfer wait — with
                 # the pipeline overlapping, its per-batch sum exceeds
@@ -384,8 +404,23 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
                 parity = np.asarray(parity)
                 if crcs is not None:
                     crcs = np.asarray(crcs)
+                t_fence = time.perf_counter()
                 observe_batch_stage(stages, "batch_encode_device",
-                                    time.perf_counter() - t_dev, nbytes)
+                                    t_fence - t_dev, nbytes)
+                if rec is not None:
+                    # Device busy is observable only as [dispatch end,
+                    # drain fence]: includes q_out queueing, so it is
+                    # an upper bound on true kernel occupancy.
+                    rec.note_span("device", bi, t_d1, t_fence)
+                if _roofline.ARMED:
+                    record_fenced_batch(
+                        "batch_encode", codec.name,
+                        out_rows=int(parity.shape[1]),
+                        in_rows=DATA_SHARDS, n=int(parity.shape[2]),
+                        batch=int(parity.shape[0]),
+                        crc=crcs is not None,
+                        seconds=t_fence - t_d0,
+                        measured_bytes=int(nbytes) + parity.nbytes)
                 t_wr = time.perf_counter()
                 written = 0
                 for j, v in enumerate(active):
@@ -397,14 +432,19 @@ def _encode_batch_group_inner(env, mesh, pool, batch, chunk_size,
                         for sid in range(codec.total_shards):
                             vol_crcs[v][sid].extend(
                                 int(c) for c in crcs[j, sid, :nb])
+                t_wr1 = time.perf_counter()
                 observe_batch_stage(stages, "batch_write",
-                                    time.perf_counter() - t_wr, written)
+                                    t_wr1 - t_wr, written)
+                if rec is not None:
+                    rec.note_span("drain", bi, t_wr, t_wr1)
                 buffers.release(buf)
 
             run_pipeline(produce(), dispatch, drain, depth=depth,
-                         cancel=cancel)
+                         cancel=cancel, recorder=rec)
             for w in writers:
                 w.finish()
+            if rec is not None:
+                _roofline.LEDGER.note_pipeline("encode", rec)
         finally:
             for d in dats:
                 d.close()

@@ -32,12 +32,15 @@ from ..ec.shard_bits import ShardBits
 from ..events import emit as emit_event
 from ..fault import registry as _fault
 from ..stats import flows as _flows
+from ..stats import roofline as _roofline
 from ..stats.metrics import (ec_repair_read_bytes_total,
                              observe_batch_stage, stage_attrs)
 from ..trace import root_span
 from ..utils import env_float as _env_float
-from .sharded_codec import batched_reconstruct, batched_reconstruct_with_crc
-from .stream_pipeline import run_pipeline
+from .sharded_codec import (batched_reconstruct,
+                            batched_reconstruct_with_crc,
+                            record_fenced_batch)
+from .stream_pipeline import PipelineRecorder, run_pipeline
 
 # Column padding granularity: keeps the jitted matmul's N divisible by
 # the mesh col axis and lane-aligned (128 lanes) for any mesh <= 16 wide.
@@ -346,8 +349,13 @@ def _rebuild_group_inner(env, mesh, pool, picker, codec, present,
             f"{codec.data_shards} for RS)" \
         if len(used) < codec.data_shards else ""
 
+    # Always-on (bounded) production recorder: per-batch stage spans
+    # feed the roofline plane's occupancy/gantt surfaces.
+    rec = PipelineRecorder(maxlen=1024) if _roofline.ARMED else None
+
     def produce():
         i = 0
+        bi = 0
         while i < len(entries):
             # Probe the first volume's shard size to bound the
             # sub-batch.
@@ -382,14 +390,18 @@ def _rebuild_group_inner(env, mesh, pool, picker, codec, present,
                             f"({len(row)} vs {sizes[v]})")
                     stacked[v, r, :len(row)] = np.frombuffer(row,
                                                              np.uint8)
+            t_gend = time.perf_counter()
             observe_batch_stage(stages, "batch_gather",
-                                time.perf_counter() - t_gather,
-                                gathered)
-            yield (stacked, chunk, sizes)
+                                t_gend - t_gather, gathered)
+            if rec is not None:
+                rec.note_span("stack", bi, t_gather, t_gend)
+            yield (stacked, chunk, sizes, bi)
+            bi += 1
             i += chunk_v
 
     def dispatch(item):
-        stacked, chunk, sizes = item
+        stacked, chunk, sizes, bi = item
+        t_d0 = time.perf_counter()
         # Device CRCs for the rebuilt rows ride along when every shard
         # in the sub-batch covers whole `.ecc` blocks (they always do:
         # shard files are 1MB-block padded by construction).
@@ -402,17 +414,32 @@ def _rebuild_group_inner(env, mesh, pool, picker, codec, present,
                 stacked, present, missing, mesh,
                 matrix_kind=matrix_kind, codec=codec)
             crcs = None
-        return rebuilt, crcs, chunk, sizes, stacked.nbytes
+        t_d1 = time.perf_counter()
+        if rec is not None:
+            rec.note_span("dispatch", bi, t_d0, t_d1)
+        return (rebuilt, crcs, chunk, sizes, stacked.nbytes, bi,
+                t_d0, t_d1)
 
     def drain(handle):
-        rebuilt, crcs, chunk, sizes, nbytes = handle
+        rebuilt, crcs, chunk, sizes, nbytes, bi, t_d0, t_d1 = handle
         # np.asarray fences the dispatch — the EXPOSED device wait.
         t_dev = time.perf_counter()
         rebuilt = np.asarray(rebuilt)
         if crcs is not None:
             crcs = np.asarray(crcs)
+        t_fence = time.perf_counter()
         observe_batch_stage(stages, "batch_rebuild_device",
-                            time.perf_counter() - t_dev, nbytes)
+                            t_fence - t_dev, nbytes)
+        if rec is not None:
+            rec.note_span("device", bi, t_d1, t_fence)
+        if _roofline.ARMED:
+            record_fenced_batch(
+                "batch_reconstruct", codec.name,
+                out_rows=int(rebuilt.shape[1]),
+                in_rows=len(used), n=int(rebuilt.shape[2]),
+                batch=int(rebuilt.shape[0]), crc=crcs is not None,
+                seconds=t_fence - t_d0,
+                measured_bytes=int(nbytes) + rebuilt.nbytes)
         t_scatter = time.perf_counter()
         scattered = 0
         for v, (vid, locs) in enumerate(chunk):
@@ -438,10 +465,15 @@ def _rebuild_group_inner(env, mesh, pool, picker, codec, present,
                        + saved)
             if progress:
                 progress(out[-1])
+        t_send = time.perf_counter()
         observe_batch_stage(stages, "batch_scatter",
-                            time.perf_counter() - t_scatter, scattered)
+                            t_send - t_scatter, scattered)
+        if rec is not None:
+            rec.note_span("drain", bi, t_scatter, t_send)
 
-    run_pipeline(produce(), dispatch, drain, depth=depth)
+    run_pipeline(produce(), dispatch, drain, depth=depth, recorder=rec)
+    if rec is not None:
+        _roofline.LEDGER.note_pipeline("rebuild", rec)
     return out
 
 

@@ -51,6 +51,33 @@ def _mm_dtype():
     return jnp.bfloat16 if platform == "tpu" else jnp.float32
 
 
+def mm_name() -> str:
+    """Roofline dtype label for the batch paths' matmul dtype."""
+    return "bf16" if _mm_dtype() == jnp.bfloat16 else "f32"
+
+
+def record_fenced_batch(kernel: str, codec_name: str, *,
+                        out_rows: int, in_rows: int, n: int,
+                        batch: int, crc: bool, seconds: float,
+                        measured_bytes: int | None = None,
+                        node: str = "") -> None:
+    """Roofline record for a batched kernel invocation.  The batch
+    entry points above return ASYNC device arrays on purpose (fencing
+    inside dispatch would serialize the stream pipeline), so the
+    caller invokes this from its drain site, AFTER the host
+    materialization that fences the kernel — `seconds` must be the
+    fenced wall.  Callers gate on `roofline.ARMED` themselves so the
+    disarmed cost stays one flag read."""
+    try:
+        from ..stats import roofline as _roofline
+        _roofline.LEDGER.record(
+            kernel, codec_name, mm_name(), out_rows=out_rows,
+            in_rows=in_rows, n=n, batch=batch, crc=crc,
+            seconds=seconds, measured_bytes=measured_bytes, node=node)
+    except Exception:  # noqa: BLE001 — accounting never breaks encode
+        pass
+
+
 def _codec_of(data_shards: int, parity_shards: int, matrix_kind: str,
               codec):
     """Resolve the scheme: an explicit codec wins, else ad-hoc RS from

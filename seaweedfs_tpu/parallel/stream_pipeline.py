@@ -31,19 +31,30 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Iterable
 
 
 class PipelineRecorder:
-    """Thread-safe (event, index, t) log with an injectable clock.
+    """Thread-safe, bounded (event, index, t) log plus per-batch stage
+    spans, with an injectable clock.
+
+    Originally a test helper for the overlap regression; now also the
+    always-on production recorder inside cluster_encode/cluster_rebuild
+    (the device roofline plane's occupancy source).  Both stores are
+    bounded rings so an arbitrarily long streamed run holds constant
+    memory: transition events keep the overlap regression exact, and
+    `note_span()` feeds the gantt / device-occupancy / bubble readers.
 
     Tests inject a counter clock so event ordering is exact sequence
-    order; production leaves it None (events aren't recorded at all on
-    the hot path unless a recorder is passed)."""
+    order; production uses the default monotonic clock."""
 
-    def __init__(self, clock: Callable[[], float] | None = None):
+    def __init__(self, clock: Callable[[], float] | None = None,
+                 maxlen: int = 4096):
         self.clock = clock or time.monotonic
-        self._events: list[tuple[str, int, float]] = []
+        self._events: deque = deque(maxlen=maxlen)
+        # (stage, index, t0, t1) — stages: stack|dispatch|device|drain
+        self._spans: deque = deque(maxlen=maxlen)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
 
@@ -52,9 +63,123 @@ class PipelineRecorder:
             self._events.append((event, index, self.clock()))
             self._cond.notify_all()
 
+    def note_span(self, stage: str, index: int, t0: float,
+                  t1: float) -> None:
+        """One completed stage interval for batch `index` (caller's
+        clock values, so fenced device walls and injected test clocks
+        both work)."""
+        with self._lock:
+            self._spans.append((stage, index, float(t0), float(t1)))
+
     def events(self) -> list[tuple[str, int, float]]:
         with self._lock:
             return list(self._events)
+
+    def spans(self) -> list[tuple[str, int, float, float]]:
+        with self._lock:
+            return list(self._spans)
+
+    # -- occupancy / gantt read side ------------------------------------
+    # Everything below computes at read time from the bounded span ring
+    # — nothing here runs on the pipeline hot path.
+
+    def gantt(self, last: int = 8) -> list[dict]:
+        """Per-batch stage timeline for the most recent `last` batches:
+        [{"index": i, "stages": {stage: [t0, t1]}}] ordered by index.
+        A stage noted twice for one index keeps the widest interval."""
+        rows: dict[int, dict] = {}
+        for stage, i, t0, t1 in self.spans():
+            st = rows.setdefault(i, {})
+            if stage in st:
+                st[stage] = [min(st[stage][0], t0), max(st[stage][1], t1)]
+            else:
+                st[stage] = [t0, t1]
+        idxs = sorted(rows)[-last:]
+        return [{"index": i, "stages": rows[i]} for i in idxs]
+
+    @staticmethod
+    def _union(intervals: list[tuple[float, float]]) -> list[list[float]]:
+        merged: list[list[float]] = []
+        for t0, t1 in sorted(intervals):
+            if t1 <= t0:
+                continue
+            if merged and t0 <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], t1)
+            else:
+                merged.append([t0, t1])
+        return merged
+
+    def device_occupancy(self) -> dict:
+        """Fraction of the recorded window the device was busy (union
+        of `device` spans over [first span start, last span end]), plus
+        each stage's active share of the same window."""
+        spans = self.spans()
+        if not spans:
+            return {"window": None, "busy_seconds": 0.0,
+                    "fraction": None, "stages": {}}
+        lo = min(t0 for _s, _i, t0, _t1 in spans)
+        hi = max(t1 for _s, _i, _t0, t1 in spans)
+        window = max(hi - lo, 1e-12)
+        by_stage: dict[str, list] = {}
+        for stage, _i, t0, t1 in spans:
+            by_stage.setdefault(stage, []).append((t0, t1))
+        shares = {stage: round(sum(b - a for a, b in
+                                   self._union(iv)) / window, 6)
+                  for stage, iv in sorted(by_stage.items())}
+        busy = sum(b - a for a, b in
+                   self._union(by_stage.get("device", [])))
+        return {"window": [lo, hi],
+                "busy_seconds": round(busy, 9),
+                "fraction": round(busy / window, 6),
+                "stages": shares}
+
+    def bubble_attribution(self) -> dict:
+        """Where the device idled: gaps in the device-busy union are
+        attributed to whichever non-device stages were active during
+        the gap (the stage the device was waiting on); gap time no
+        stage covers is `idle`.  `starving_stage` names the biggest
+        contributor — the thing to widen next."""
+        spans = self.spans()
+        device = self._union([(t0, t1) for s, _i, t0, t1 in spans
+                              if s == "device"])
+        if not device:
+            return {"bubble_seconds": 0.0, "by_stage": {},
+                    "starving_stage": ""}
+        lo = min(t0 for _s, _i, t0, _t1 in spans)
+        hi = max(t1 for _s, _i, _t0, t1 in spans)
+        gaps: list[tuple[float, float]] = []
+        cur = lo
+        for a, b in device:
+            if a > cur:
+                gaps.append((cur, a))
+            cur = max(cur, b)
+        if hi > cur:
+            gaps.append((cur, hi))
+        others: dict[str, list[list[float]]] = {}
+        for s, _i, t0, t1 in spans:
+            if s != "device":
+                others.setdefault(s, []).append((t0, t1))
+        others = {s: self._union(iv) for s, iv in others.items()}
+        by_stage: dict[str, float] = {}
+        covered = 0.0
+        total = sum(b - a for a, b in gaps)
+        for g0, g1 in gaps:
+            for stage, iv in others.items():
+                ov = sum(min(b, g1) - max(a, g0) for a, b in iv
+                         if min(b, g1) > max(a, g0))
+                if ov > 0.0:
+                    by_stage[stage] = by_stage.get(stage, 0.0) + ov
+                    covered += ov
+        idle = total - min(covered, total)
+        if idle > 1e-12:
+            by_stage["idle"] = by_stage.get("idle", 0.0) + idle
+        starving = ""
+        if by_stage:
+            starving = max(sorted(by_stage), key=lambda s: by_stage[s])
+        return {"bubble_seconds": round(total, 9),
+                "by_stage": {s: round(v, 9)
+                             for s, v in sorted(by_stage.items())},
+                "starving_stage": starving}
 
     def seen(self, event: str, index: int) -> bool:
         with self._lock:

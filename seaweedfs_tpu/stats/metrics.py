@@ -332,8 +332,11 @@ ec_stage_bytes = Counter(
 
 def observe_ec_stage(stage: str, seconds: float, nbytes: int = 0) -> None:
     ec_stage_seconds.observe(seconds, stage=stage)
-    if nbytes:
-        ec_stage_bytes.inc(nbytes, stage=stage)
+    # Unconditional: a zero-byte observation must still materialize the
+    # stage's series (rate() over a family that only appears under load
+    # reads as a counter reset, and per-stage byte totals silently
+    # under-count stages whose first calls carry nbytes=0).
+    ec_stage_bytes.inc(nbytes, stage=stage)
     # Time-attribution: execution-fenced device legs observed while a
     # request ledger is active (a degraded read's EC reconstruction,
     # an inline repair's decode) land in that request's `device`

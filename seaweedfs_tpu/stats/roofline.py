@@ -1,0 +1,629 @@
+"""Device roofline plane: per-kernel work accounting and achieved
+fraction of the device's measured peaks.
+
+ROADMAP item 4 (XOR elimination, sparser RS realizations, deeper
+pipeline overlap) is gated on claims that need device-side evidence:
+"encode sits at N% of the shape ceiling" must come from measurement,
+not hand math.  This module is that evidence plane, the device-side
+sibling of the PR 9 time-attribution plane:
+
+- an analytic per-invocation cost model — bit-matrix geometry
+  (out_rows, in_rows, n, batch) -> bytes moved, GF(2) MACs,
+  arithmetic intensity — mirroring the `pl.CostEstimate` the Pallas
+  kernels declare (ops/coder_pallas.py);
+- a once-per-process `probe_peaks()` micro-bench (device matmul peak
+  per mm dtype, on-device memory bandwidth, H2D/D2H transfer, host
+  stream bandwidth), cached to disk keyed by backend + device kind so
+  a process restart does not re-pay the probe;
+- a bounded invocation ring + windowed achieved-fraction sketches
+  keyed by (kernel, codec, dtype, geometry), fed by every
+  execution-fenced kernel call (the fence is the caller's job — a
+  dispatch-only wall would flatter the kernel);
+- always-on pipeline occupancy: `cluster_encode`/`cluster_rebuild`
+  hand their per-batch stage spans (stack | dispatch | device | drain)
+  to `note_pipeline()`, which keeps recent gantts, publishes the
+  device-occupancy fraction, names the stage that starved the device,
+  and emits a `device.slow` event on sustained occupancy collapse.
+
+Like the other planes the kernel catalog is closed (recording an
+uncataloged kernel raises), the ledger is a process singleton with
+absolute rows (heartbeat rollup is idempotent), and the kill switch
+(`-roofline=false` / SEAWEEDFS_TPU_ROOFLINE=0) reduces every call
+site to one module-flag check.
+
+The conservation gate, in the spirit of the wire-flow plane: analytic
+bytes per invocation must match the ledger-measured bytes within
+max(1%, 4KB) — a cost model that drifts from what the kernels
+actually move is worse than no model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .metrics import Counter, Gauge
+from .sketch import WindowedSketch
+
+# -- arming ------------------------------------------------------------------
+# One module-level flag; disarmed call sites pay exactly this check
+# (same discipline as fault points and metered locks, asserted by
+# tests/test_roofline.py).
+
+ARMED = os.environ.get("SEAWEEDFS_TPU_ROOFLINE", "1") not in ("0", "false")
+
+
+def set_armed(on: bool) -> None:
+    global ARMED
+    ARMED = bool(on)
+
+
+# -- kernel catalog ----------------------------------------------------------
+# Closed set, like events/journal.py TYPES and flows.PURPOSES:
+# RooflineLedger.record() raises on anything not listed here, so a new
+# device kernel cannot ship without declaring itself (and getting a
+# cost model + tests).
+
+KERNELS = {
+    "encode_kernel":
+        "single-volume parity encode: bit-matrix apply on the stacked "
+        "data shards (ops/coder_pallas.py PallasCoder.encode)",
+    "encode_crc_kernel":
+        "fused encode + per-shard CRC32 fold in one device pass "
+        "(ops/coder_pallas.py PallasCoder.encode_with_crc)",
+    "reconstruct_kernel":
+        "decode-matrix apply rebuilding missing shards from survivors "
+        "(ops/coder_pallas.py PallasCoder.reconstruct)",
+    "batch_encode":
+        "multi-volume sharded encode on the device mesh "
+        "(parallel/sharded_codec.py batched_encode[_with_crc])",
+    "batch_reconstruct":
+        "multi-volume sharded rebuild on the device mesh "
+        "(parallel/sharded_codec.py batched_reconstruct[_with_crc])",
+}
+
+PIPELINE_STAGES = ("stack", "dispatch", "device", "drain")
+
+kernel_seconds_total = Counter(
+    "SeaweedFS_kernel_seconds_total",
+    "execution-fenced device kernel wall seconds",
+    ("kernel", "codec", "dtype"))
+
+kernel_bytes_total = Counter(
+    "SeaweedFS_kernel_bytes_total",
+    "analytic bytes moved by device kernels (cost-model bytes; the "
+    "conservation check pins these to ledger-measured bytes)",
+    ("kernel", "codec", "dtype"))
+
+kernel_work_total = Counter(
+    "SeaweedFS_kernel_work_total",
+    "analytic GF(2) MACs performed by device kernels",
+    ("kernel", "codec", "dtype"))
+
+device_occupancy = Gauge(
+    "SeaweedFS_device_occupancy",
+    "fraction of the streamed-pipeline window each stage kept the "
+    "device busy (stage=device is the occupancy headline; other "
+    "stages show where the wall went)",
+    ("stage",))
+
+
+def validate(kernel: str) -> str:
+    if kernel not in KERNELS:
+        raise ValueError(
+            f"unknown roofline kernel {kernel!r}; cataloged: "
+            f"{sorted(KERNELS)}")
+    return kernel
+
+
+# -- analytic cost model -----------------------------------------------------
+# The bit-matrix kernels multiply an (8*out_rows x 8*in_rows) GF(2)
+# matrix against 8*in_rows bit-rows of n bytes each: the same algebra
+# the Pallas kernel declares in its pl.CostEstimate
+# (ops/coder_pallas.py) — flops = 2 * (8*out) * (8*in) * n,
+# bytes = (in + out) * n.  The fused-CRC variant folds a second
+# (8*(in+out) x 32)-bit matrix over every input AND output row.
+
+
+def cost_model(out_rows: int, in_rows: int, n: int, *, batch: int = 1,
+               crc: bool = False) -> dict:
+    """Analytic work for one kernel invocation.
+
+    Returns bytes moved (read + written payload), GF(2) MACs (one MAC
+    = one AND+XOR bit op on a byte lane), flops (2*MACs, the matmul
+    convention the probe and the Pallas CostEstimate both use), and
+    arithmetic intensity (flops per byte)."""
+    b = int(batch)
+    nbytes = (in_rows + out_rows) * n * b
+    macs = 8 * out_rows * 8 * in_rows * n * b
+    if crc:
+        # CRC fold: 32 output bits from 8*(in+out) input bits, per
+        # byte column (matches the kernel's declared estimate).
+        macs += 8 * (in_rows + out_rows) * 32 * n * b
+    flops = 2 * macs
+    return {
+        "bytes": nbytes,
+        "macs": macs,
+        "flops": flops,
+        "intensity": flops / nbytes if nbytes else 0.0,
+    }
+
+
+def geometry_key(out_rows: int, in_rows: int, n: int,
+                 batch: int = 1) -> str:
+    if batch > 1:
+        return f"{out_rows}x{in_rows}x{n}b{batch}"
+    return f"{out_rows}x{in_rows}x{n}"
+
+
+# -- GF(2) work: dense vs post-elimination -----------------------------------
+# The bench publishes effective (post-elimination) XOR work beside the
+# dense count per codec, so matrix-scheduling work (arxiv 2108.02692,
+# arxiv 1312.5155) lands against an already-published baseline column.
+
+
+def dense_gf2_work(bitmatrix) -> int:
+    """XOR count of the naive schedule: each output bit-row of weight
+    w costs w-1 XORs (w ANDs are free against constant 0/1 entries)."""
+    import numpy as np
+    bm = (np.asarray(bitmatrix) & 1).astype(np.uint8)
+    weights = bm.sum(axis=1)
+    return int(np.maximum(weights.astype(np.int64) - 1, 0).sum())
+
+
+def effective_gf2_work(bitmatrix, max_rounds: int = 100000) -> int:
+    """XOR count after greedy common-subexpression elimination (Paar's
+    algorithm): repeatedly factor out the column pair shared by the
+    most output rows.  Deterministic (ties break to the smallest
+    pair), exact on the matrices we ship (tens of rows/columns)."""
+    import numpy as np
+    bm = (np.asarray(bitmatrix) & 1).astype(np.uint8)
+    rows = [set(np.flatnonzero(r).tolist()) for r in bm]
+    next_col = bm.shape[1]
+    extracted = 0
+    for _ in range(max_rounds):
+        counts: dict[tuple[int, int], int] = {}
+        for r in rows:
+            rs = sorted(r)
+            for i in range(len(rs)):
+                for j in range(i + 1, len(rs)):
+                    p = (rs[i], rs[j])
+                    counts[p] = counts.get(p, 0) + 1
+        if not counts:
+            break
+        best = max(counts.values())
+        if best < 2:
+            break
+        pair = min(p for p, c in counts.items() if c == best)
+        a, b = pair
+        for r in rows:
+            if a in r and b in r:
+                r.discard(a)
+                r.discard(b)
+                r.add(next_col)
+        next_col += 1
+        extracted += 1
+    return extracted + sum(max(len(r) - 1, 0) for r in rows)
+
+
+# -- peak probing ------------------------------------------------------------
+
+_PEAKS_VERSION = 2
+_PROBE_DTYPES = ("int8", "bf16")
+_peaks_lock = threading.Lock()
+_peaks: dict | None = None
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("SEAWEEDFS_TPU_ROOFLINE_CACHE", "")
+    if d:
+        return d
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "seaweedfs_tpu")
+
+
+def _cache_path(backend: str, kind: str) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                   for c in f"{backend}_{kind}")
+    return os.path.join(_cache_dir(), f"roofline_peaks_{safe}.json")
+
+
+def _best_of(fn, reps: int = 3) -> float:
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _probe_matmul(jnp, jax, dtype: str, m: int = 256) -> float:
+    """Measured matmul flops/s for one mm dtype (int8 accumulating to
+    int32, bf16 to f32 — the two dtypes PallasCoder dispatches)."""
+    if dtype == "int8":
+        a = jnp.ones((m, m), jnp.int8)
+        acc = jnp.int32
+    else:
+        a = jnp.ones((m, m), jnp.bfloat16)
+        acc = jnp.float32
+
+    @jax.jit
+    def mm(x, y):
+        return jax.lax.dot_general(
+            x, y, (((1,), (0,)), ((), ())), preferred_element_type=acc)
+
+    jax.block_until_ready(mm(a, a))  # compile outside the clock
+    t = _best_of(lambda: jax.block_until_ready(mm(a, a)))
+    return 2.0 * m ** 3 / max(t, 1e-9)
+
+
+def _probe_membw(jnp, jax, nbytes: int = 1 << 23) -> float:
+    """On-device streaming bandwidth: one read + one write pass."""
+    x = jnp.ones((nbytes,), jnp.uint8)
+
+    @jax.jit
+    def touch(v):
+        return v + 1
+
+    jax.block_until_ready(touch(x))
+    t = _best_of(lambda: jax.block_until_ready(touch(x)))
+    return 2.0 * nbytes / max(t, 1e-9)
+
+
+def _probe_transfers(np, jax, nbytes: int = 1 << 23) -> tuple:
+    host = np.ones(nbytes, np.uint8)
+    dev = jax.block_until_ready(jax.device_put(host))
+    h2d = nbytes / max(
+        _best_of(lambda: jax.block_until_ready(jax.device_put(host))),
+        1e-9)
+    d2h = nbytes / max(_best_of(lambda: np.asarray(dev)), 1e-9)
+    stream = 2.0 * nbytes / max(_best_of(host.copy), 1e-9)
+    return h2d, d2h, stream
+
+
+def probe_peaks(force: bool = False) -> dict:
+    """Once-per-process measured device peaks, disk-cached keyed by
+    (backend, device kind) so restarts skip the micro-bench.  Every
+    probe is best-of-3 with compile outside the clock; failures
+    degrade to a zeroed doc rather than taking the caller down."""
+    global _peaks
+    with _peaks_lock:
+        if _peaks is not None and not force:
+            return _peaks
+        try:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            backend = jax.default_backend()
+            devs = jax.local_devices()
+            kind = devs[0].device_kind if devs else "unknown"
+        except Exception:  # noqa: BLE001 — no usable device stack
+            _peaks = {"version": _PEAKS_VERSION, "backend": "none",
+                      "device_kind": "none", "matmul_flops": {},
+                      "membw_bps": 0.0, "h2d_bps": 0.0, "d2h_bps": 0.0,
+                      "host_stream_bps": 0.0, "error": "jax unavailable"}
+            return _peaks
+
+        path = _cache_path(backend, kind)
+        if not force:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)
+                if doc.get("version") == _PEAKS_VERSION:
+                    _peaks = doc
+                    return _peaks
+            except Exception:  # noqa: BLE001 — absent/stale cache
+                pass
+
+        t_start = time.perf_counter()
+        doc = {"version": _PEAKS_VERSION, "backend": backend,
+               "device_kind": kind, "matmul_flops": {},
+               "membw_bps": 0.0, "h2d_bps": 0.0, "d2h_bps": 0.0,
+               "host_stream_bps": 0.0}
+        try:
+            for dt in _PROBE_DTYPES:
+                doc["matmul_flops"][dt] = _probe_matmul(jnp, jax, dt)
+            doc["membw_bps"] = _probe_membw(jnp, jax)
+            h2d, d2h, stream = _probe_transfers(np, jax)
+            doc["h2d_bps"], doc["d2h_bps"] = h2d, d2h
+            doc["host_stream_bps"] = stream
+        except Exception as e:  # noqa: BLE001 — probes are best-effort
+            doc["error"] = f"{type(e).__name__}: {e}"
+        doc["probe_seconds"] = round(time.perf_counter() - t_start, 3)
+
+        try:
+            os.makedirs(_cache_dir(), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 — read-only home is fine
+            pass
+        _peaks = doc
+        return _peaks
+
+
+def roofline_floor_seconds(flops: float, nbytes: float,
+                           peaks: dict, dtype: str) -> float | None:
+    """The roofline lower bound on wall time: compute-limited OR
+    bandwidth-limited, whichever binds.  None when the probe failed
+    (an achieved fraction against a made-up peak is noise)."""
+    pf = (peaks.get("matmul_flops") or {}).get(dtype) or 0.0
+    bw = peaks.get("membw_bps") or 0.0
+    if pf <= 0.0 or bw <= 0.0:
+        return None
+    return max(flops / pf, nbytes / bw)
+
+
+# -- occupancy collapse detection --------------------------------------------
+
+_COLLAPSE_OCCUPANCY = 0.35  # device-busy fraction below this ...
+_COLLAPSE_STREAK = 3        # ... for this many consecutive batches
+_EMIT_EVERY = 5.0           # one device.slow event per this many s
+
+# -- the ledger --------------------------------------------------------------
+
+_RING_MAX = 256        # recent invocations kept for /debug/device
+_PIPELINES_MAX = 16    # recent pipeline occupancy docs
+_GANTT_LAST = 8        # batches of gantt carried per pipeline doc
+
+
+class RooflineLedger:
+    """Process-global per-kernel accounting: bounded invocation ring,
+    absolute per-series totals, windowed achieved-fraction sketches,
+    and recent pipeline-occupancy docs.
+
+    The clock is injected (tests advance sketch windows and collapse
+    streaks without sleeping); `record()` is the single kernel entry
+    point and `note_pipeline()` the single occupancy entry point."""
+
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=_RING_MAX)
+        # (kernel, codec, dtype, geometry) ->
+        #   [count, seconds, bytes, macs, WindowedSketch]
+        self._series: dict[tuple, list] = {}
+        self._pipelines: deque = deque(maxlen=_PIPELINES_MAX)
+        self._streak: dict[str, int] = {}
+        self._collapsed: dict[str, bool] = {}
+        self._last_emit = 0.0
+
+    # -- kernel records ---------------------------------------------
+
+    def record(self, kernel: str, codec: str, dtype: str, *,
+               out_rows: int, in_rows: int, n: int, batch: int = 1,
+               crc: bool = False, seconds: float,
+               measured_bytes: int | None = None,
+               node: str = "") -> dict:
+        """One execution-fenced kernel invocation.  The caller fences
+        (block_until_ready / host materialization) BEFORE stopping its
+        clock; this only does the bookkeeping."""
+        validate(kernel)
+        cost = cost_model(out_rows, in_rows, n, batch=batch, crc=crc)
+        geom = geometry_key(out_rows, in_rows, n, batch)
+        secs = max(float(seconds), 1e-9)
+
+        peaks = probe_peaks()
+        floor = roofline_floor_seconds(cost["flops"], cost["bytes"],
+                                       peaks, dtype)
+        achieved = None if floor is None else min(floor / secs, 1.0)
+
+        row = {"ts": round(self.clock(), 6), "kernel": kernel,
+               "codec": codec, "dtype": dtype, "geometry": geom,
+               "seconds": round(secs, 9), "bytes": cost["bytes"],
+               "macs": cost["macs"], "intensity":
+                   round(cost["intensity"], 3),
+               "achieved": None if achieved is None
+                   else round(achieved, 6),
+               "measured_bytes": measured_bytes, "node": node}
+        key = (kernel, codec, dtype, geom)
+        with self._lock:
+            self._ring.append(row)
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = [
+                    0, 0.0, 0, 0,
+                    WindowedSketch(min_value=1e-6, clock=self.clock)]
+            series[0] += 1
+            series[1] += secs
+            series[2] += cost["bytes"]
+            series[3] += cost["macs"]
+            if achieved is not None:
+                series[4].observe(achieved)
+
+        kernel_seconds_total.inc(secs, kernel=kernel, codec=codec,
+                                 dtype=dtype)
+        kernel_bytes_total.inc(cost["bytes"], kernel=kernel,
+                               codec=codec, dtype=dtype)
+        kernel_work_total.inc(cost["macs"], kernel=kernel, codec=codec,
+                              dtype=dtype)
+        return row
+
+    # -- pipeline occupancy -----------------------------------------
+
+    def note_pipeline(self, kind: str, recorder, node: str = "") -> dict:
+        """Fold one streamed run's recorder into the ledger: keep the
+        occupancy doc + recent gantt, publish the occupancy gauge, and
+        emit `device.slow` when the device-busy fraction stays
+        collapsed for _COLLAPSE_STREAK consecutive runs."""
+        occ = recorder.device_occupancy()
+        bubbles = recorder.bubble_attribution()
+        doc = {"ts": round(self.clock(), 6), "kind": kind,
+               "node": node, "occupancy": occ, "bubbles": bubbles,
+               "gantt": recorder.gantt(last=_GANTT_LAST)}
+        frac = occ.get("fraction")
+        with self._lock:
+            self._pipelines.append(doc)
+            collapsed = False
+            if frac is not None:
+                if frac < _COLLAPSE_OCCUPANCY:
+                    self._streak[kind] = self._streak.get(kind, 0) + 1
+                else:
+                    self._streak[kind] = 0
+                collapsed = self._streak[kind] >= _COLLAPSE_STREAK
+                self._collapsed[kind] = collapsed
+            now = self.clock()
+            should_emit = (collapsed
+                           and now - self._last_emit >= _EMIT_EVERY)
+            if should_emit:
+                self._last_emit = now
+
+        if frac is not None:
+            device_occupancy.set(frac, stage="device")
+            for stage, share in (occ.get("stages") or {}).items():
+                if stage != "device":
+                    device_occupancy.set(share, stage=stage)
+        if should_emit:
+            self._emit_slow(kind, node, frac, bubbles)
+        return doc
+
+    def _emit_slow(self, kind: str, node: str, frac: float,
+                   bubbles: dict) -> None:
+        try:
+            from ..events import emit
+            from ..trace import root_span
+            with root_span("device.slow", "roofline"):
+                emit("device.slow", node=node, severity="warn",
+                     pipeline=kind,
+                     occupancy=round(float(frac), 4),
+                     threshold=_COLLAPSE_OCCUPANCY,
+                     streak=self._streak.get(kind, 0),
+                     starving_stage=bubbles.get("starving_stage", ""),
+                     bubble_seconds=round(
+                         float(bubbles.get("bubble_seconds", 0.0)), 6))
+        except Exception:  # noqa: BLE001 — accounting must never
+            pass           # take the encode path down
+
+    # -- conservation -----------------------------------------------
+
+    def conservation(self) -> dict:
+        """Analytic bytes vs ledger-measured bytes, per invocation in
+        the ring, within max(1%, 4KB) — the cost-model correctness
+        gate (PR 16 wire-flow style)."""
+        checked = 0
+        violations = []
+        with self._lock:
+            rows = list(self._ring)
+        for row in rows:
+            mb = row.get("measured_bytes")
+            if mb is None:
+                continue
+            checked += 1
+            tol = max(0.01 * mb, 4096.0)
+            if abs(row["bytes"] - mb) > tol:
+                if len(violations) < 8:
+                    violations.append(
+                        {"kernel": row["kernel"],
+                         "geometry": row["geometry"],
+                         "analytic": row["bytes"], "measured": mb})
+        return {"ok": not violations, "checked": checked,
+                "violations": violations}
+
+    # -- read side ---------------------------------------------------
+
+    def kernel_table(self) -> list[dict]:
+        """Absolute per-series rollup (idempotent heartbeat rows)."""
+        with self._lock:
+            items = sorted(self._series.items())
+            out = []
+            for (kernel, codec, dtype, geom), s in items:
+                sk = s[4]
+                out.append({"kernel": kernel, "codec": codec,
+                            "dtype": dtype, "geometry": geom,
+                            "count": s[0],
+                            "seconds": round(s[1], 6),
+                            "bytes": s[2], "work": s[3],
+                            "achieved_p50": _rq(sk, 0.5),
+                            "achieved_p95": _rq(sk, 0.95)})
+        return out
+
+    def recent(self, n: int = 32) -> list[dict]:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def pipelines(self, n: int = 4) -> list[dict]:
+        with self._lock:
+            return list(self._pipelines)[-n:]
+
+    def occupancy_summary(self) -> dict:
+        """Latest occupancy per pipeline kind + the collapse verdicts
+        the healthz warning keys on."""
+        with self._lock:
+            docs = list(self._pipelines)
+            collapsed = dict(self._collapsed)
+            streaks = dict(self._streak)
+        latest: dict[str, dict] = {}
+        for doc in docs:
+            occ = doc.get("occupancy") or {}
+            latest[doc["kind"]] = {
+                "fraction": occ.get("fraction"),
+                "starving_stage":
+                    (doc.get("bubbles") or {}).get("starving_stage", ""),
+                "ts": doc.get("ts")}
+        return {"latest": latest, "collapsed": collapsed,
+                "streaks": streaks,
+                "any_collapsed": any(collapsed.values())}
+
+    def heartbeat_view(self) -> dict:
+        """What a volume server ships under hb["device"]: absolute
+        kernel rows (merge is idempotent) + the occupancy summary."""
+        return {"kernels": self.kernel_table(),
+                "occupancy": self.occupancy_summary()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._series.clear()
+            self._pipelines.clear()
+            self._streak.clear()
+            self._collapsed.clear()
+            self._last_emit = 0.0
+
+
+def _rq(sketch, q: float):
+    v = sketch.quantile(q)
+    return None if v is None else round(v, 6)
+
+
+LEDGER = RooflineLedger()
+
+
+def _device_memory_stats() -> list[dict]:
+    """jax.local_devices() memory stats, best-effort (CPU backends
+    usually expose nothing)."""
+    out = []
+    try:
+        import jax
+        for d in jax.local_devices():
+            row = {"id": d.id, "kind": d.device_kind,
+                   "platform": d.platform}
+            try:
+                ms = d.memory_stats()
+                if ms:
+                    row["bytes_in_use"] = ms.get("bytes_in_use")
+                    row["bytes_limit"] = ms.get("bytes_limit")
+            except Exception:  # noqa: BLE001 — not all backends
+                pass
+            out.append(row)
+    except Exception:  # noqa: BLE001 — no jax, no rows
+        pass
+    return out
+
+
+def debug_doc(node: str, role: str) -> dict:
+    """GET /debug/device payload: measured peaks, the per-kernel
+    roofline table, recent invocations, recent pipeline gantts with
+    bubble attribution, the conservation verdict, and device memory
+    stats."""
+    return {"node": node, "role": role, "armed": ARMED,
+            "peaks": probe_peaks(),
+            "kernels": LEDGER.kernel_table(),
+            "recent": LEDGER.recent(16),
+            "pipelines": LEDGER.pipelines(4),
+            "occupancy": LEDGER.occupancy_summary(),
+            "conservation": LEDGER.conservation(),
+            "devices": _device_memory_stats()}

@@ -842,6 +842,21 @@ def _drive_lease_fence(cl):
         vs.stop()
 
 
+def _drive_device_slow(cl):
+    """Collapse through the real ledger path: three consecutive
+    streamed runs whose device-occupancy fraction sits at 10% (device
+    busy 1s of a 10s window, starved by dispatch) trip the streak and
+    emit through note_pipeline's own rate-limited site."""
+    from seaweedfs_tpu.parallel.stream_pipeline import PipelineRecorder
+    from seaweedfs_tpu.stats.roofline import RooflineLedger
+    ledger = RooflineLedger(clock=lambda: 100.0)
+    rec = PipelineRecorder(clock=lambda: 0.0)
+    rec.note_span("dispatch", 0, 0.0, 9.0)
+    rec.note_span("device", 0, 9.0, 10.0)
+    for _ in range(3):
+        ledger.note_pipeline("encode", rec, node="evdev:0")
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -887,6 +902,7 @@ DRIVERS = {
     "lease.acquire": _drive_lease_acquire,
     "lease.move": _drive_lease_move,
     "lease.fence": _drive_lease_fence,
+    "device.slow": _drive_device_slow,
 }
 
 
@@ -902,8 +918,9 @@ def test_driver_catalog_matches_registry():
     # lag/cutover + 3 data-lifecycle types: lifecycle.tier/promote +
     # volume.expired + 2 tenancy types: quota.exceeded +
     # tenant.throttled + 1 wire-flow type: flows.budget + 3 geo lease
-    # types: lease.acquire/move/fence).
-    assert len(TYPES) == 44
+    # types: lease.acquire/move/fence + 1 device roofline type:
+    # device.slow).
+    assert len(TYPES) == 45
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))

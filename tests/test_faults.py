@@ -422,7 +422,11 @@ def _drive_wan_reorder(cl):
     from seaweedfs_tpu.core.needle import Needle
     from seaweedfs_tpu.stats.metrics import replication_resends_total
     _master, servers, stub, _client = cl
-    vs = servers[0]
+    # Any server with a spare volume slot: earlier drivers' assigns
+    # grow 7 single-copy + paired 001 volumes with RANDOM node
+    # placement, which can fill one (never both) of the two 7-slot
+    # stores before this driver runs.
+    vs = next(s for s in servers if s.store.free_location())
     vid = 7777
     v = vs.store.add_volume(vid, "reordercol", "000", "")
     v.enable_rlog()

@@ -338,9 +338,14 @@ def test_disk_reserve_flips_readonly_and_master_steers(tmp_path):
         status, doc = rpc.call_status(
             f"{master.url()}/cluster/healthz")
         assert status == 200, doc["problems"]
-        # ...and the master assigns to the recovered node again.
-        seen = {rpc.call(f"{master.url()}/dir/assign")["url"]
-                for _ in range(20)}
+        # ...and the master assigns to the recovered node again.  The
+        # pick among writable volumes is random, so sample until the
+        # recovered node shows up (a fixed 20-draw sample can miss a
+        # minority holder on a slow 1-core host).
+        deadline = time.monotonic() + 10
+        seen = set()
+        while low.url() not in seen and time.monotonic() < deadline:
+            seen.add(rpc.call(f"{master.url()}/dir/assign")["url"])
         assert low.url() in seen, seen
     finally:
         for vs in servers:

@@ -232,7 +232,18 @@ def test_master_ha_volume_id_consensus_across_failover(ha_cluster):
         time.sleep(0.2)
     assert list(new_leader.topo.leaves()), \
         "volume server did not follow the new leader"
-    rpc.call(new_leader.url() + "/dir/assign?count=1")
+    # The node row can precede its full beat's volume list: an assign
+    # in that window sees zero active volumes on a full store and
+    # 406s ("cannot grow") — retry until the re-registration lands.
+    deadline = time.monotonic() + 20
+    while True:
+        try:
+            rpc.call(new_leader.url() + "/dir/assign?count=1")
+            break
+        except rpc.RpcError as e:
+            if e.status != 406 or time.monotonic() >= deadline:
+                raise
+            time.sleep(0.2)
     # Consensus guarantees no id reuse after failover: the new leader's
     # high-water mark covers every id the old leader issued, and a
     # forced grow issues a strictly greater id.

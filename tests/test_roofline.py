@@ -1,0 +1,540 @@
+"""Device roofline plane (stats/roofline.py + the streamed-pipeline
+occupancy recorder).
+
+Covers ISSUE 18's acceptance gates: the kernel catalog is closed and
+anti-rot tested, the analytic cost model matches the Pallas
+CostEstimate algebra exactly, probe_peaks() is disk-cached keyed by
+backend/device kind (a tampered cache is believed, proving no
+re-probe), achieved fractions land in bounded rings with windowed
+sketches, the conservation check pins analytic bytes to
+ledger-measured bytes within max(1%, 4KB), PipelineRecorder survives
+production duty (bounded overflow, concurrent writers, exact
+injected-clock gantt/occupancy/bubble math), sustained occupancy
+collapse emits a rate-limited device.slow event, the disarmed path is
+a single flag check (the record hook is provably never reached), a
+deliberately slow fence is included in the reported kernel wall
+(execution-fencing regression), nbytes=0 observations still
+materialize the ec_stage_bytes series, and the four new instruments
+scrape promcheck-clean on master and volume server of a live cluster
+with /debug/device, /cluster/device, healthz, and cluster.roofline
+all agreeing."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.cluster import rpc
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.events.journal import JOURNAL
+from seaweedfs_tpu.ops import coder_pallas
+from seaweedfs_tpu.ops.coder_pallas import PallasCoder
+from seaweedfs_tpu.parallel.stream_pipeline import PipelineRecorder
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.stats import metrics, roofline
+from seaweedfs_tpu.stats.promcheck import validate_exposition
+
+pytestmark = pytest.mark.roofline
+
+
+FAKE_PEAKS = {"version": roofline._PEAKS_VERSION, "backend": "fake",
+              "device_kind": "fake",
+              "matmul_flops": {"int8": 1e9, "bf16": 1e9, "f32": 1e9},
+              "membw_bps": 1e8, "h2d_bps": 1e9, "d2h_bps": 1e9,
+              "host_stream_bps": 1e9, "probe_seconds": 0.0}
+
+
+@pytest.fixture
+def fake_peaks(monkeypatch):
+    """Deterministic peaks: achieved fractions become exact algebra
+    instead of hardware-dependent measurements."""
+    monkeypatch.setattr(roofline, "_peaks", dict(FAKE_PEAKS))
+
+
+# -- catalog + cost model ----------------------------------------------------
+
+def test_kernel_catalog_anti_rot():
+    """Closed catalog, like events TYPES and flows PURPOSES: exactly
+    the documented kernels exist, each validates and has a
+    description; anything else raises at the record site."""
+    expected = {"encode_kernel", "encode_crc_kernel",
+                "reconstruct_kernel", "batch_encode",
+                "batch_reconstruct"}
+    assert set(roofline.KERNELS) == expected
+    for k in roofline.KERNELS:
+        assert roofline.validate(k) == k
+        assert roofline.KERNELS[k], f"kernel {k} has no description"
+    for bad in ("encode", "", "ENCODE_KERNEL", "matmul"):
+        with pytest.raises(ValueError):
+            roofline.validate(bad)
+    ledger = roofline.RooflineLedger()
+    with pytest.raises(ValueError):
+        ledger.record("matmul", "rs", "int8", out_rows=4, in_rows=10,
+                      n=64, seconds=0.1)
+    assert roofline.PIPELINE_STAGES == ("stack", "dispatch", "device",
+                                        "drain")
+
+
+def test_cost_model_algebra():
+    """The analytic model IS the Pallas CostEstimate algebra: bytes =
+    (in+out)*n, macs = 8*out * 8*in * n, CRC folds 8*(in+out)*32*n
+    more, flops = 2*macs, everything linear in batch."""
+    c = roofline.cost_model(4, 10, 4096)
+    assert c["bytes"] == 14 * 4096
+    assert c["macs"] == 8 * 4 * 8 * 10 * 4096
+    assert c["flops"] == 2 * c["macs"]
+    assert c["intensity"] == pytest.approx(c["flops"] / c["bytes"])
+
+    crc = roofline.cost_model(4, 10, 4096, crc=True)
+    assert crc["bytes"] == c["bytes"]
+    assert crc["macs"] == c["macs"] + 8 * 14 * 32 * 4096
+
+    b = roofline.cost_model(4, 10, 4096, batch=3)
+    assert b["bytes"] == 3 * c["bytes"]
+    assert b["macs"] == 3 * c["macs"]
+
+    assert roofline.geometry_key(4, 10, 4096) == "4x10x4096"
+    assert roofline.geometry_key(4, 10, 4096, batch=8) == "4x10x4096b8"
+
+
+def test_gf2_work_dense_vs_effective():
+    """Paar elimination on a hand case: rows {a,b,c} and {a,b,d} cost
+    4 dense XORs but 3 after factoring the shared (a,b) pair; on the
+    real rs(10,4) parity bit-matrix elimination must win big (the
+    bench's baseline column, arxiv 2108.02692 territory)."""
+    m = np.array([[1, 1, 1, 0],
+                  [1, 1, 0, 1]], np.uint8)
+    assert roofline.dense_gf2_work(m) == 4
+    assert roofline.effective_gf2_work(m) == 3
+    # A weight-1 row costs zero XORs in both schedules.
+    assert roofline.dense_gf2_work(np.eye(4, dtype=np.uint8)) == 0
+    assert roofline.effective_gf2_work(np.eye(4, dtype=np.uint8)) == 0
+
+    bm = np.asarray(PallasCoder(10, 4).codec.parity_bitmatrix())
+    dense = roofline.dense_gf2_work(bm)
+    eff = roofline.effective_gf2_work(bm)
+    assert 0 < eff < dense
+
+
+# -- peak probing ------------------------------------------------------------
+
+def test_probe_peaks_disk_cache(tmp_path, monkeypatch):
+    """One real probe writes the cache; a process 'restart' (module
+    memo cleared) must read the file back instead of re-probing — a
+    tampered sentinel value coming back proves no re-measurement."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_ROOFLINE_CACHE", str(tmp_path))
+    monkeypatch.setattr(roofline, "_peaks", None)
+    doc = roofline.probe_peaks(force=True)
+    assert doc["version"] == roofline._PEAKS_VERSION
+    assert doc["backend"] not in ("", "none")
+    assert doc["matmul_flops"].get("int8", 0) > 0
+    assert doc["membw_bps"] > 0
+    path = roofline._cache_path(doc["backend"], doc["device_kind"])
+    with open(path, encoding="utf-8") as f:
+        on_disk = json.load(f)
+    assert on_disk["membw_bps"] == doc["membw_bps"]
+
+    on_disk["membw_bps"] = 123456.0
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(on_disk, f)
+    monkeypatch.setattr(roofline, "_peaks", None)
+    assert roofline.probe_peaks()["membw_bps"] == 123456.0
+    # The memo serves every later call without touching disk again.
+    assert roofline.probe_peaks()["membw_bps"] == 123456.0
+
+
+def test_roofline_floor(fake_peaks):
+    """max(compute floor, bandwidth floor); None when the peak is
+    missing or zeroed (a fraction against a made-up peak is noise)."""
+    peaks = roofline.probe_peaks()
+    assert roofline.roofline_floor_seconds(
+        2e9, 1e6, peaks, "int8") == pytest.approx(2.0)
+    assert roofline.roofline_floor_seconds(
+        1e6, 1e9, peaks, "int8") == pytest.approx(10.0)
+    assert roofline.roofline_floor_seconds(
+        1e6, 1e6, peaks, "fp4") is None
+    assert roofline.roofline_floor_seconds(
+        1e6, 1e6, {"matmul_flops": {}, "membw_bps": 0.0},
+        "int8") is None
+
+
+# -- the ledger --------------------------------------------------------------
+
+def test_ledger_ring_bounded_sketches_and_conservation(fake_peaks):
+    """300 records: the ring holds the newest 256, the series totals
+    stay absolute (heartbeat merge is idempotent), achieved fractions
+    are exact against the fake peaks, and conservation flags exactly
+    the row whose measured bytes drifted past max(1%, 4KB)."""
+    t = [1000.0]
+    ledger = roofline.RooflineLedger(clock=lambda: t[0])
+    cost = roofline.cost_model(4, 10, 4096)
+    floor = roofline.roofline_floor_seconds(
+        cost["flops"], cost["bytes"], FAKE_PEAKS, "int8")
+    for _ in range(300):
+        t[0] += 0.01
+        row = ledger.record(
+            "encode_kernel", "rs", "int8", out_rows=4, in_rows=10,
+            n=4096, seconds=floor * 2, measured_bytes=cost["bytes"])
+    assert row["achieved"] == pytest.approx(0.5)
+    assert row["geometry"] == "4x10x4096"
+    assert len(ledger.recent(1000)) == roofline._RING_MAX
+
+    table = ledger.kernel_table()
+    assert len(table) == 1
+    assert table[0]["count"] == 300
+    assert table[0]["seconds"] == pytest.approx(300 * floor * 2,
+                                                rel=1e-3)
+    assert table[0]["bytes"] == 300 * cost["bytes"]
+    assert table[0]["work"] == 300 * cost["macs"]
+    assert table[0]["achieved_p50"] == pytest.approx(0.5, rel=0.15)
+
+    cons = ledger.conservation()
+    assert cons["ok"] and cons["checked"] == roofline._RING_MAX
+
+    # Off-by-more-than-tolerance measured bytes: the model drifted.
+    ledger.record("encode_kernel", "rs", "int8", out_rows=4,
+                  in_rows=10, n=4096, seconds=0.1,
+                  measured_bytes=cost["bytes"] * 2)
+    cons = ledger.conservation()
+    assert not cons["ok"]
+    assert cons["violations"][0]["kernel"] == "encode_kernel"
+
+    # An achieved fraction never exceeds 1.0 (a kernel can't beat the
+    # roofline; measurement jitter must not report that it did).
+    fast = ledger.record("encode_kernel", "rs", "int8", out_rows=4,
+                         in_rows=10, n=4096, seconds=floor / 10)
+    assert fast["achieved"] == 1.0
+
+
+def test_real_encode_records_and_conserves(fake_peaks):
+    """The PallasCoder call sites feed the process ledger with
+    measured bytes equal to the analytic payload — conservation by
+    construction, checked against a real (interpret-mode) encode,
+    fused-CRC encode, and reconstruct."""
+    roofline.LEDGER.reset()
+    roofline.set_armed(True)
+    try:
+        pc = PallasCoder(4, 2)
+        data = np.arange(4 * 2048, dtype=np.uint8).reshape(4, 2048)
+        parity = np.asarray(pc.encode(data))
+        assert parity.shape == (2, 2048)
+        pc.encode_with_crc(data)
+        shards = {i: data[i] for i in range(4)}
+        shards[4] = parity[0]
+        pc.reconstruct({k: v for k, v in shards.items() if k != 0},
+                       wanted=[0])
+        kinds = {r["kernel"] for r in roofline.LEDGER.recent()}
+        assert {"encode_kernel", "encode_crc_kernel",
+                "reconstruct_kernel"} <= kinds
+        cons = roofline.LEDGER.conservation()
+        assert cons["ok"], cons["violations"]
+        assert cons["checked"] >= 3
+    finally:
+        roofline.LEDGER.reset()
+
+
+def test_disarmed_path_is_one_flag_check(monkeypatch):
+    """-roofline=false reduces every call site to the ARMED check: a
+    booby-trapped record hook proves the accounting code is never
+    reached, and the kernels still run."""
+    def boom(*a, **k):
+        raise AssertionError("roofline hook reached while disarmed")
+
+    monkeypatch.setattr(coder_pallas, "_record_roofline", boom)
+    monkeypatch.setattr(roofline.RooflineLedger, "record", boom)
+    roofline.set_armed(False)
+    try:
+        pc = PallasCoder(4, 2)
+        data = np.ones((4, 1024), np.uint8)
+        out = np.asarray(pc.encode(data))
+        assert out.shape == (2, 1024)
+        pc.encode_with_crc(data)
+    finally:
+        roofline.set_armed(True)
+
+
+def test_fencing_includes_device_wait(fake_peaks, monkeypatch):
+    """Execution-fencing regression: when the fence itself takes 50ms
+    (modeling in-flight device work at block_until_ready time), the
+    recorded kernel wall must include it.  A timer stopped before the
+    fence — the async-dispatch flattery bug — fails here."""
+    roofline.LEDGER.reset()
+    roofline.set_armed(True)
+    real_fence = coder_pallas.jax.block_until_ready
+
+    def slow_fence(x):
+        time.sleep(0.05)
+        return real_fence(x)
+
+    monkeypatch.setattr(coder_pallas.jax, "block_until_ready",
+                        slow_fence)
+    try:
+        PallasCoder(4, 2).encode(np.ones((4, 1024), np.uint8))
+        rows = [r for r in roofline.LEDGER.recent()
+                if r["kernel"] == "encode_kernel"]
+        assert rows, "encode never recorded"
+        assert rows[-1]["seconds"] >= 0.05
+    finally:
+        roofline.LEDGER.reset()
+
+
+def test_observe_ec_stage_counts_zero_bytes():
+    """Satellite fix: nbytes=0 observations must still materialize the
+    stage's ec_stage_bytes series (a family that only appears under
+    byte-carrying load reads as a counter reset in rate() and silently
+    under-counts stages whose first calls are zero-byte)."""
+    stage = "zb_regression_stage"
+    text0 = "\n".join(metrics.ec_stage_bytes.expose())
+    assert f'stage="{stage}"' not in text0
+    metrics.observe_ec_stage(stage, 0.001, 0)
+    text1 = "\n".join(metrics.ec_stage_bytes.expose())
+    assert f'stage="{stage}"' in text1
+    assert metrics.ec_stage_bytes.value(stage=stage) == 0.0
+    metrics.observe_ec_stage(stage, 0.001, 7)
+    assert metrics.ec_stage_bytes.value(stage=stage) == 7.0
+
+
+# -- PipelineRecorder as production component --------------------------------
+
+def test_recorder_bounded_overflow():
+    """Production duty means constant memory: both the event and span
+    rings drop the oldest entries past maxlen, and the read side keeps
+    computing over whatever survived."""
+    rec = PipelineRecorder(maxlen=8)
+    for i in range(100):
+        rec.record("dispatched", i)
+        rec.note_span("device", i, float(i), float(i) + 0.5)
+    assert len(rec.events()) == 8
+    assert len(rec.spans()) == 8
+    assert [s[1] for s in rec.spans()] == list(range(92, 100))
+    occ = rec.device_occupancy()
+    assert occ["fraction"] is not None
+    assert rec.gantt(last=4)[-1]["index"] == 99
+
+
+def test_recorder_concurrent_writers():
+    """Stages run on pool threads plus the main drain loop; concurrent
+    note_span/record from 8 writers must never corrupt the rings."""
+    rec = PipelineRecorder(maxlen=512)
+    errs = []
+
+    def hammer(tid):
+        try:
+            for i in range(200):
+                rec.note_span("device", i, i + tid * 0.01,
+                              i + tid * 0.01 + 0.5)
+                rec.record("drained", i)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    assert len(rec.spans()) == 512
+    assert rec.device_occupancy()["fraction"] is not None
+    rec.bubble_attribution()
+
+
+def test_recorder_gantt_occupancy_bubbles_exact():
+    """Injected-clock math, no sleeps: two batches with known spans
+    give an exact device-busy fraction, exact per-gap bubble
+    attribution naming the starving stage, and index-ordered gantt
+    rows that keep the widest interval for a re-noted stage."""
+    rec = PipelineRecorder()
+    rec.note_span("stack", 0, 0.0, 1.0)
+    rec.note_span("dispatch", 0, 1.0, 2.0)
+    rec.note_span("device", 0, 2.0, 4.0)
+    rec.note_span("drain", 0, 4.0, 5.0)
+    rec.note_span("stack", 1, 1.0, 3.0)
+    rec.note_span("dispatch", 1, 3.0, 4.0)
+    rec.note_span("device", 1, 4.0, 7.0)
+    rec.note_span("drain", 1, 7.0, 8.0)
+
+    occ = rec.device_occupancy()
+    assert occ["window"] == [0.0, 8.0]
+    assert occ["busy_seconds"] == pytest.approx(5.0)   # [2,7] union
+    assert occ["fraction"] == pytest.approx(5.0 / 8.0)
+    assert occ["stages"]["stack"] == pytest.approx(3.0 / 8.0)
+
+    bub = rec.bubble_attribution()
+    # Gaps: [0,2] (stack covers 2s of it, dispatch 1s) and [7,8]
+    # (drain covers all 1s).  Starving stage = stack.
+    assert bub["bubble_seconds"] == pytest.approx(3.0)
+    assert bub["by_stage"]["stack"] == pytest.approx(2.0)
+    assert bub["by_stage"]["dispatch"] == pytest.approx(1.0)
+    assert bub["by_stage"]["drain"] == pytest.approx(1.0)
+    assert bub["starving_stage"] == "stack"
+
+    g = rec.gantt()
+    assert [row["index"] for row in g] == [0, 1]
+    assert g[0]["stages"]["device"] == [2.0, 4.0]
+    # Split stack segments (the pool-wait exclusion pattern) widen.
+    rec.note_span("stack", 0, 0.5, 1.5)
+    assert rec.gantt()[0]["stages"]["stack"] == [0.0, 1.5]
+
+
+def test_pipeline_collapse_emits_rate_limited_device_slow():
+    """Three consecutive collapsed runs trip the streak and emit ONE
+    device.slow (warn); further collapsed runs inside the rate-limit
+    window stay silent; a healthy run resets the streak."""
+    now = [100.0]
+    ledger = roofline.RooflineLedger(clock=lambda: now[0])
+    bad = PipelineRecorder()
+    bad.note_span("dispatch", 0, 0.0, 9.0)
+    bad.note_span("device", 0, 9.0, 10.0)     # 10% busy
+    good = PipelineRecorder()
+    good.note_span("device", 0, 0.0, 9.0)
+    good.note_span("drain", 0, 9.0, 10.0)     # 90% busy
+
+    seq0 = JOURNAL._seq
+
+    def slow_events():
+        return [e for e in JOURNAL.snapshot(type_="device.slow")
+                if e["seq"] > seq0]
+
+    for _ in range(3):
+        ledger.note_pipeline("encode", bad, node="t:0")
+    evs = slow_events()
+    assert len(evs) == 1
+    assert evs[0]["severity"] == "warn"
+    assert evs[0]["attrs"]["pipeline"] == "encode"
+    assert evs[0]["attrs"]["occupancy"] == pytest.approx(0.1)
+    assert evs[0]["attrs"]["starving_stage"] == "dispatch"
+
+    # Still collapsed but inside _EMIT_EVERY: no fresh event.
+    ledger.note_pipeline("encode", bad)
+    assert len(slow_events()) == 1
+    # Past the window: one more.
+    now[0] += roofline._EMIT_EVERY + 1.0
+    ledger.note_pipeline("encode", bad)
+    assert len(slow_events()) == 2
+
+    occ = ledger.occupancy_summary()
+    assert occ["any_collapsed"] and occ["collapsed"]["encode"]
+    assert occ["latest"]["encode"]["fraction"] == pytest.approx(0.1)
+    assert occ["latest"]["encode"]["starving_stage"] == "dispatch"
+
+    ledger.note_pipeline("encode", good)
+    occ = ledger.occupancy_summary()
+    assert not occ["any_collapsed"]
+    assert occ["latest"]["encode"]["fraction"] == pytest.approx(0.9)
+
+
+# -- live cluster: surfaces + promcheck --------------------------------------
+
+@pytest.fixture
+def cluster(tmp_path):
+    roofline.LEDGER.reset()
+    roofline.set_armed(True)
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp_path / "meta"),
+                          pulse_seconds=60)
+    master.start()
+    d = tmp_path / "vs0"
+    d.mkdir()
+    vs = VolumeServer(master.url(), [str(d)], max_volume_counts=[10],
+                      pulse_seconds=60)
+    vs.start()
+    yield master, vs
+    vs.stop()
+    master.stop()
+    roofline.LEDGER.reset()
+
+
+def _seed_ledger():
+    """One real interpret-mode encode plus an injected-clock collapsed
+    pipeline folded into the process ledger — the device plane's full
+    surface without a heavyweight streamed workload."""
+    pc = PallasCoder(4, 2)
+    pc.encode(np.ones((4, 2048), np.uint8))
+    rec = PipelineRecorder()
+    rec.note_span("stack", 0, 0.0, 8.0)
+    rec.note_span("dispatch", 0, 8.0, 9.0)
+    rec.note_span("device", 0, 9.0, 10.0)
+    for _ in range(roofline._COLLAPSE_STREAK):
+        roofline.LEDGER.note_pipeline("encode", rec, node="seed:0")
+
+
+def test_debug_and_cluster_device_surfaces(cluster, tmp_path):
+    """The acceptance gate: a recorded encode + collapsed streamed
+    pipeline show up on /debug/device (volume AND master), roll up
+    through the heartbeat into /cluster/device with a collapse
+    warning, mark healthz's device section (warning, never 503-worthy
+    by itself), and render through cluster.roofline with -save/-diff
+    round-tripping."""
+    master, vs = cluster
+    _seed_ledger()
+
+    doc = rpc.call(f"http://{vs.url()}/debug/device")
+    assert doc["armed"] is True and doc["role"] == "volume"
+    kernels = {r["kernel"] for r in doc["kernels"]}
+    assert "encode_kernel" in kernels
+    assert doc["conservation"]["ok"], doc["conservation"]
+    occ = doc["occupancy"]["latest"]["encode"]
+    assert occ["fraction"] == pytest.approx(0.1)
+    assert occ["starving_stage"] == "stack"
+    assert doc["pipelines"][-1]["gantt"], "gantt missing"
+
+    # The role-generic mount answers on the master too.
+    mdoc = rpc.call(f"{master.url()}/debug/device")
+    assert mdoc["role"] == "master" and "peaks" in mdoc
+
+    vs._send_heartbeat(full=True)
+    cdoc = rpc.call(f"{master.url()}/cluster/device")
+    assert vs.url() in cdoc["nodes"]
+    merged = {r["kernel"] for r in cdoc["kernels"]}
+    assert "encode_kernel" in merged
+    assert any("collapsed" in w for w in cdoc["warnings"]), cdoc
+    row = next(r for r in cdoc["kernels"]
+               if r["kernel"] == "encode_kernel")
+    assert row["count"] >= 1 and row["bytes"] > 0 and row["work"] > 0
+    # ?kernel= filters; an uncataloged name is a loud error.
+    fdoc = rpc.call(
+        f"{master.url()}/cluster/device?kernel=batch_encode")
+    assert all(r["kernel"] == "batch_encode" for r in fdoc["kernels"])
+    with pytest.raises(Exception):
+        rpc.call(f"{master.url()}/cluster/device?kernel=bogus")
+
+    status, hdoc = rpc.call_status(f"{master.url()}/cluster/healthz")
+    assert isinstance(hdoc, dict) and "device" in hdoc
+    assert any("collapsed" in w for w in hdoc["device"]["warnings"])
+    assert any(r["pipeline"] == "encode"
+               for r in hdoc["device"]["occupancy"])
+    # Occupancy collapse alone is a warning, not a health problem.
+    assert not any("occupancy" in p for p in hdoc["problems"])
+
+    env = CommandEnv(master.url())
+    out = run_command(env, "cluster.roofline")
+    assert "encode_kernel" in out and "peaks[" in out
+    assert "starved by stack" in out
+    assert "!!" in out
+    save = str(tmp_path / "rl_base.json")
+    out = run_command(env, f"cluster.roofline -save {save}")
+    assert "kernel rows" in out
+    out = run_command(env, f"cluster.roofline -diff {save}")
+    assert "no achieved-fraction movement" in out
+
+
+def test_promcheck_roofline_instruments_all_roles(cluster):
+    """Every new instrument scrapes promcheck-clean on master and
+    volume server, and the occupancy gauge carries the stage label."""
+    master, vs = cluster
+    _seed_ledger()
+    mtext = bytes(rpc.call(f"{master.url()}/metrics")).decode()
+    vtext = bytes(rpc.call(f"http://{vs.url()}/metrics")).decode()
+    for text, who in ((mtext, "master"), (vtext, "volume")):
+        assert validate_exposition(text) == [], f"{who} scrape dirty"
+        for fam in ("SeaweedFS_kernel_seconds_total",
+                    "SeaweedFS_kernel_bytes_total",
+                    "SeaweedFS_kernel_work_total",
+                    "SeaweedFS_device_occupancy"):
+            assert fam in text, (who, fam)
+    assert 'kernel="encode_kernel"' in vtext
+    assert 'stage="device"' in vtext
