@@ -54,16 +54,27 @@ REAL subprocess cluster (master + 2 volume servers), then:
    both the shipper's ack accounting and the rlog.ship flow ledger
    row.  Standalone: `python bench_load.py --geo` writes only
    BENCH_geo_r01.json.
+8. (round 6) the METADATA-PLANE HA phase: a sharded filer fleet
+   (master -filer.shards=N + N filer processes with per-shard
+   crash-safe journals) absorbs a closed-loop mkdir/rename storm
+   through the shard-map-aware client, first with 1 shard (every
+   commit serialized behind one primary's fsync + semi-sync fan-out)
+   and then with the N primaries spread via filer.shards.move; the
+   sharded fleet must beat the single-shard fleet by >= META_SCALE_X
+   with zero errors and every shard's journal advanced.  Standalone:
+   `python bench_load.py --meta` writes only BENCH_meta_r01.json.
 
 Output: one JSON document (default BENCH_load_r03.json) — the BENCH
 series beside the EC kernel numbers — plus BENCH_tenant_r01.json from
-the round-4 tenant phase and BENCH_geo_r01.json from the round-5 geo
-phase.
+the round-4 tenant phase, BENCH_geo_r01.json from the round-5 geo
+phase, and BENCH_meta_r01.json from the round-6 metadata-HA phase.
 
 Knobs (env): BENCH_LOAD_QUICK=1 (seconds-scale smoke: the `slow`
 pytest path), BENCH_LOAD_RATE, BENCH_LOAD_DURATION, BENCH_LOAD_WARMUP,
 BENCH_LOAD_KEYS, BENCH_LOAD_SIZE, BENCH_LOAD_WORKERS, BENCH_LOAD_ZIPF,
-BENCH_LOAD_WRITE_FRACTION.  CPU-only; no accelerator involved.
+BENCH_LOAD_WRITE_FRACTION; the meta phase reads BENCH_META_SHARDS,
+BENCH_META_FILERS, BENCH_META_SECONDS, BENCH_META_WORKERS,
+BENCH_META_SCALE_X.  CPU-only; no accelerator involved.
 """
 
 from __future__ import annotations
@@ -1285,15 +1296,270 @@ def geo_round(out_path: str) -> int:
     return 0 if doc["geo_ok"] else 1
 
 
+# -- round 6: the metadata-plane HA phase ------------------------------------
+#
+# A sharded filer fleet (master with -filer.shards=N + N filer
+# processes, each journaling to its own -filer.ha.dir) absorbs a
+# closed-loop mkdir/rename storm through the shard-map-aware client.
+# The phase prices the sharding itself: a single shard serializes
+# every metadata commit behind one primary's journal-fsync +
+# semi-sync fan-out critical section, so spreading the N shard
+# primaries across the fleet (filer.shards.move, exactly the runbook
+# step) must scale committed throughput.  Gates: the N-shard fleet
+# beats the 1-shard fleet by >= META_SCALE_X (>= 4 cores; on smaller
+# boxes the gate bounds coordination overhead at META_FLOOR_X — see
+# the escape-hatch comment at the knobs), the moves actually spread
+# the primaries, every shard's journal advanced, and both storms
+# commit with zero client-visible errors.
+
+META_SHARDS = int(_env("BENCH_META_SHARDS", 4))
+META_FILERS = int(_env("BENCH_META_FILERS", 4))
+META_SECONDS = _env("BENCH_META_SECONDS", 3.0 if QUICK else 8.0)
+META_WORKERS = int(_env("BENCH_META_WORKERS", 4 if QUICK else 8))
+META_SCALE_X = _env("BENCH_META_SCALE_X", 1.2)
+# The 1-core escape hatch (the tenant/geo phases' reasoning): N shard
+# primaries on one core time-slice a single CPU, so the ratio prices
+# the scheduler, not the sharding — there the gate only bounds the
+# coordination overhead (sharded must hold >= FLOOR_X of the
+# single-shard fleet).  Boxes with >= 4 cores must show real scaling.
+META_FLOOR_X = _env("BENCH_META_FLOOR_X", 0.6)
+META_PULSE = _env("BENCH_META_PULSE", 1.0)
+
+
+class MetaFleet:
+    """Subprocess master (-filer.shards=N) + META_FILERS filers.  No
+    volume servers: mkdir/rename are pure metadata commits, and the
+    plane being priced is the shard journal path, not blob IO."""
+
+    def __init__(self, tmp: str, shards: int):
+        from seaweedfs_tpu.cluster import rpc
+        self.tmp = tmp
+        self.shards = shards
+        self.procs: list[subprocess.Popen] = []
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONHASHSEED="0", SEAWEEDFS_TPU_TRACES="0")
+        mport = rpc.free_port()
+        self.master_url = f"http://127.0.0.1:{mport}"
+        self._spawn(["master", f"-port={mport}", f"-mdir={tmp}/meta",
+                     f"-filer.shards={shards}"], env)
+        self.filer_urls: list[str] = []
+        for i in range(META_FILERS):
+            fport = rpc.free_port()
+            self._spawn(["filer", f"-port={fport}",
+                         f"-master=127.0.0.1:{mport}",
+                         f"-pulseSeconds={META_PULSE}",
+                         f"-filer.ha.dir={tmp}/ha{i}"], env)
+            self.filer_urls.append(f"http://127.0.0.1:{fport}")
+
+    _spawn = Cluster._spawn
+    stop = Cluster.stop
+
+    def shard_map(self) -> dict:
+        from seaweedfs_tpu.cluster import rpc
+        return rpc.call(self.master_url + "/cluster/filer/shards",
+                        timeout=5.0)
+
+    def wait_ready(self, timeout: float = 60.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                doc = self.shard_map()
+                rows = doc.get("shards") or {}
+                alive = [f for f in doc.get("filers", [])
+                         if f.get("alive")]
+                if len(alive) == META_FILERS and \
+                        len(rows) == self.shards and \
+                        all(r.get("primary") for r in rows.values()):
+                    return
+            except Exception:  # noqa: BLE001 — still starting
+                pass
+            time.sleep(0.2)
+        raise TimeoutError("filer fleet never became healthy")
+
+    def spread_primaries(self, timeout: float = 30.0) -> None:
+        """filer.shards.move shard k -> filer k%N: the master hands
+        every shard to the first registrant, so an unspread fleet
+        measures one process, not N."""
+        import json as _json
+
+        from seaweedfs_tpu.cluster import rpc
+        targets = {k: self.filer_urls[k % len(self.filer_urls)]
+                   for k in range(self.shards)}
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            doc = self.shard_map()
+            rows = {int(k): v for k, v in
+                    (doc.get("shards") or {}).items()}
+            pending = [k for k, to in targets.items()
+                       if rows.get(k, {}).get("primary") != to]
+            if not pending:
+                return
+            for k in pending:
+                try:
+                    rpc.call(self.master_url +
+                             "/cluster/filer/shards/move", "POST",
+                             _json.dumps({"shard": k,
+                                          "to": targets[k]}).encode(),
+                             timeout=10.0)
+                except Exception:  # noqa: BLE001 — contested mid-
+                    pass           # move; re-checked next lap
+            time.sleep(0.3)
+        raise TimeoutError("shard primaries never spread")
+
+
+def _meta_dirs(shards: int) -> list[str]:
+    """Top-level dirs covering every shard (2 per shard): the storm
+    must offer work to ALL primaries or the scaling gate measures the
+    hash, not the plane."""
+    from seaweedfs_tpu.filer.metaha import shard_of
+    per: dict[int, list[str]] = {k: [] for k in range(max(shards, 1))}
+    i = 0
+    while any(len(v) < 2 for v in per.values()):
+        name = f"bench{i}"
+        k = shard_of("/" + name, shards) if shards > 1 else 0
+        if len(per[k]) < 2:
+            per[k].append(name)
+        i += 1
+    return [d for row in per.values() for d in row]
+
+
+def _meta_storm(master_url: str, dirs: list[str],
+                seconds: float) -> dict:
+    """Closed-loop mkdir/rename storm through ShardedFilerClient —
+    every 4th committed dir is renamed (same top-level dir: renames
+    never cross shards).  One client per worker: the map cache and
+    retry state are per-thread, like real gateway processes."""
+    from seaweedfs_tpu.filer.client import ShardedFilerClient
+    lat: list[list[float]] = [[] for _ in range(META_WORKERS)]
+    errs = [0] * META_WORKERS
+    ops = [0] * META_WORKERS
+    start = time.perf_counter()
+    stop = start + seconds
+
+    def worker(wi: int) -> None:
+        client = ShardedFilerClient(master_url, map_ttl=2.0)
+        n = 0
+        while time.perf_counter() < stop:
+            top = dirs[(wi + n) % len(dirs)]
+            path = f"/{top}/w{wi}-n{n}"
+            t0 = time.perf_counter()
+            try:
+                client.mkdir(path)
+                if n % 4 == 3:
+                    client.rename(path, path + "-r")
+            except Exception:  # noqa: BLE001 — counted, gated
+                errs[wi] += 1
+            else:
+                ops[wi] += 2 if n % 4 == 3 else 1
+                lat[wi].append(time.perf_counter() - t0)
+            n += 1
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(META_WORKERS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - start
+    total = sum(ops)
+    return {"ops": total, "errors": sum(errs),
+            "ops_per_s": round(total / max(wall, 1e-9), 1),
+            "latency": percentiles([x for row in lat for x in row])}
+
+
+def meta_phase() -> dict:
+    from seaweedfs_tpu.cluster import rpc
+    doc: dict = {"shards": META_SHARDS, "filers": META_FILERS,
+                 "seconds": META_SECONDS, "workers": META_WORKERS}
+    dirs = _meta_dirs(META_SHARDS)
+    for label, shards in (("single", 1), ("sharded", META_SHARDS)):
+        tmp = tempfile.mkdtemp(prefix=f"bench_meta_{label}_")
+        fleet = MetaFleet(tmp, shards)
+        try:
+            fleet.wait_ready()
+            if shards > 1:
+                fleet.spread_primaries()
+                # Let the post-move reshuffle settle (followers
+                # re-tail the moved primaries and rejoin the sync
+                # sets) so the storm starts in steady state.
+                time.sleep(2 * META_PULSE)
+            log(f"{label} fleet ready ({shards} shard(s), "
+                f"{META_FILERS} filers); storm "
+                f"{META_SECONDS:.0f}s x{META_WORKERS} workers ...")
+            # Warm every top dir through the client first: the first
+            # touch of a fresh shard map + parent mkdirs is one-time
+            # cost, not steady-state metadata throughput.
+            from seaweedfs_tpu.filer.client import ShardedFilerClient
+            warm = ShardedFilerClient(fleet.master_url)
+            for d in dirs:
+                warm.mkdir(f"/{d}/warm")
+            storm = _meta_storm(fleet.master_url, dirs, META_SECONDS)
+            smap = fleet.shard_map()
+            rows = {int(k): v for k, v in
+                    (smap.get("shards") or {}).items()}
+            shard_rows = {}
+            for k, row in sorted(rows.items()):
+                st = rpc.call(
+                    row["primary"] +
+                    f"/.meta/shard/status?shard={k}", timeout=5.0)
+                shard_rows[k] = {
+                    "primary": row["primary"],
+                    "epoch": row.get("epoch"),
+                    "followers": len(row.get("followers", [])),
+                    "last_seq": int(st.get("last_seq", 0))}
+            doc[label] = {**storm, "shard_rows": shard_rows,
+                          "primaries": sorted(
+                              {r["primary"] for r in rows.values()})}
+        finally:
+            fleet.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+    single, sharded = doc["single"], doc["sharded"]
+    ratio = sharded["ops_per_s"] / max(single["ops_per_s"], 1e-9)
+    cores = os.cpu_count() or 1
+    doc["scaling_ratio"] = round(ratio, 3)
+    doc["cores"] = cores
+    doc["scale_required"] = META_SCALE_X if cores >= 4 else META_FLOOR_X
+    doc["gates"] = {
+        "sharded_scales_over_single": ratio >= doc["scale_required"],
+        "primaries_spread": len(sharded["primaries"]) ==
+            min(META_SHARDS, META_FILERS),
+        "every_shard_journaled": all(
+            r["last_seq"] > 0
+            for r in sharded["shard_rows"].values()),
+        "zero_errors": single["errors"] == 0 and
+            sharded["errors"] == 0,
+    }
+    doc["meta_ok"] = all(doc["gates"].values())
+    return doc
+
+
+def meta_round(out_path: str) -> int:
+    """Round 6 runner: publish BENCH_meta_r01.json, gate on meta_ok."""
+    t0 = time.time()
+    log("meta phase (round 6: sharded filer metadata HA) ...")
+    phase = meta_phase()
+    doc = {"bench": "meta", "round": 6, "quick": QUICK,
+           **phase, "elapsed_s": round(time.time() - t0, 1)}
+    print(json.dumps(doc, indent=1))
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    log(f"wrote {out_path}")
+    return 0 if doc["meta_ok"] else 1
+
+
 def main() -> int:
     out_path = "BENCH_load_r03.json"
     args = sys.argv[1:]
     tenant_only = "--tenant" in args
     geo_only = "--geo" in args
+    meta_only = "--meta" in args
     if tenant_only:
         out_path = "BENCH_tenant_r01.json"
     if geo_only:
         out_path = "BENCH_geo_r01.json"
+    if meta_only:
+        out_path = "BENCH_meta_r01.json"
     if "-o" in args:
         out_path = args[args.index("-o") + 1]
 
@@ -1309,6 +1575,8 @@ def main() -> int:
         return tenant_round(out_path)
     if geo_only:
         return geo_round(out_path)
+    if meta_only:
+        return meta_round(out_path)
 
     tmp = tempfile.mkdtemp(prefix="bench_load_")
     cluster = Cluster(tmp, attribution=True)
@@ -1522,7 +1790,10 @@ def main() -> int:
         # (BENCH_geo_r01.json) and gates alongside.
         geo_rc = geo_round(
             os.path.join(REPO, "BENCH_geo_r01.json"))
-        return 0 if (ok and ten_rc == 0 and geo_rc == 0) else 1
+        meta_rc = meta_round(
+            os.path.join(REPO, "BENCH_meta_r01.json"))
+        return 0 if (ok and ten_rc == 0 and geo_rc == 0
+                     and meta_rc == 0) else 1
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

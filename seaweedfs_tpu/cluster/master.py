@@ -88,7 +88,8 @@ class MasterServer:
                  geo_vid_offset: int = 0,
                  steer_peer: str | None = None,
                  steer_reads: bool = False,
-                 steer_refresh: float = 2.0):
+                 steer_refresh: float = 2.0,
+                 filer_shards: int = 0):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
@@ -112,6 +113,20 @@ class MasterServer:
         # without polling.
         self._watchers: list = []
         self._watchers_lock = threading.Lock()
+        # Filer metadata-HA plane (-filer.shards=N; 0 keeps it off):
+        # filers register + heartbeat like volume servers, and the
+        # master owns the shard map — which filer is primary for each
+        # namespace shard, at which fencing epoch, with which
+        # followers.  Persisted so a master restart cannot regress an
+        # epoch (that would un-fence a deposed primary).
+        self.filer_shards = int(filer_shards)
+        self._filers: dict[str, dict] = {}   # url -> row
+        self._filer_lock = threading.RLock()
+        self._shard_map: dict[int, dict] = {}
+        self._shard_map_version = 0
+        self._shard_map_path = f"{meta_dir}/filer_shards.json" \
+            if meta_dir else None
+        self._load_shard_map()
         if meta_dir:
             import os
             os.makedirs(meta_dir, exist_ok=True)
@@ -199,6 +214,11 @@ class MasterServer:
         s.route("GET", "/cluster/tenants", self._cluster_tenants)
         s.route("GET", "/cluster/flows", self._cluster_flows)
         s.route("GET", "/cluster/device", self._cluster_device)
+        s.route("POST", "/filer/heartbeat", self._filer_heartbeat)
+        s.route("GET", "/cluster/filer/shards",
+                self._cluster_filer_shards)
+        s.route("POST", "/cluster/filer/shards/move",
+                self._filer_shard_move)
         reg = s.enable_metrics("master")
         # Device roofline instruments (process-global singletons): the
         # master runs no EC kernels itself in the deployed topology,
@@ -1458,9 +1478,16 @@ class MasterServer:
                     lease_doc["held_local"] += 1
                 if lrow.get("moving"):
                     lease_doc["moving"] += 1
+        # Filer fleet (metadata-HA plane): registered filers appear
+        # beside volume nodes; a dead filer or a primary-less shard is
+        # a PROBLEM — namespace writes for that shard fail closed.
+        filer_rows, filer_problems = self.filer_health_rows()
+        problems.extend(filer_problems)
         doc = {"healthy": not problems, "problems": problems,
                "leader": self.leader_url(), "is_leader": self.is_leader(),
                "nodes": nodes, "volumes": volumes,
+               "filers": {"nodes": filer_rows,
+                          "num_shards": self.filer_shards},
                "ec_volumes": ec_volumes, "slo": slo_doc,
                "replication": {"lag_slo": self.replication_lag_slo,
                                "cluster_id": self.geo_cluster_id
@@ -1931,6 +1958,306 @@ class MasterServer:
                         pass
         return vacuumed
 
+    # -- filer metadata-HA plane (shard map + filer registry) ----------------
+
+    def _load_shard_map(self) -> None:
+        if not self._shard_map_path:
+            return
+        try:
+            with open(self._shard_map_path) as f:
+                doc = json.load(f)
+            self._shard_map = {int(k): v
+                               for k, v in doc.get("shards",
+                                                   {}).items()}
+            self._shard_map_version = int(doc.get("version", 0))
+            if not self.filer_shards:
+                self.filer_shards = int(doc.get("num_shards", 0))
+        except (OSError, ValueError):
+            pass
+
+    def _store_shard_map(self) -> None:
+        """Atomic tmp+fsync+rename: a restart must never regress an
+        epoch (that would un-fence a deposed primary)."""
+        if not self._shard_map_path:
+            return
+        import os
+        tmp = f"{self._shard_map_path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"version": self._shard_map_version,
+                           "num_shards": self.filer_shards,
+                           "shards": {str(k): v for k, v in
+                                      self._shard_map.items()}}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._shard_map_path)
+        except OSError:
+            pass
+
+    def _shard_map_doc(self) -> dict:
+        return {"num_shards": self.filer_shards,
+                "version": self._shard_map_version,
+                "shards": {str(k): v
+                           for k, v in self._shard_map.items()}}
+
+    def _filer_fresh_cutoff(self) -> float:
+        return time.time() - 2 * self.topo.pulse_seconds
+
+    def _live_filers(self) -> list[str]:
+        cutoff = self._filer_fresh_cutoff()
+        return sorted(u for u, row in self._filers.items()
+                      if row.get("last_seen", 0) >= cutoff)
+
+    def _filer_heartbeat(self, query: dict, body: bytes):
+        """Filer registration + pulse (the volume-server /heartbeat
+        analog).  The response carries the shard map when the plane is
+        armed — map distribution rides the beat, no extra poll."""
+        if not self.is_leader():
+            return {"leader": self.raft.leader(), "is_leader": False}
+        hb = json.loads(body or b"{}")
+        url = hb.get("url", "")
+        if not url:
+            raise rpc.RpcError(400, "filer heartbeat without url")
+        with self._filer_lock:
+            known = url in self._filers
+            self._filers[url] = {
+                "url": url, "last_seen": time.time(),
+                "signature": hb.get("signature", 0),
+                "shards": hb.get("shards", {}),
+            }
+            if not known:
+                from ..events import emit as emit_event
+                emit_event("heartbeat.recovered", node=url,
+                           role="filer")
+            if self.filer_shards > 0:
+                self._assign_filer_shards()
+                return {"is_leader": True, "pulse_seconds":
+                        self.topo.pulse_seconds, **self._shard_map_doc()}
+        return {"is_leader": True,
+                "pulse_seconds": self.topo.pulse_seconds}
+
+    def _assign_filer_shards(self) -> None:
+        """Round-robin unowned shards over the live fleet and keep
+        follower sets current.  Runs under _filer_lock.  Never touches
+        a shard whose primary is alive — reassignment of dead
+        primaries is the sweep's job (promotion needs the
+        most-caught-up follower, not the next in rotation)."""
+        live = self._live_filers()
+        if not live:
+            return
+        changed = False
+        for k in range(self.filer_shards):
+            row = self._shard_map.get(k)
+            if row is None or not row.get("primary"):
+                primary = live[k % len(live)]
+                row = {"primary": primary,
+                       "epoch": (row or {}).get("epoch", 0) + 1,
+                       "followers": [u for u in live
+                                     if u != primary][:2]}
+                self._shard_map[k] = row
+                changed = True
+                continue
+            followers = [u for u in live
+                         if u != row["primary"]][:2]
+            if set(followers) - set(row.get("followers", [])):
+                # Grow-only refresh: new fleet members join as
+                # followers; members missing a beat are NOT dropped
+                # here (the sweep owns death) — flapping would churn
+                # the sync set.
+                row["followers"] = sorted(
+                    set(row.get("followers", [])) | set(followers))
+                changed = True
+        if changed:
+            self._shard_map_version += 1
+            self._store_shard_map()
+
+    def _sweep_dead_filers(self) -> None:
+        """Failover: a shard whose primary missed 2 pulses promotes
+        the most-caught-up live follower at epoch+1 (the epoch fence
+        makes the deposed primary's late pushes refusable)."""
+        if self.filer_shards <= 0:
+            return
+        from ..events import emit as emit_event
+        with self._filer_lock:
+            live = set(self._live_filers())
+            for url in sorted(set(self._filers) - live):
+                if not self._filers[url].get("_mourned"):
+                    self._filers[url]["_mourned"] = True
+                    emit_event("heartbeat.lost", node=url,
+                               severity="warn", role="filer")
+            changed = False
+            lease_cutoff = time.time() - 3 * self.topo.pulse_seconds
+            for k, row in sorted(self._shard_map.items()):
+                primary = row.get("primary")
+                if primary in live:
+                    continue
+                prow = self._filers.get(primary)
+                if prow and prow.get("last_seen", 0) >= lease_cutoff:
+                    # Dead to us, but its primary lease (renewed for
+                    # 3 pulses at its last heartbeat) may still be
+                    # live behind a partition — promoting now could
+                    # produce two acking primaries.  Wait it out.
+                    continue
+                # Most-caught-up follower: ask each candidate for its
+                # LIVE journal position — the heartbeat rows can be a
+                # pulse stale, and promoting the wrong follower would
+                # lose every op acked since its beat.  Fall back to
+                # the heartbeat row when a candidate can't answer.
+                from ..fault import registry as _fault
+                best, best_seq = None, -1
+                for f in row.get("followers", []):
+                    if f not in live:
+                        continue
+                    try:
+                        if _fault.ARMED:
+                            _fault.hit("wan.partition", peer=f,
+                                       shard=k)
+                        st = rpc.call(
+                            f + f"/.meta/shard/status?shard={k}",
+                            timeout=2.0)
+                        seq = int(st.get("last_seq", 0))
+                    except Exception:  # noqa: BLE001 — stale fallback
+                        srow = self._filers[f].get("shards",
+                                                   {}).get(str(k), {})
+                        seq = int(srow.get("last_seq", 0))
+                    if seq > best_seq:
+                        best, best_seq = f, seq
+                if best is None:
+                    continue  # contested: fails closed until a
+                    #           follower comes back
+                old = primary
+                row["primary"] = best
+                row["epoch"] = int(row.get("epoch", 0)) + 1
+                row["followers"] = [u for u in live if u != best]
+                changed = True
+                emit_event("shard.promote", node=best, severity="warn",
+                           shard=k, old_primary=old or "",
+                           epoch=row["epoch"], last_seq=best_seq)
+                self._push_shard_acquire(k, row,
+                                         self._shard_map_version + 1)
+            if changed:
+                self._shard_map_version += 1
+                self._store_shard_map()
+
+    def _push_shard_acquire(self, shard: int, row: dict,
+                            version: int) -> None:
+        """Best-effort immediate acquire push — the next heartbeat
+        map is the backstop if this misses."""
+        from ..fault import registry as _fault
+        try:
+            if _fault.ARMED:
+                _fault.hit("wan.partition", peer=row["primary"],
+                           shard=shard)
+            rpc.call_json(row["primary"] + "/.meta/shard/acquire",
+                          payload={"shard": shard,
+                                   "epoch": row["epoch"],
+                                   "followers": row["followers"],
+                                   "version": version},
+                          timeout=5.0)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _cluster_filer_shards(self, query: dict, body: bytes):
+        with self._filer_lock:
+            cutoff = self._filer_fresh_cutoff()
+            filers = [{"url": u,
+                       "alive": row.get("last_seen", 0) >= cutoff,
+                       "age_seconds": round(
+                           time.time() - row.get("last_seen", 0), 3),
+                       "shards": row.get("shards", {})}
+                      for u, row in sorted(self._filers.items())]
+            return {**self._shard_map_doc(), "filers": filers}
+
+    def _filer_shard_move(self, query: dict, body: bytes):
+        """filer.shards.move: demote-first primary transfer.  The old
+        primary stops acking BEFORE the new one exists anywhere;
+        mid-move the shard is contested and fails closed (the
+        lease.py begin_move stance)."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/cluster/filer/shards/move",
+                                         query, body)
+        req = json.loads(body or b"{}")
+        shard = int(req.get("shard", -1))
+        to = req.get("to", "")
+        from ..events import emit as emit_event
+        with self._filer_lock:
+            row = self._shard_map.get(shard)
+            if row is None:
+                raise rpc.RpcError(404, f"no such shard {shard}")
+            if to not in self._live_filers():
+                raise rpc.RpcError(
+                    409, f"target filer {to} not registered/alive")
+            if to == row.get("primary"):
+                return {"moved": False, "already": True, **row}
+            old = row.get("primary")
+            if old:
+                from ..fault import registry as _fault
+                try:
+                    if _fault.ARMED:
+                        _fault.hit("wan.partition", peer=old,
+                                   shard=shard)
+                    rpc.call_json(old + "/.meta/shard/demote",
+                                  payload={"shard": shard,
+                                           "epoch": row["epoch"]},
+                                  timeout=5.0)
+                except Exception:  # noqa: BLE001 — unreachable old
+                    # primary.  Demote-first fails CLOSED (the geo
+                    # lease-move stance): while its lease may still
+                    # be live behind a partition, transferring the
+                    # shard could produce two acking primaries.
+                    # Once the lease TTL has surely lapsed, the
+                    # epoch bump below fences its pushes instead.
+                    last = self._filers.get(old, {}).get("last_seen",
+                                                         0)
+                    if last >= time.time() - \
+                            3 * self.topo.pulse_seconds:
+                        raise rpc.RpcError(
+                            503, f"shard {shard} NOT moved: old "
+                            f"primary {old} unreachable and its "
+                            "lease may still be live; retry after "
+                            "the lease TTL") from None
+            row["primary"] = to
+            row["epoch"] = int(row.get("epoch", 0)) + 1
+            row["followers"] = [u for u in self._live_filers()
+                                if u != to]
+            self._shard_map_version += 1
+            self._store_shard_map()
+            emit_event("shard.move", node=to, shard=shard,
+                       old_primary=old or "", epoch=row["epoch"])
+            self._push_shard_acquire(shard, row,
+                                     self._shard_map_version)
+            return {"moved": True, "shard": shard,
+                    "old_primary": old or "", **row}
+
+    def filer_health_rows(self) -> tuple[list[dict], list[str]]:
+        """(rows, problems) for /cluster/healthz + cluster.check."""
+        with self._filer_lock:
+            cutoff = self._filer_fresh_cutoff()
+            rows, problems = [], []
+            for u, row in sorted(self._filers.items()):
+                alive = row.get("last_seen", 0) >= cutoff
+                nprim = sum(
+                    1 for r in self._shard_map.values()
+                    if r.get("primary") == u)
+                rows.append({
+                    "url": u, "alive": alive,
+                    "age_seconds": round(
+                        time.time() - row.get("last_seen", 0), 3),
+                    "shards_primary": nprim})
+                if not alive:
+                    problems.append(f"filer {u} missed heartbeats "
+                                    "(last seen "
+                                    f"{rows[-1]['age_seconds']}s ago)")
+            for k in range(self.filer_shards):
+                row = self._shard_map.get(k)
+                if row is None or not row.get("primary") or \
+                        row["primary"] not in {
+                            r["url"] for r in rows if r["alive"]}:
+                    problems.append(
+                        f"filer shard {k} has no live primary "
+                        "(writes fail closed)")
+            return rows, problems
+
     def _sweep_loop(self) -> None:
         """Dead-node detection (CollectDeadNodeAndFullVolumes)."""
         while not self._stop.wait(self.topo.pulse_seconds):
@@ -1948,6 +2275,7 @@ class MasterServer:
                         pass
                 continue
             self._sweep_dead_nodes()
+            self._sweep_dead_filers()
 
     def _sweep_dead_nodes(self) -> None:
         """One dead-node collection round — the sweep loop's body,

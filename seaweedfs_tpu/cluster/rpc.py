@@ -363,7 +363,8 @@ class _Lane:
 # the traffic being diagnosed (asserted by
 # tests/test_attribution.py's saturated-server profile test).
 _ADMISSION_EXEMPT = {"/metrics", "/cluster/healthz", "/heartbeat",
-                     "/admin/drain", "/admin/status", "/cluster/watch"}
+                     "/filer/heartbeat", "/admin/drain",
+                     "/admin/status", "/cluster/watch"}
 
 
 def _admission_exempt(path: str) -> bool:

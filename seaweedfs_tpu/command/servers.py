@@ -211,6 +211,16 @@ def run_master(flags: Flags, args: list[str]) -> int:
                     if flags.get("replicate.steer.peer") else None),
         steer_reads=flags.get_bool("replicate.steer", False),
         steer_refresh=flags.get_float("replicate.steer.refresh", 2.0),
+        # Metadata HA: -filer.shards=N arms the sharded filer plane —
+        # registered filers get consistent-hash-on-directory shards
+        # with an epoch-fenced primary each and log-replicated
+        # followers; 0 (default) leaves filers standalone.
+        # -pulseSeconds sets the master's liveness clock: dead-node
+        # sweeps run at 2 pulses and a dead shard primary's lease is
+        # waited out for 3 — without the flag, failover time is
+        # welded to the 5s default.
+        filer_shards=flags.get_int("filer.shards", 0),
+        pulse_seconds=flags.get_float("pulseSeconds", 5.0),
         **_slo_flags(flags))
     m.start()
     glog.infof("master serving at %s", m.server.url())
@@ -354,6 +364,11 @@ def run_filer(flags: Flags, args: list[str]) -> int:
         cache_tenant_mb=(int(flags.get("filer.cache.tenant.mb"))
                          if flags.get("filer.cache.tenant.mb") != ""
                          else None),
+        # Metadata-HA plane: the heartbeat cadence to the master (the
+        # primary lease TTL is 3 pulses) and where the per-shard
+        # journals live (default: <-dir>.shards).
+        pulse_seconds=flags.get_float("pulseSeconds", 5.0),
+        ha_dir=flags.get("filer.ha.dir") or None,
         **_slo_flags(flags))
     fs.start()
     glog.infof("filer serving at %s", fs.server.url())
@@ -518,7 +533,8 @@ register(Command("master", "master -port=9333 -mdir=/tmp/meta"
                  " [-geo.cluster.id=A] [-geo.vid.stride=2]"
                  " [-geo.vid.offset=0] [-replicate.steer]"
                  " [-replicate.steer.peer=peer-master:9333]"
-                 " [-replicate.steer.refresh=2]",
+                 " [-replicate.steer.refresh=2]"
+                 " [-filer.shards=0] [-pulseSeconds=5]",
                  "start a master server", run_master))
 register(Command("volume",
                  "volume -port=8080 -dir=/data -max=8 -mserver=host:9333"
@@ -538,7 +554,8 @@ register(Command("filer", "filer -port=8888 -master=host:9333"
                  " [-filer.pack.threshold=0(B)] [-filer.pack.max=1048576]"
                  " [-filer.pack.linger=0.008] [-filer.proxy.min=262144]"
                  " [-tenant.rules=tenants.txt]"
-                 " [-filer.cache.tenant.mb=0]",
+                 " [-filer.cache.tenant.mb=0]"
+                 " [-pulseSeconds=5] [-filer.ha.dir=...]",
                  "start a filer server", run_filer))
 register(Command("msg.broker", "msg.broker -port=17777 -filer=host:8888",
                  "start a pub/sub message broker", run_msg_broker))

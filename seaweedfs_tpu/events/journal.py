@@ -158,6 +158,18 @@ TYPES: dict[str, str] = {
                    "device-occupancy fraction stayed below threshold "
                    "for consecutive batch groups — attrs name the "
                    "starving stage and bubble seconds",
+    "shard.promote": "a filer metadata shard failed over: its primary "
+                     "went dead and the master promoted the "
+                     "most-caught-up follower at epoch+1 (attrs carry "
+                     "shard, old/new primary, epoch)",
+    "shard.move": "a filer metadata shard moved primaries on request "
+                  "(demote-first, then the new primary acquires at "
+                  "epoch+1 — mid-move the shard is contested and "
+                  "fails closed)",
+    "shard.fence": "a filer adopted a higher shard epoch (durable "
+                   "before any record at that epoch is accepted) — "
+                   "pushes from the deposed primary's stale epoch now "
+                   "refuse with 409",
 }
 
 SEVERITIES = ("info", "warn", "error")

@@ -104,6 +104,17 @@ class Filer:
         self.meta_log = MetaLog(meta_log_dir, capacity=log_capacity)
         self._log_lock = threading.RLock()
         self._subscribers: list[Callable[[MetaEvent], None]] = []
+        # Metadata-HA shard sink (filer/metaha.py ShardPlane.on_op):
+        # when set, every committed mutation is journaled into its
+        # shard's durable log + replicated to followers BEFORE the
+        # caller can ack.  A raise from the sink fails the request —
+        # an op the shard plane refused was never acked.  None (the
+        # default) keeps a standalone filer on the pre-HA path.
+        self.shard_sink: Callable[[dict, str], None] | None = None
+        # Set while replaying a replicated record through the
+        # high-level mutators: suppresses re-journaling (no loops) and
+        # chunk GC (the origin primary already queued the deletes).
+        self._applying_remote = threading.local()
         # Signatures to attach to the next mutation on this thread
         # (set by the server when a sync/replication client replays a
         # remote event carrying prior signatures).
@@ -298,6 +309,9 @@ class Filer:
             self._hl_store_content(entry)
         self.store.insert_entry(entry)
         self._notify(entry.dir, old, entry)
+        self._sink({"op": "set", "entry": entry.to_dict(),
+                    "old": old.to_dict() if old else None},
+                   entry.path)
         return entry
 
     def update_entry(self, entry: Entry) -> Entry:
@@ -314,6 +328,8 @@ class Filer:
             self._hl_store_content(entry)
         self.store.update_entry(entry)
         self._notify(entry.dir, old, entry)
+        self._sink({"op": "set", "entry": entry.to_dict(),
+                    "old": old.to_dict()}, entry.path)
         return entry
 
     def _ensure_parents(self, dir_path: str, attr: Attributes) -> None:
@@ -336,6 +352,8 @@ class Filer:
                       replication=attr.replication))
         self.store.insert_entry(d)
         self._notify(d.dir, None, d)
+        self._sink({"op": "set", "entry": d.to_dict(), "old": None},
+                   d.path)
 
     def delete_entry(self, path: str, recursive: bool = False,
                      delete_chunks: bool = True) -> None:
@@ -365,6 +383,12 @@ class Filer:
             self._queue_chunk_deletion(e.chunks)
         self.store.delete_entry(path)
         self._notify(e.dir, e, None)
+        # The record carries the top entry only: a recursive delete
+        # replays as one recursive delete on the follower (the
+        # reference's event stream elides per-child tombstones too).
+        self._sink({"op": "del", "path": path,
+                    "entry": e.to_dict(), "recursive": recursive},
+                   path)
 
     def _walk(self, root: str) -> Iterable[Entry]:
         from .filerstore import iterate_tree
@@ -426,12 +450,21 @@ class Filer:
         moved = self.store.find_entry(new_path)
         self._notify(e.dir, e, None)
         self._notify(moved.dir, None, moved)
+        # One logical record for the whole (possibly subtree) move:
+        # the follower replays it as a rename against its own store —
+        # a delete+create pair could never reconstruct the subtree.
+        self._sink({"op": "ren", "src": old_path, "dst": new_path},
+                   old_path)
         return moved
 
     # -- chunk GC ------------------------------------------------------------
 
     def _queue_chunk_deletion(self, chunks: list[FileChunk]) -> None:
         if not chunks:
+            return
+        if getattr(self._applying_remote, "flag", False):
+            # Replicated replay: the origin primary already queued
+            # these blob deletes — queueing again would double-free.
             return
         from .filechunk_manifest import (has_chunk_manifest,
                                          resolve_chunk_manifest)
@@ -496,6 +529,22 @@ class Filer:
             def __exit__(self, *exc):
                 filer._extra_signatures.value = []
         return _Ctx()
+
+    def _sink(self, op: dict, path: str) -> None:
+        """Hand one committed logical op to the shard plane (journal +
+        fan-out before ack).  No-op standalone, and while replaying a
+        replicated record (the follower's apply must not re-journal).
+        Raises ShardWriteError when the plane refuses the ack."""
+        sink = self.shard_sink
+        if sink is None or getattr(self._applying_remote, "flag",
+                                   False):
+            return
+        sigs = [self.signature]
+        for s in getattr(self._extra_signatures, "value", []):
+            if s not in sigs:
+                sigs.append(s)
+        op["sigs"] = sigs
+        sink(op, path)
 
     def _notify(self, directory: str, old: Entry | None,
                 new: Entry | None) -> None:

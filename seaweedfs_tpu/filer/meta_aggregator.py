@@ -106,3 +106,66 @@ class MetaAggregator:
                 pass
         for t in self._threads:
             t.join(timeout=2)
+
+
+class ShardMetaAggregator:
+    """Cluster-wide metadata stream over the SHARDED filer fleet.
+
+    Where MetaAggregator tails fixed peers by local timestamp, this
+    tails each shard's journal by (shard, seq) — exact, replicated
+    cursors that survive a primary failover: when a tail drops, the
+    shard map is re-fetched from the master and the stream resumes on
+    the promoted primary at the same seq (the new primary serves the
+    same numbering the old one acked; unacked suffixes were unwound
+    by rejoin repair, so nothing the cursor saw can disappear).
+
+    Subscribers get fn(shard, seq, record) for every journaled
+    logical op (set / del / ren) in order per shard."""
+
+    def __init__(self, master_url: str | list[str],
+                 reconnect_interval: float = 1.0):
+        from .client import ShardedFilerClient
+        self.client = ShardedFilerClient(master_url)
+        self.reconnect_interval = reconnect_interval
+        self.cursors: dict[int, int] = {}
+        self._subscribers: list = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def subscribe(self, fn) -> None:
+        """fn(shard, seq, record) on every aggregated journal record."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def start(self, cursors: dict | None = None) -> None:
+        self.cursors = {int(k): int(v)
+                        for k, v in (cursors or {}).items()}
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="shard-meta-aggregator")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                recs, self.cursors = self.client.poll_events(
+                    self.cursors)
+            except Exception:  # noqa: BLE001 — master/primaries down:
+                recs = []      # back off and re-resolve next round
+            with self._lock:
+                subs = list(self._subscribers)
+            for r in recs:
+                for fn in subs:
+                    try:
+                        fn(r["shard"], r["seq"], r["record"])
+                    except Exception:  # noqa: BLE001 — a bad
+                        pass           # subscriber can't stall the tail
+            # Poll pacing: an empty round sleeps; a full page loops
+            # immediately to drain the backlog.
+            if not recs:
+                self._stop.wait(self.reconnect_interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

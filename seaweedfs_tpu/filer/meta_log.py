@@ -45,7 +45,51 @@ class MetaLog:
         self._last_ts = 0
         if self.dir is not None:
             os.makedirs(self.dir, exist_ok=True)
+            self._truncate_torn_tail()
             self._last_ts = self._scan_last_ts()
+
+    def _truncate_torn_tail(self) -> None:
+        """Drop a partial trailing line from the newest segment once at
+        open.  A crash mid-append leaves the segment ending in a torn
+        JSONL line; left in place it poisons replay for every event the
+        process appends *after* it (the new events land behind the torn
+        bytes, and a line-oriented reader that trips on the tear can
+        never reach them).  Same open-time repair stance as the volume
+        needle-log and replication-log recovery paths."""
+        segs = self._segments()
+        if not segs:
+            return
+        path = os.path.join(self.dir, segs[-1])
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return
+        if not data:
+            return
+        end = len(data)
+        # Step 1: an unterminated final line is torn by definition.
+        last_nl = data.rfind(b"\n", 0, end)
+        if last_nl != end - 1:
+            end = last_nl + 1  # 0 when the file has no newline at all
+        # Step 2: step back over terminated-but-unparseable tail lines
+        # (fsync ordering can persist the newline without the payload).
+        # Bad lines *surrounded by* good ones are left for read_since
+        # to skip individually — truncation only ever eats the tail.
+        while end > 0:
+            prev_nl = data.rfind(b"\n", 0, end - 1)
+            line = data[prev_nl + 1:end - 1]
+            if not line.strip():
+                end = prev_nl + 1
+                continue
+            try:
+                json.loads(line)
+                break
+            except json.JSONDecodeError:
+                end = prev_nl + 1
+        if end < len(data):
+            with open(path, "r+b") as f:
+                f.truncate(end)
 
     def _scan_last_ts(self) -> int:
         """Newest persisted ts_ns: last parseable line of the newest
@@ -135,21 +179,28 @@ class MetaLog:
         ring_first = ring[0]["ts_ns"] if ring else None
         for name in keep:
             try:
-                with open(os.path.join(self.dir, name), "rb") as f:
-                    for raw in f:
-                        if not raw.strip():
-                            continue
-                        ev = json.loads(raw)
-                        if ev["ts_ns"] <= since_ns:
-                            continue
-                        if ring_first is not None and \
-                                ev["ts_ns"] >= ring_first:
-                            break  # rest is covered by the ring
-                        out.append(ev)
-                        if len(out) >= limit:
-                            return out
-            except (OSError, json.JSONDecodeError):
+                f = open(os.path.join(self.dir, name), "rb")
+            except OSError:
                 continue
+            with f:
+                for raw in f:
+                    if not raw.strip():
+                        continue
+                    try:
+                        ev = json.loads(raw)
+                    except json.JSONDecodeError:
+                        # Skip only the bad line: a mid-segment tear
+                        # must not eat every event after it (the
+                        # old per-segment except did exactly that).
+                        continue
+                    if ev["ts_ns"] <= since_ns:
+                        continue
+                    if ring_first is not None and \
+                            ev["ts_ns"] >= ring_first:
+                        break  # rest is covered by the ring
+                    out.append(ev)
+                    if len(out) >= limit:
+                        return out
         for ev in ring:
             if ev["ts_ns"] > since_ns:
                 out.append(ev)

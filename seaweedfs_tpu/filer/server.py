@@ -101,6 +101,37 @@ class _MetaTail:
         return False
 
 
+class _ShardTail:
+    """Streaming body for /.meta/subscribe?shard=K&tail=true: NDJSON
+    journal records from since_seq+1 onward, pushed as the primary
+    commits them.  Resuming by (shard, seq) is exact — no timestamp
+    heuristics — so a shard-aware aggregator survives a failover by
+    reconnecting to the new primary at its applied seq."""
+
+    _PAGE = 500
+
+    def __init__(self, plane, shard: int, since_seq: int):
+        self._plane = plane
+        self._shard = shard
+        self._cursor = since_seq
+
+    def read(self, n: int = -1) -> bytes:
+        while True:
+            recs = self._plane.log_for(self._shard).read_from(
+                self._cursor + 1, self._PAGE)
+            if recs:
+                self._cursor = recs[-1][0]
+                return b"".join(
+                    json.dumps({"shard": self._shard, "seq": s,
+                                "epoch": e,
+                                "record": r}).encode() + b"\n"
+                    for s, e, r in recs)
+            if self._plane._stop.is_set():
+                return b""  # plane shutting down: end the stream
+            self._plane.wait_for_seq(self._shard, self._cursor + 1,
+                                     25.0)
+
+
 class FilerServer:
     # Smallest single-chunk GET window served by the direct
     # volume→client relay instead of the buffered chunk path
@@ -125,7 +156,9 @@ class FilerServer:
                  pack_linger: float = 0.008,
                  proxy_min: int | None = None,
                  tenant_rules: str = "",
-                 cache_tenant_mb: int | None = None):
+                 cache_tenant_mb: int | None = None,
+                 pulse_seconds: float = 5.0,
+                 ha_dir: str | None = None):
         # Accepts an HA seed list; all master traffic (including the
         # /dir/* proxies mounts rely on) fails over via WeedClient.
         self.client = WeedClient(master_url)
@@ -187,8 +220,39 @@ class FilerServer:
             admission=rpc.AdmissionControl(
                 0, tenant_policy=self.tenant_policy))
         s = self.server
+        # Metadata-HA shard plane (filer/metaha.py): per-shard durable
+        # journals + replication + the epoch fence.  Disarmed until the
+        # master's heartbeat response carries a shard map
+        # (-filer.shards=N on the master); a standalone filer never
+        # pays for it.
+        from .metaha import ShardPlane, ShardWriteError
+        self._shard_err = ShardWriteError
+        self._ha_tmp = None
+        if ha_dir is None:
+            if store_path:
+                ha_dir = store_path + ".shards"
+            else:
+                import tempfile
+                self._ha_tmp = tempfile.TemporaryDirectory(
+                    prefix="filer-shards-")
+                ha_dir = self._ha_tmp.name
+        self.pulse_seconds = pulse_seconds
+        self.shards = ShardPlane(self.filer, ha_dir,
+                                 self_url="",  # set in start()
+                                 pulse_seconds=pulse_seconds)
+        self.filer.shard_sink = self.shards.on_op
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        self._hb_master = None  # leader hint; falls back to seeds
         s.route("GET", "/.meta/subscribe", self._meta_subscribe)
         s.route("GET", "/.meta/info", self._meta_info)
+        s.route("POST", "/.meta/shard/apply", self._shard_apply)
+        s.route("POST", "/.meta/shard/demote", self._shard_demote)
+        s.route("POST", "/.meta/shard/acquire", self._shard_acquire)
+        s.route("POST", "/.meta/shard/insync", self._shard_insync)
+        s.route("GET", "/.meta/shard/status", self._shard_status)
+        s.route("GET", "/.meta/shard/tail", self._shard_tail)
+        s.route("GET", "/debug/shards", self._debug_shards)
         s.route("GET", "/debug/cache", self._debug_cache)
         s.route("GET", "/debug/tenants", self._debug_tenants)
         s.route("GET", "/.ui", self._ui)
@@ -212,6 +276,15 @@ class FilerServer:
         # port like the other gateways (the reference's -metricsPort).
         self.metrics_registry = s.enable_metrics(
             "filer", serve_route=False)
+        # Shard-plane instruments (process-global singletons,
+        # stats/metrics.py): journal appends, replicated applies,
+        # epoch-fence refusals.
+        from ..stats.metrics import (filer_shard_apply_total,
+                                     filer_shard_fences_total,
+                                     filer_shard_journal_records_total)
+        for m in (filer_shard_journal_records_total,
+                  filer_shard_apply_total, filer_shard_fences_total):
+            self.metrics_registry.register_once(m)
         # SLO plane: exemplars + live quantiles on /debug/slow and
         # /debug/slo (literal routes win over the user-path prefix
         # routes, same as the other /debug surfaces above); declared
@@ -234,9 +307,12 @@ class FilerServer:
         # goes to a volume server as it arrives, so RSS stays O(chunk)
         # however large the PUT (autochunk streaming,
         # filer_server_handlers_write_autochunk.go:188).
-        s.prefix_route("POST", "/", self._post, stream_body=True)
-        s.prefix_route("PUT", "/", self._post, stream_body=True)
-        s.prefix_route("DELETE", "/", self._delete)
+        s.prefix_route("POST", "/", self._shard_gated(self._post),
+                       stream_body=True)
+        s.prefix_route("PUT", "/", self._shard_gated(self._post),
+                       stream_body=True)
+        s.prefix_route("DELETE", "/",
+                       self._shard_gated(self._delete))
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -250,17 +326,35 @@ class FilerServer:
             self._loc_watch_stop = self.client.start_location_watch()
         except Exception:  # noqa: BLE001 — degrade to TTL cache
             self._loc_watch_stop = None
+        # Fleet membership: the shard plane needs the bound port as its
+        # identity before the first pulse (port=0 resolves at bind).
+        self.shards.self_url = self.url()
+        try:
+            self.heartbeat_once()  # register before serving writes
+        except Exception:  # noqa: BLE001 — master down: loop retries
+            pass
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="filer-heartbeat")
+        self._hb_thread.start()
 
     def stop(self) -> None:
         # Release any upload threads parked on an open pack before the
         # server stops accepting their responses.
         self.packer.flush_all()
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
         if getattr(self, "_loc_watch_stop", None):
             self._loc_watch_stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
         self.server.stop()
+        self.filer.shard_sink = None
+        self.shards.stop()
         self.filer.close()
+        if self._ha_tmp is not None:
+            self._ha_tmp.cleanup()
 
     def url(self) -> str:
         return self.server.url()
@@ -636,6 +730,11 @@ class FilerServer:
         then every new mutation is pushed the moment it commits — the
         reference's replay-then-tail gRPC stream
         (filer_grpc_server_sub_meta.go), no polling."""
+        if "shard" in query:
+            # Shard-journal mode: exact (shard, seq) resume — the
+            # cursor survives a failover because seq numbers are the
+            # replicated history, not this node's clock.
+            return self._shard_subscribe(query)
         if query.get("tail") == "true":
             return self._meta_subscribe_stream(query)
         since = int(query.get("since_ns", 0))
@@ -668,6 +767,141 @@ class FilerServer:
         prefix = query.get("prefix", "")
         return (200, _MetaTail(self.filer, since, excl, prefix),
                 {"Content-Type": "application/x-ndjson"})
+
+    # -- metadata-HA shard plane (filer/metaha.py) ---------------------------
+
+    def _shard_gated(self, fn):
+        """Write-gate for the user-namespace mutation routes: when the
+        shard plane is armed, refuse up front (before any body bytes or
+        chunk uploads move) unless this filer is the live primary for
+        the path's shard — and convert a mid-commit ShardWriteError
+        (fence/no-insync discovered at journal time) into the same
+        JSON verdict.  409 carries the primary hint for shard-map-aware
+        clients; 503 means contested, retry after the map settles."""
+        def handler(path: str, query: dict, body):
+            if self.shards.armed:
+                p = urllib.parse.unquote(path).rstrip("/") or "/"
+                if "mv.to" in query:
+                    verdict = self.shards.gate_rename(p, query["mv.to"])
+                else:
+                    verdict = self.shards.gate(p)
+                if verdict is not None:
+                    return self._shard_verdict(*verdict)
+            try:
+                return fn(path, query, body)
+            except self._shard_err as e:
+                return self._shard_verdict(e.status, e.doc)
+        return handler
+
+    @staticmethod
+    def _shard_verdict(status: int, doc: dict):
+        if status == 200:
+            return doc
+        return (status, json.dumps(doc).encode(),
+                {"Content-Type": "application/json"})
+
+    def _shard_apply(self, query: dict, body: bytes):
+        d = json.loads(body)
+        return self._shard_verdict(*self.shards.apply_record(
+            int(d["shard"]), int(d["epoch"]), int(d["seq"]),
+            d["record"]))
+
+    def _shard_demote(self, query: dict, body: bytes):
+        d = json.loads(body)
+        return self._shard_verdict(*self.shards.demote(
+            int(d["shard"]), int(d.get("epoch", 0))))
+
+    def _shard_acquire(self, query: dict, body: bytes):
+        d = json.loads(body)
+        return self._shard_verdict(*self.shards.acquire(
+            int(d["shard"]), int(d["epoch"]),
+            list(d.get("followers", [])), int(d.get("version", 0))))
+
+    def _shard_insync(self, query: dict, body: bytes):
+        d = json.loads(body)
+        return self._shard_verdict(*self.shards.reinsync(
+            int(d["shard"]), d["follower"], int(d.get("seq", 0))))
+
+    def _shard_status(self, query: dict, body: bytes) -> dict:
+        if "shard" in query:
+            k = int(query["shard"])
+            log = self.shards.log_for(k)
+            return {"shard": k, "role": self.shards.role(k),
+                    "epoch": self.shards._epochs.get(k, 0),
+                    "last_seq": log.last_seq,
+                    "applied_seq": log.watermark.value}
+        return self.shards.status()
+
+    def _shard_tail(self, query: dict, body: bytes) -> dict:
+        k = int(query["shard"])
+        since = int(query.get("since_seq", 0))
+        limit = min(int(query.get("limit", 500)), 2000)
+        log = self.shards.log_for(k)
+        recs = log.read_from(since + 1, limit)
+        return {"shard": k, "last_seq": log.last_seq,
+                "records": [[s, e, r] for s, e, r in recs]}
+
+    def _shard_subscribe(self, query: dict):
+        k = int(query["shard"])
+        since = int(query.get("since_seq", 0))
+        if query.get("tail") == "true":
+            return (200, _ShardTail(self.shards, k, since),
+                    {"Content-Type": "application/x-ndjson"})
+        limit = min(int(query.get("limit", 1000)), 10000)
+        recs = self.shards.log_for(k).read_from(since + 1, limit)
+        return {"shard": k,
+                "records": [{"seq": s, "epoch": e, "record": r}
+                            for s, e, r in recs],
+                "last_seq": recs[-1][0] if recs else since,
+                "signature": self.filer.signature}
+
+    def _debug_shards(self, query: dict, body: bytes) -> dict:
+        """GET /debug/shards — the plane's own view: per-shard role,
+        epoch, journal head, applied watermark, in-sync set."""
+        return self.shards.status()
+
+    def heartbeat_once(self) -> bool:
+        """Register + pulse with the master (filers are fleet members
+        like volume servers): ships per-shard journal positions so
+        failover promotes the most-caught-up follower, and adopts the
+        shard map the leader's response carries.  A successful pulse
+        renews the primary lease TTL (metaha.note_master_contact) —
+        no master contact, no acks."""
+        from ..fault import registry as _fault
+        payload = {"url": self.url(),
+                   "signature": self.filer.signature,
+                   "shards": self.shards.heartbeat_rows()}
+        master = self._hb_master or self.client.master_url
+        try:
+            if _fault.ARMED:
+                _fault.hit("wan.partition", master=master,
+                           server=self.url())
+            doc = rpc.call_json(master + "/filer/heartbeat",
+                                payload=payload, timeout=5.0)
+        except Exception:  # noqa: BLE001 — master down: rotate seeds
+            seeds = self.client.masters
+            if len(seeds) > 1:
+                i = (seeds.index(master) + 1) % len(seeds) \
+                    if master in seeds else 0
+                self._hb_master = seeds[i]
+            return False
+        if doc.get("is_leader") is False:
+            hint = doc.get("leader")
+            if hint and hint != master:
+                self._hb_master = hint  # redial the leader next tick
+            return False
+        self._hb_master = master
+        self.shards.note_master_contact()
+        if doc.get("num_shards"):
+            self.shards.arm(doc)
+        return True
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.pulse_seconds):
+            try:
+                self.heartbeat_once()
+            except Exception:  # noqa: BLE001 — never kill the pulse
+                pass
 
     def _ui(self, query: dict, body: bytes):
         """Status page (the reference's filer UI).  Lives at /.ui since

@@ -564,4 +564,89 @@ class ClusterCheck(Command):
             lines.append("")
             lines.append("readonly volumes: " + ", ".join(
                 f"{v['id']}@{v['node']}" for v in ro))
+        filers = (doc.get("filers") or {}).get("nodes", [])
+        if filers:
+            lines.append("")
+            lines.append(f"{'FILER':29}  {'HB AGE':>7}  {'PRIMARY OF':>10}")
+            for f in filers:
+                mark = "" if f.get("alive") else "  !! dead"
+                lines.append(
+                    f"{f['url']:29}  {f['age_seconds']:7.1f}  "
+                    f"{f['shards_primary']:10d}{mark}")
         return "\n".join(lines)
+
+
+@register
+class FilerShardsLs(Command):
+    name = "filer.shards.ls"
+    help = ("filer.shards.ls — the master's filer shard map: per-shard "
+            "primary, fencing epoch, followers, and each registered "
+            "filer's journal positions (metadata-HA plane; empty when "
+            "the master runs without -filer.shards)")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        try:
+            doc = rpc.call(f"{env.master_url}/cluster/filer/shards",
+                           timeout=10.0)
+        except Exception as e:  # noqa: BLE001
+            raise ShellError(
+                f"cannot read the shard map: {e}") from None
+        assert isinstance(doc, dict)
+        if not doc.get("num_shards"):
+            return ("metadata plane disarmed "
+                    "(master started without -filer.shards)")
+        lines = [f"{doc['num_shards']} shards, map version "
+                 f"{doc.get('version', 0)}", "",
+                 f"{'SHARD':>5}  {'EPOCH':>5}  {'PRIMARY':29}  FOLLOWERS"]
+        for k in sorted((doc.get("shards") or {}), key=int):
+            row = doc["shards"][k]
+            lines.append(
+                f"{int(k):5d}  {row.get('epoch', 0):5d}  "
+                f"{row.get('primary') or '(none)':29}  "
+                + (", ".join(row.get("followers", [])) or "-"))
+        filers = doc.get("filers", [])
+        if filers:
+            lines.append("")
+            lines.append(f"{'FILER':29}  {'ALIVE':5}  JOURNALS "
+                         "(shard:last_seq/applied)")
+            for f in filers:
+                js = " ".join(
+                    f"{k}:{v.get('last_seq', 0)}/"
+                    f"{v.get('applied_seq', 0)}"
+                    for k, v in sorted(f.get("shards", {}).items(),
+                                       key=lambda kv: int(kv[0]))) \
+                    or "-"
+                lines.append(f"{f['url']:29}  "
+                             f"{'yes' if f.get('alive') else 'NO':5}  "
+                             f"{js}")
+        return "\n".join(lines)
+
+
+@register
+class FilerShardsMove(Command):
+    name = "filer.shards.move"
+    help = ("filer.shards.move -shard N -to http://host:port — "
+            "demote-first primary transfer: the old primary stops "
+            "acking before the new one exists anywhere (mid-move the "
+            "shard fails closed), then the epoch bumps and the target "
+            "acquires; clients re-route on their next 409/map refresh")
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, _rest = self.parse_flags(args)
+        if "shard" not in flags or "to" not in flags:
+            raise ShellError(
+                "filer.shards.move -shard N -to url is required")
+        shard = int(flags["shard"])
+        to = flags["to"]
+        to = to if "://" in to else f"http://{to}"
+        try:
+            out = rpc.call_json(
+                f"{env.master_url}/cluster/filer/shards/move", "POST",
+                {"shard": shard, "to": to}, timeout=30.0)
+        except Exception as e:  # noqa: BLE001
+            raise ShellError(f"move failed: {e}") from None
+        if out.get("already"):
+            return f"shard {shard} already primary on {to}"
+        return (f"shard {shard} moved to {to} at epoch "
+                f"{out.get('epoch', '?')} (old primary "
+                f"{out.get('old_primary') or '(none)'} fenced)")

@@ -461,6 +461,27 @@ filer_packed_bytes_total = Counter(
     "SeaweedFS_filer_packed_bytes_total",
     "payload bytes stored via the small-file packer")
 
+# Metadata-HA shard plane (filer/metaha.py): journal appends on the
+# shard primary, replicated applies on followers, and epoch-fence
+# refusals (the metadata split-brain guard).
+
+filer_shard_journal_records_total = Counter(
+    "SeaweedFS_filer_shard_journal_records_total",
+    "metadata mutations framed into a shard .mlog on the primary",
+    ("shard",))
+
+filer_shard_apply_total = Counter(
+    "SeaweedFS_filer_shard_apply_total",
+    "replicated shard records applied on followers, by result "
+    "(applied / duplicate)",
+    ("shard", "result"))
+
+filer_shard_fences_total = Counter(
+    "SeaweedFS_filer_shard_fences_total",
+    "stale-epoch shard operations refused (the metadata split-brain "
+    "fence)",
+    ("shard",))
+
 
 def observe_batch_stage(stages: dict, stage: str, seconds: float,
                         nbytes: int) -> None:

@@ -857,6 +857,56 @@ def _drive_device_slow(cl):
         ledger.note_pipeline("encode", rec, node="evdev:0")
 
 
+def _shard_master():
+    """Unstarted master with the metadata-HA plane armed and two fake
+    filers registered via the real heartbeat handler (handlers work
+    without start(); the fake URLs refuse connections fast, which the
+    best-effort acquire/demote pushes tolerate by design)."""
+    from seaweedfs_tpu.cluster.master import MasterServer
+    m = MasterServer(port=0, filer_shards=2)
+    a, b = "http://127.0.0.1:1", "http://127.0.0.1:2"
+    for u in (a, b):
+        m._filer_heartbeat({}, json.dumps({"url": u,
+                                           "shards": {}}).encode())
+    return m, a, b
+
+
+def _drive_shard_promote(cl):
+    """Failover through the real sweep: the primary misses its pulses,
+    the most-caught-up live follower is promoted at epoch+1."""
+    m, _a, _b = _shard_master()
+    dead = m._shard_map[0]["primary"]
+    m._filers[dead]["last_seen"] = 0.0
+    with root_span("drive.shard_promote", "test"):
+        m._sweep_dead_filers()
+    assert m._shard_map[0]["primary"] != dead
+
+
+def _drive_shard_move(cl):
+    m, a, b = _shard_master()
+    old = m._shard_map[0]["primary"]
+    target = b if old == a else a
+    # The fake old primary refuses its demote push, and a move away
+    # from an unreachable primary fails CLOSED while its lease may
+    # still be live — age it past the 3-pulse TTL so the move lands.
+    m._filers[old]["last_seen"] = 0.0
+    with root_span("drive.shard_move", "test"):
+        out = m._filer_shard_move(
+            {}, json.dumps({"shard": 0, "to": target}).encode())
+    assert out["moved"] and out["primary"] == target
+
+
+def _drive_shard_fence(cl, tmp_path=None):
+    """A durable epoch raise on the filer-side plane — the moment a
+    stale primary's pushes become refusable."""
+    import tempfile
+    from seaweedfs_tpu.filer.metaha import ShardPlane
+    plane = ShardPlane(None, tempfile.mkdtemp(),
+                       "http://127.0.0.1:3")
+    with root_span("drive.shard_fence", "test"):
+        assert plane._fence(0, 1)
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -903,6 +953,9 @@ DRIVERS = {
     "lease.move": _drive_lease_move,
     "lease.fence": _drive_lease_fence,
     "device.slow": _drive_device_slow,
+    "shard.promote": _drive_shard_promote,
+    "shard.move": _drive_shard_move,
+    "shard.fence": _drive_shard_fence,
 }
 
 
@@ -919,8 +972,9 @@ def test_driver_catalog_matches_registry():
     # volume.expired + 2 tenancy types: quota.exceeded +
     # tenant.throttled + 1 wire-flow type: flows.budget + 3 geo lease
     # types: lease.acquire/move/fence + 1 device roofline type:
-    # device.slow).
-    assert len(TYPES) == 45
+    # device.slow + 3 filer metadata-HA types: shard.promote/move/
+    # fence).
+    assert len(TYPES) == 48
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))

@@ -88,6 +88,53 @@ def test_meta_log_no_duplicates_between_disk_and_ring(tmp_path):
     assert [e["n"] for e in log.read_since(0)] == [0, 1, 2, 3, 4]
 
 
+def test_meta_log_truncates_torn_tail_once_at_open(tmp_path):
+    """A crash mid-append leaves a torn final line; reopen must
+    physically truncate it (once, at open — not re-skip it on every
+    read) and every intact event must survive."""
+    d = str(tmp_path / "torn")
+    log = MetaLog(d, capacity=2)
+    for i in range(10):
+        log.append({"ts_ns": i + 1, "n": i})
+    log.close()
+    seg = sorted((tmp_path / "torn").glob("*.meta.jsonl"))[-1]
+    good_size = seg.stat().st_size
+    with open(seg, "ab") as f:
+        f.write(b'{"ts_ns": 999, "n":')  # torn: no newline, bad JSON
+    log2 = MetaLog(d, capacity=2)
+    assert seg.stat().st_size == good_size  # tail physically gone
+    assert [e["n"] for e in log2.read_since(0)] == list(range(10))
+    # Appends after the repair extend the truncated file cleanly.
+    log2.append({"ts_ns": 100, "n": 10})
+    log2.close()
+    log3 = MetaLog(d, capacity=2)
+    assert [e["n"] for e in log3.read_since(0)] == list(range(11))
+    log3.close()
+
+
+def test_meta_log_mid_segment_tear_skips_only_bad_line(tmp_path):
+    """Bit rot in the middle of a segment must drop ONLY the damaged
+    line — the old per-segment exception handler ate every event after
+    it (and the file must NOT be truncated at the damage: the good
+    suffix is still valid history)."""
+    d = str(tmp_path / "midtear")
+    log = MetaLog(d, capacity=2)
+    for i in range(10):
+        log.append({"ts_ns": i + 1, "n": i})
+    log.close()
+    seg = sorted((tmp_path / "midtear").glob("*.meta.jsonl"))[-1]
+    lines = seg.read_bytes().splitlines(keepends=True)
+    assert len(lines) >= 3
+    lines[1] = b'{"ts_ns": corrupted!!\n'
+    seg.write_bytes(b"".join(lines))
+    size = seg.stat().st_size
+    log2 = MetaLog(d, capacity=2)
+    assert seg.stat().st_size == size  # mid-segment: no truncation
+    got = [e["n"] for e in log2.read_since(0)]
+    assert len(got) == 9 and got == sorted(got)  # one event lost, rest
+    log2.close()                                 # intact and ordered
+
+
 # -- Filer integration -----------------------------------------------------
 
 def test_filer_meta_log_survives_restart(tmp_path):

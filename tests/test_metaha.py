@@ -1,0 +1,576 @@
+"""Metadata plane HA: sharded filer fleet with crash-safe
+log-replicated shards, epoch-fenced failover, and shard-map-aware
+clients.
+
+The namespace shards on the first path component; each shard primary
+frames every acked mutation into a CRC-framed `.mlog` journal
+(replication/rlog.py FramedLog), fsyncs it, and semi-sync-replicates
+it to in-sync followers BEFORE the 200.  The master owns the shard
+map (filers register and heartbeat like volume servers) and promotes
+the most-caught-up follower at epoch+1 when a primary dies.
+
+The PR acceptance gates live here:
+
+- `test_kill_primary_mid_storm_zero_acked_op_loss` — a shard primary
+  is killed (kill -9 analog: no demote, no goodbye pulse) in the
+  middle of a create/rename storm; the master promotes a follower,
+  shard-map-aware clients converge on it, and EVERY op acked before
+  the kill is still present after the failover.
+- `test_partition_during_move_no_dual_primary_ack` — `wan.partition`
+  armed against the old primary while the master moves the shard: at
+  no point do two filers ack writes for the shard (the partitioned
+  side fails closed when its lease TTL lapses; its pushes are fenced
+  by epoch), and after heal the trees converge equal on every
+  replica.
+- torn-mlog restart — a crash mid-append tears the journal tail; the
+  reopen truncates exactly the torn frame and the seq chain resumes.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import fault
+from seaweedfs_tpu.cluster import resilience, rpc
+from seaweedfs_tpu.cluster.master import MasterServer
+from seaweedfs_tpu.cluster.volume_server import VolumeServer
+from seaweedfs_tpu.filer import Filer, MemoryStore
+from seaweedfs_tpu.filer.client import (FilerProxy, ShardedFilerClient)
+from seaweedfs_tpu.filer.meta_aggregator import ShardMetaAggregator
+from seaweedfs_tpu.filer.metaha import (ShardPlane, ShardWriteError,
+                                        shard_key, shard_of)
+from seaweedfs_tpu.filer.server import FilerServer
+from seaweedfs_tpu.replication.rlog import FramedLog
+from seaweedfs_tpu.stats.promcheck import validate_exposition
+
+pytestmark = pytest.mark.metaha
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fault.disarm_all()
+    resilience.reset_breakers()
+    yield
+    fault.disarm_all()
+    resilience.reset_breakers()
+
+
+def _wait(cond, timeout=20.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+# -- shard keying ------------------------------------------------------------
+
+def test_shard_key_first_path_component():
+    assert shard_key("/a/b/c") == "a"
+    assert shard_key("/a") == "a"
+    assert shard_key("a/b") == "a"
+    assert shard_key("/") == ""
+    # A rename inside one top-level tree is single-shard by
+    # construction — the whole subtree hashes on the same component.
+    for n in (1, 2, 7, 64):
+        assert shard_of("/proj/deep/file", n) == shard_of("/proj", n)
+        assert 0 <= shard_of("/proj", n) < n
+
+
+def _dir_for_shard(k: int, num_shards: int) -> str:
+    """A top-level directory name that hashes to shard `k`."""
+    i = 0
+    while True:
+        name = f"d{k}x{i}"
+        if shard_of("/" + name, num_shards) == k:
+            return name
+        i += 1
+
+
+# -- FramedLog: the shard `.mlog` -------------------------------------------
+
+def test_framed_log_append_read_restart(tmp_path):
+    path = str(tmp_path / "s.mlog")
+    log = FramedLog(path)
+    for i in range(5):
+        assert log.append(1, {"op": "set", "n": i}) == i + 1
+    log.sync()
+    assert [r["n"] for _s, _e, r in log.read_from(3)] == [2, 3, 4]
+    log.close()
+    # Restart: seqs, epoch, and payloads all recover from the file.
+    log2 = FramedLog(path)
+    assert (log2.first_seq, log2.last_seq, log2.last_epoch) == (1, 5, 1)
+    assert log2.append(2, {"op": "set", "n": 5}) == 6
+    assert log2.read_from(6) == [(6, 2, {"op": "set", "n": 5})]
+    log2.close()
+
+
+def test_framed_log_torn_tail_truncated_on_restart(tmp_path):
+    """THE torn-mlog gate: a kill -9 mid-append leaves a half-written
+    frame; reopen drops exactly that frame — every fsync'd (acked)
+    record survives and the seq chain resumes where it stopped."""
+    path = str(tmp_path / "torn.mlog")
+    log = FramedLog(path)
+    for i in range(8):
+        log.append(3, {"op": "set", "n": i})
+    log.sync()
+    log.close()
+    with open(path, "ab") as f:           # torn frame: header only,
+        f.write(b"\x00" * 9)              # no payload, no CRC
+    log2 = FramedLog(path)
+    assert log2.last_seq == 8
+    assert [r["n"] for _s, _e, r in log2.read_from(1)] == list(range(8))
+    assert log2.append(3, {"op": "set", "n": 8}) == 9
+    log2.close()
+    # CRC-bad full frame (bit rot in the tail) is also stepped over.
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[:-1] + bytes([data[-1] ^ 0xFF]))
+    log3 = FramedLog(path)
+    assert log3.last_seq == 8
+    log3.close()
+
+
+def test_framed_log_seq_gap_raises_and_follower_passthrough(tmp_path):
+    log = FramedLog(str(tmp_path / "gap.mlog"))
+    assert log.append(1, {"n": 0}, seq=7) == 7  # follower bootstrap:
+    assert log.first_seq == 7                   # any starting seq
+    assert log.append(1, {"n": 1}, seq=8) == 8
+    with pytest.raises(ValueError):
+        log.append(1, {"n": 9}, seq=10)         # gap: refuse
+    assert log.last_seq == 8
+    log.close()
+
+
+def test_framed_log_truncate_from_returns_newest_first(tmp_path):
+    log = FramedLog(str(tmp_path / "cut.mlog"))
+    for i in range(6):
+        log.append(1, {"n": i})
+    dropped = log.truncate_from(4)
+    assert [r["n"] for _s, _e, r in dropped] == [5, 4, 3]
+    assert log.last_seq == 3
+    assert log.append(2, {"n": 99}) == 4  # chain resumes at the cut
+    log.close()
+
+
+# -- ShardPlane: fencing, idempotency, semi-sync ----------------------------
+
+def _plane(tmp_path, url="http://127.0.0.1:1"):
+    f = Filer(store=MemoryStore())
+    plane = ShardPlane(f, str(tmp_path / "ha"), url, pulse_seconds=5.0)
+    plane.num_shards = 2
+    return f, plane
+
+
+def test_apply_record_fences_stale_epochs_durably(tmp_path):
+    f, plane = _plane(tmp_path)
+    st, _ = plane.apply_record(0, 2, 1, {"op": "kv", "key": "a",
+                                         "val": None})
+    assert st == 200
+    # A push from a deposed primary at the old epoch is refused.
+    st, doc = plane.apply_record(0, 1, 2, {"op": "kv", "key": "b",
+                                           "val": None})
+    assert st == 409 and doc["current"] == 2
+    # The fence survives a restart (shard_epochs.json is durable,
+    # written BEFORE any record at the new epoch is accepted).
+    plane.stop()
+    f2, plane2 = _plane(tmp_path)
+    st, _ = plane2.apply_record(0, 1, 2, {"op": "kv", "key": "b",
+                                          "val": None})
+    assert st == 409
+    plane2.stop()
+    f.close()
+    f2.close()
+
+
+def test_apply_record_idempotent_and_gap_refused(tmp_path):
+    f, plane = _plane(tmp_path)
+    rec = {"op": "set", "entry": {"path": "/x/a", "is_directory": True}}
+    assert plane.apply_record(0, 1, 1, rec)[0] == 200
+    st, doc = plane.apply_record(0, 1, 1, rec)  # replay: no-op, acked
+    assert st == 200 and doc["dup"]
+    st, doc = plane.apply_record(0, 1, 5, rec)  # gap: refused unacked
+    assert st == 409 and "gap" in doc["error"]
+    assert plane.log_for(0).last_seq == 1
+    plane.stop()
+    f.close()
+
+
+def test_primary_fails_closed_without_master_contact(tmp_path):
+    """No master contact, no acks: the lease-TTL half of the
+    no-dual-primary guarantee (the epoch fence is the other)."""
+    f, plane = _plane(tmp_path)
+    shard = shard_of("/solo", 2)
+    plane.acquire(shard, 1, followers=[])
+    verdict = plane.gate("/solo/file")    # lease never renewed
+    assert verdict is not None and verdict[0] == 503
+    assert "lease" in verdict[1]["error"]
+    plane.note_master_contact()           # a pulse landed: acks resume
+    assert plane.gate("/solo/file") is None
+    plane.stop()
+    f.close()
+
+
+def test_semi_sync_refuses_when_no_follower_acks(tmp_path):
+    """The zero-acked-op-loss bar: with followers configured but none
+    reachable, the primary journals locally then REFUSES the ack —
+    an acked op always exists on at least two disks."""
+    f, plane = _plane(tmp_path)
+    shard = shard_of("/twod", 2)
+    plane.acquire(shard, 1,
+                  followers=["http://127.0.0.1:9"])  # nothing there
+    plane.note_master_contact()
+    with pytest.raises(ShardWriteError) as ei:
+        plane.on_op({"op": "set",
+                     "entry": {"path": "/twod", "is_directory": True}},
+                    "/twod")
+    assert ei.value.status == 503
+    assert "no in-sync follower" in ei.value.doc["error"]
+    plane.stop()
+    f.close()
+
+
+# -- the fleet ---------------------------------------------------------------
+
+SHARDS = 2
+PULSE = 0.4
+
+
+def _start_fleet(tmp, n_filers=3):
+    (tmp / "master").mkdir(exist_ok=True)
+    master = MasterServer(volume_size_limit_mb=64,
+                          meta_dir=str(tmp / "master"),
+                          pulse_seconds=PULSE, filer_shards=SHARDS)
+    master.start()
+    vs = VolumeServer(master.url(), [str(tmp / "vs")], pulse_seconds=60)
+    vs.start()
+    filers = []
+    for i in range(n_filers):
+        fs = FilerServer(master.url(), pulse_seconds=PULSE,
+                         ha_dir=str(tmp / f"ha{i}"))
+        fs.start()
+        filers.append(fs)
+    _wait(lambda: all(fs.shards.armed and
+                      len(fs.shards.map) == SHARDS for fs in filers),
+          msg="shard map never armed on every filer")
+    return master, vs, filers
+
+
+def _stop_fleet(master, vs, filers):
+    fault.disarm_all()
+    resilience.reset_breakers()
+    for fs in filers:
+        try:
+            fs.stop()
+        except Exception:  # noqa: BLE001 — hard-killed mid-test
+            pass
+    vs.stop()
+    master.stop()
+
+
+def _hard_kill(fs: FilerServer) -> None:
+    """kill -9 analog: the process vanishes — no demote, no goodbye
+    pulse, journals exactly as the last fsync left them."""
+    fs._hb_stop.set()
+    fs.server.stop()
+    fs.filer.shard_sink = None
+    fs.shards.stop()
+
+
+def _primary_of(master, shard: int) -> str:
+    doc = rpc.call(master.url() + "/cluster/filer/shards")
+    return (doc["shards"].get(str(shard)) or {}).get("primary")
+
+
+def _by_url(filers, url):
+    return next(fs for fs in filers if fs.url() == url)
+
+
+def _wait_insync(filers, master, shard: int, n: int = 1):
+    def ok():
+        url = _primary_of(master, shard)
+        if not url:
+            return False
+        try:
+            fs = _by_url(filers, url)
+        except StopIteration:
+            return False
+        return len(fs.shards._insync.get(shard, ())) >= n
+    _wait(ok, msg=f"shard {shard} never reached {n} in-sync followers")
+
+
+def _tree(fs: FilerServer, path: str) -> dict:
+    """Recursive {path: is_directory} snapshot straight off the local
+    store — reads are ungated, so this sees exactly what replicated."""
+    out = {}
+    try:
+        entries = fs.filer.list_entries(path, "", False, 10_000)
+    except Exception:  # noqa: BLE001 — dir not replicated (yet)
+        return out
+    for e in entries:
+        out[e.path] = e.is_directory
+        if e.is_directory:
+            out.update(_tree(fs, e.path))
+    return out
+
+
+def test_fleet_routes_replicates_and_hints(tmp_path):
+    master, vs, filers = _start_fleet(tmp_path)
+    try:
+        for k in range(SHARDS):
+            _wait_insync(filers, master, k)
+        cl = ShardedFilerClient(master.url(), map_ttl=0.2)
+        d = _dir_for_shard(0, SHARDS)
+        cl.mkdir(f"/{d}")
+        cl.mkdir(f"/{d}/inner")
+        cl.rename(f"/{d}/inner", f"/{d}/moved")
+        shard = shard_of(f"/{d}", SHARDS)
+        primary = _primary_of(master, shard)
+        # 409 wrong-shard from a non-primary carries the primary hint.
+        other = next(fs for fs in filers if fs.url() != primary)
+        req = urllib.request.Request(other.url() + f"/{d}/nope",
+                                     data=b"x", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 409
+        hint = json.loads(ei.value.read())
+        assert hint["error"] == "wrong shard"
+        assert hint["primary"] == primary and hint["shard"] == shard
+        # Cross-shard rename is refused up front (400, not a partial
+        # delete+create split across two histories).
+        d1 = _dir_for_shard(1, SHARDS)
+        cl.mkdir(f"/{d1}")
+        with pytest.raises((rpc.RpcError,
+                            urllib.error.HTTPError)) as ei:
+            FilerProxy(primary).rename(f"/{d}", f"/{d1}/stolen")
+        assert getattr(ei.value, "status", getattr(ei.value, "code",
+                                                   0)) == 400
+        # Semi-sync: the acked rename is already on every follower's
+        # journal; the store catches up within a tailer beat.
+        pfs = _by_url(filers, primary)
+        want = pfs.shards.log_for(shard).last_seq
+        followers = [fs for fs in filers if fs.url() != primary]
+        _wait(lambda: all(
+            fs.shards.log_for(shard).watermark.value >= want
+            for fs in followers), msg="followers never leveled")
+        for fs in followers:
+            t = _tree(fs, f"/{d}")
+            assert f"/{d}/moved" in t and f"/{d}/inner" not in t
+        # The fleet shows up in the master's health rollup.
+        hz = rpc.call(master.url() + "/cluster/healthz")
+        assert {r["url"] for r in hz["filers"]["nodes"]} == \
+            {fs.url() for fs in filers}
+        assert not [p for p in hz["problems"] if "filer" in p]
+    finally:
+        _stop_fleet(master, vs, filers)
+
+
+def test_kill_primary_mid_storm_zero_acked_op_loss(tmp_path):
+    """THE failover gate: kill -9 the shard primary mid create/rename
+    storm.  Every op acked before the kill survives the promotion,
+    the most-caught-up follower takes over at epoch+1, and the
+    shard-map-aware client converges without surfacing the death."""
+    master, vs, filers = _start_fleet(tmp_path)
+    try:
+        shard = 0
+        d = _dir_for_shard(shard, SHARDS)
+        _wait_insync(filers, master, shard, n=2)
+        old_primary = _primary_of(master, shard)
+        old_epoch = rpc.call(master.url() + "/cluster/filer/shards")[
+            "shards"][str(shard)]["epoch"]
+        cl = ShardedFilerClient(master.url(), map_ttl=0.2,
+                                contested_deadline=20.0)
+        cl.mkdir(f"/{d}")
+        acked: list[tuple[str, str]] = []  # (kind, path) in ack order
+        for i in range(10):
+            cl.mkdir(f"/{d}/pre{i}")
+            acked.append(("dir", f"/{d}/pre{i}"))
+        cl.rename(f"/{d}/pre0", f"/{d}/ren0")
+        acked[0] = ("dir", f"/{d}/ren0")
+        _hard_kill(_by_url(filers, old_primary))
+        # The storm keeps going THROUGH the failover: the client eats
+        # the contested 503s (old primary gone, promotion in flight)
+        # and lands every op on the promoted follower.
+        for i in range(10):
+            cl.mkdir(f"/{d}/post{i}")
+            acked.append(("dir", f"/{d}/post{i}"))
+        cl.rename(f"/{d}/post0", f"/{d}/renp")
+        acked[10] = ("dir", f"/{d}/renp")
+        new_primary = _primary_of(master, shard)
+        assert new_primary and new_primary != old_primary
+        new_epoch = rpc.call(master.url() + "/cluster/filer/shards")[
+            "shards"][str(shard)]["epoch"]
+        assert new_epoch > old_epoch, "promotion must bump the fence"
+        # ZERO acked-op loss: every ack is visible on the new primary.
+        t = _tree(_by_url(filers, new_primary), f"/{d}")
+        for _kind, path in acked:
+            assert path in t, f"acked {path} lost across failover"
+        assert f"/{d}/pre0" not in t and f"/{d}/post0" not in t
+        # The surviving follower converges on the same tree.
+        live = [fs for fs in filers
+                if fs.url() not in (old_primary, new_primary)]
+        want = _by_url(filers,
+                       new_primary).shards.log_for(shard).last_seq
+        _wait(lambda: all(
+            fs.shards.log_for(shard).watermark.value >= want
+            for fs in live), msg="survivor follower never leveled")
+        for fs in live:
+            assert _tree(fs, f"/{d}") == t
+    finally:
+        _stop_fleet(master, vs, filers)
+
+
+def test_partition_during_move_no_dual_primary_ack(tmp_path):
+    """THE split-brain gate: `wan.partition` cuts the old primary off
+    mid shard-move.  The partitioned side fails CLOSED when its lease
+    TTL lapses (never acks in the dark), its late pushes are fenced by
+    epoch, the promoted side acks — and after heal every replica's
+    tree is equal."""
+    master, vs, filers = _start_fleet(tmp_path)
+    try:
+        shard = 1
+        d = _dir_for_shard(shard, SHARDS)
+        _wait_insync(filers, master, shard, n=2)
+        cl = ShardedFilerClient(master.url(), map_ttl=0.2,
+                                contested_deadline=20.0)
+        cl.mkdir(f"/{d}")
+        cl.mkdir(f"/{d}/base")
+        a_url = _primary_of(master, shard)
+        a = _by_url(filers, a_url)
+        b = next(fs for fs in filers if fs.url() != a_url)
+        fault.arm("wan.partition", f"fail*100000~{a_url}")
+        try:
+            move_body = json.dumps({"shard": shard,
+                                    "to": b.url()}).encode()
+            # A move while the old primary's lease may still be live
+            # behind the partition fails CLOSED — transferring now
+            # could produce two acking primaries (the geo lease-move
+            # stance).
+            st, doc = rpc.call_status(
+                master.url() + "/cluster/filer/shards/move", "POST",
+                move_body)
+            assert st == 503 and "NOT moved" in json.dumps(doc)
+            assert _primary_of(master, shard) == a_url
+            # A's pulses die behind the partition; its lease TTL
+            # (3 pulses) lapses and it stops acking — in the dark,
+            # fail closed.
+            _wait(lambda: a.shards.gate(f"/{d}/x") is not None,
+                  msg="partitioned primary never failed closed")
+            st = a.shards.gate(f"/{d}/x")
+            assert st[0] == 503 and "lease" in st[1]["error"]
+            # Once the master has seen the TTL out, the move goes
+            # through (the sweep may promote on its own first — the
+            # retry then transfers from that interim primary).
+            def try_move():
+                s, mdoc = rpc.call_status(
+                    master.url() + "/cluster/filer/shards/move",
+                    "POST", move_body)
+                return s == 200 and (mdoc.get("moved") or
+                                     mdoc.get("already"))
+            _wait(try_move, msg="move never cleared the lease TTL")
+            assert _primary_of(master, shard) == b.url()
+            moved_epoch = rpc.call(
+                master.url() + "/cluster/filer/shards")["shards"][
+                str(shard)]["epoch"]
+            # NO DUAL ACK: a write straight at A is refused...
+            with pytest.raises((rpc.RpcError, OSError)) as ei:
+                FilerProxy(a_url).mkdir(f"/{d}/brainA")
+            assert getattr(ei.value, "status", 503) >= 500
+            # ...while the promoted primary acks through the client
+            # (B's in-sync pushes to A die on the partition too; the
+            # third filer acks the semi-sync write).
+            cl.refresh_map(force=True)
+            cl.mkdir(f"/{d}/during")
+            # A late push at A's old epoch is fenced with 409 by the
+            # promoted primary — the other half of the guarantee.
+            st, fdoc = rpc.call_status(
+                b.url() + "/.meta/shard/apply", "POST",
+                json.dumps({"shard": shard, "epoch": moved_epoch - 1,
+                            "seq": 1,
+                            "record": {"op": "kv", "key": "z",
+                                       "val": None}}).encode())
+            assert st == 409 and "stale epoch" in fdoc["error"]
+        finally:
+            fault.disarm_all()
+            resilience.reset_breakers()
+        # Heal: A heartbeats again, adopts the moved map as a
+        # follower, and its tailer levels it with the new history.
+        _wait(lambda: a.shards.role(shard) == "follower",
+              msg="healed primary never demoted itself")
+        want = b.shards.log_for(shard).last_seq
+        _wait(lambda: all(
+            fs.shards.log_for(shard).watermark.value >= want
+            for fs in filers if fs is not b),
+            msg="healed fleet never leveled")
+        trees = [_tree(fs, f"/{d}") for fs in filers]
+        assert trees[0] == trees[1] == trees[2]
+        assert f"/{d}/during" in trees[0]
+        assert f"/{d}/brainA" not in trees[0]
+    finally:
+        _stop_fleet(master, vs, filers)
+
+
+def test_shard_subscribe_resumes_by_seq_across_fleet(tmp_path):
+    """Cluster-wide (shard, seq) subscription: exact resume positions
+    that survive because seqs ARE the replicated history."""
+    master, vs, filers = _start_fleet(tmp_path, n_filers=2)
+    try:
+        for k in range(SHARDS):
+            _wait_insync(filers, master, k)
+        cl = ShardedFilerClient(master.url(), map_ttl=0.2)
+        dirs = [_dir_for_shard(k, SHARDS) for k in range(SHARDS)]
+        for d in dirs:
+            cl.mkdir(f"/{d}")
+        recs, cursors = cl.poll_events()
+        made = {r["record"]["entry"]["path"] for r in recs
+                if r["record"].get("op") == "set"}
+        assert {f"/{d}" for d in dirs} <= made
+        assert set(cursors) == set(range(SHARDS))
+        # Resume: only records past the cursor come back, from every
+        # shard's own primary.
+        for d in dirs:
+            cl.mkdir(f"/{d}/next")
+        recs2, cursors2 = cl.poll_events(cursors)
+        paths2 = {r["record"]["entry"]["path"] for r in recs2
+                  if r["record"].get("op") == "set"}
+        assert paths2 == {f"/{d}/next" for d in dirs}
+        assert all(cursors2[k] > cursors[k] for k in cursors)
+        # The aggregator rides the same cursors on a thread.
+        agg = ShardMetaAggregator(master.url())
+        seen = []
+        agg.subscribe(lambda s, q, r: seen.append((s, q,
+                                                   r.get("op"))))
+        agg.start(cursors2)
+        cl.mkdir(f"/{dirs[0]}/live")
+        _wait(lambda: any(op == "set" for _s, _q, op in seen),
+              msg="aggregator never saw the live op")
+        agg.stop()
+    finally:
+        _stop_fleet(master, vs, filers)
+
+
+def test_shard_metrics_promcheck(tmp_path):
+    master, vs, filers = _start_fleet(tmp_path, n_filers=2)
+    try:
+        for k in range(SHARDS):
+            _wait_insync(filers, master, k)
+        cl = ShardedFilerClient(master.url(), map_ttl=0.2)
+        d = _dir_for_shard(0, SHARDS)
+        cl.mkdir(f"/{d}")
+        cl.mkdir(f"/{d}/one")
+        text = "\n".join(fs.metrics_registry.expose()
+                         for fs in filers)
+        for fam in ("SeaweedFS_filer_shard_journal_records_total",
+                    "SeaweedFS_filer_shard_apply_total"):
+            assert fam in text, f"{fam} missing from the exposition"
+        for fs in filers:
+            t = fs.metrics_registry.expose()
+            assert validate_exposition(t) == [], \
+                validate_exposition(t)[:5]
+    finally:
+        _stop_fleet(master, vs, filers)
