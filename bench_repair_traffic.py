@@ -20,8 +20,16 @@ measurement gate ROADMAP item 1 (regenerating codes) needs: a codec
 whose predicted savings don't survive contact with the wire (sidecar
 overhead, retry amplification) is not a savings.
 
+Round 3 adds end-to-end **MTTR**: a holder of 001-replicated data, an
+rs(10,4) stripe, and an lrc(10,2,2) stripe is killed for good and the
+durability autopilot (cluster/repair_daemon.py) drives the deficit to
+convergence — wall time kill -> restored redundancy per scheme, with
+bytes-on-wire (repair.fetch / ec.gather in the flow ledger)
+cross-asserted against the actual file sizes moved.
+
 Environment knobs: BENCH_REPAIR_MB (local volume size, default 256),
 BENCH_REPAIR_WIRE_MB (wire-leg volume size, default 16),
+BENCH_REPAIR_MTTR_MB (MTTR-leg volume size, default 8),
 SEAWEEDFS_TPU_CODER (backend; default auto — pallas on TPU).
 
 All diagnostics go to stderr; stdout carries exactly one JSON line.
@@ -40,6 +48,7 @@ import numpy as np
 
 VOLUME_MB = int(os.environ.get("BENCH_REPAIR_MB", "256"))
 WIRE_MB = int(os.environ.get("BENCH_REPAIR_WIRE_MB", "16"))
+MTTR_MB = int(os.environ.get("BENCH_REPAIR_MTTR_MB", "8"))
 LOST_SHARD = 3  # a data shard inside LRC local group A
 
 
@@ -214,6 +223,163 @@ def bench_codec_wire(name: str) -> dict:
         master.stop()
 
 
+def bench_repair_mttr(mode: str) -> dict:
+    """Round 3: mean-time-to-repair, kill -> converged, through the
+    durability autopilot.  One volume of BENCH_REPAIR_MTTR_MB data is
+    made durable three ways — 001 replication, rs(10,4), lrc(10,2,2)
+    — then a holder is killed for good and the repair daemon drives
+    the deficit to convergence.  MTTR is wall time from the kill to
+    restored redundancy; bytes-on-wire come from the flow ledger
+    (repair.fetch for re-replication, ec.gather for rebuilds) and are
+    cross-asserted against the actual file sizes so the ledger can
+    never silently under-count repair traffic."""
+    import shutil
+    import tempfile as _tf
+
+    from seaweedfs_tpu.cluster import rpc
+    from seaweedfs_tpu.cluster.client import WeedClient
+    from seaweedfs_tpu.cluster.master import MasterServer
+    from seaweedfs_tpu.cluster.volume_server import VolumeServer
+    from seaweedfs_tpu.codecs import get_codec
+    from seaweedfs_tpu.stats import flows
+
+    tmp = _tf.mkdtemp(prefix=f"bench_mttr_{mode}_")
+    master = MasterServer(volume_size_limit_mb=max(MTTR_MB * 4, 64),
+                          meta_dir=os.path.join(tmp, "meta"),
+                          pulse_seconds=60)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = os.path.join(tmp, f"vs{i}")
+        os.makedirs(d)
+        vs = VolumeServer(master.url(), [d], pulse_seconds=60)
+        vs.start()
+        servers.append(vs)
+
+    def kill(vs):
+        t0 = time.perf_counter()
+        vs.stop()
+        dn = next(n for n in master.topo.leaves()
+                  if n.url() == vs.url())
+        dn.last_seen = 0.0
+        master._sweep_dead_nodes()
+        return t0
+
+    try:
+        client = WeedClient(master.url())
+        col = f"mttr{mode}"
+        blob = os.urandom(1 << 20)
+        master.repair.enabled = True
+        master.repair.delay = 0.0
+
+        if mode == "replicated":
+            rpc.call(f"{master.url()}/vol/grow?count=1"
+                     f"&collection={col}&replication=001", "POST")
+            fid = client.upload_data(blob, collection=col,
+                                     replication="001")
+            vid = int(fid.split(",")[0])
+            for _ in range(MTTR_MB - 1):
+                client.upload_data(blob, collection=col,
+                                   replication="001")
+            holders = {dn.url() for dn in master.topo.lookup(col, vid)}
+            dead = next(vs for vs in servers if vs.url() in holders)
+            survivor = next(vs for vs in servers
+                            if vs.url() in holders and vs is not dead)
+            v = survivor.store.find_volume(vid)
+            v.sync()
+            expect = (v.dat_size()
+                      + os.path.getsize(v.file_name() + ".idx"))
+            flows.LEDGER.reset()
+            t_kill = kill(dead)
+            out = master.repair.run_now(kinds=["replicate"])
+            mttr = time.perf_counter() - t_kill
+            assert any(r["outcome"] == "ok" for r in out["results"])
+            assert len(master.topo.lookup(col, vid)) == 2
+            time.sleep(0.3)
+            wire, _ops = flows.LEDGER.totals(purpose_="repair.fetch",
+                                             direction="in")
+            purpose = "repair.fetch"
+        else:
+            codec = get_codec(mode)
+            rpc.call(f"{master.url()}/vol/grow?count=1"
+                     f"&collection={col}", "POST")
+            fid = client.upload_data(blob, collection=col)
+            vid = int(fid.split(",")[0])
+            for _ in range(MTTR_MB - 1):
+                client.upload_data(blob, collection=col)
+            src = client.lookup(vid)[0]["url"]
+            rpc.call_json(f"http://{src}/admin/ec/generate", "POST",
+                          {"volume": vid, "codec": mode})
+            spread = [(servers[0], [0, 1, 2, 3, 4]),
+                      (servers[1], [5, 6, 7, 8, 9]),
+                      (servers[2], list(range(10, codec.total_shards)))]
+            for vs, shards in spread:
+                if vs.url() != src:
+                    rpc.call_json(
+                        f"http://{vs.url()}/admin/ec/copy_shard",
+                        "POST", {"volume": vid, "source": src,
+                                 "shards": shards, "copy_ecx": True})
+            for vs, shards in spread:
+                rpc.call_json(f"http://{vs.url()}/admin/ec/mount",
+                              "POST", {"volume": vid})
+                drop = [s for s in range(codec.total_shards)
+                        if s not in shards]
+                rpc.call_json(
+                    f"http://{vs.url()}/admin/ec/delete_shards",
+                    "POST", {"volume": vid, "shards": drop})
+            rpc.call_json(f"http://{src}/admin/delete_volume", "POST",
+                          {"volume": vid})
+            for vs in servers:
+                vs._send_heartbeat(full=True)
+                vs._ec_loc_cache.clear()
+            locs = master.topo.lookup_ec_shards(vid).locations
+            shard_bytes = len(bytes(rpc.call(
+                f"http://{locs[0][0].url()}/admin/ec/shard_file"
+                f"?volume={vid}&shard=0")))
+            missing = list(range(10, codec.total_shards))
+            plans = codec.repair_plan(tuple(range(10)), missing)
+            expect = (len({r for p in plans for r in p.reads})
+                      * shard_bytes)
+            flows.LEDGER.reset()
+            t_kill = kill(servers[2])  # shards 10.. gone for good
+            out = master.repair.run_now(kinds=["ec"])
+            mttr = time.perf_counter() - t_kill
+            assert any(r["outcome"] == "ok" for r in out["results"]), \
+                out
+            present = {s for s, dns in master.topo.lookup_ec_shards(
+                vid).locations.items() if dns}
+            assert present == set(range(codec.total_shards))
+            time.sleep(0.3)
+            wire, _ops = flows.LEDGER.totals(purpose_="ec.gather",
+                                             direction="in")
+            purpose = "ec.gather"
+
+        # The cross-assert: the ledger's repair bytes bound the actual
+        # payload below (it must have moved at least the files) and
+        # within 25% + 1 MB above (framing/sidecar overhead only).
+        assert expect <= wire <= expect * 1.25 + (1 << 20), \
+            f"{mode}: ledger says {wire}, files say {expect}"
+        log(f"{mode}: MTTR {mttr:.2f}s, {wire / 1e6:.1f} MB on the "
+            f"wire via {purpose} (files: {expect / 1e6:.1f} MB)")
+        return {
+            "mode": mode,
+            "volume_mb": MTTR_MB,
+            "mttr_seconds": round(mttr, 3),
+            "wire_purpose": purpose,
+            "wire_repair_bytes": int(wire),
+            "expected_repair_bytes": int(expect),
+            "overhead_bytes": int(wire - expect),
+        }
+    finally:
+        for vs in servers:
+            try:
+                vs.stop()
+            except Exception:  # noqa: BLE001 — the killed one
+                pass
+        master.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     out_path = None
     args = sys.argv[1:]
@@ -244,6 +410,10 @@ def main() -> int:
     results["wire"]["read_savings_actual"] = round(
         1.0 - results["wire"]["lrc"]["wire_gather_bytes"]
         / results["wire"]["rs"]["wire_gather_bytes"], 4)
+    # Round 3: end-to-end MTTR (kill -> converged) through the
+    # durability autopilot, per durability scheme, ledger-checked.
+    results["mttr"] = {mode: bench_repair_mttr(mode)
+                       for mode in ("replicated", "rs", "lrc")}
     line = json.dumps(results)
     print(line)
     if out_path:

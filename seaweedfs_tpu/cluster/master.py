@@ -89,7 +89,10 @@ class MasterServer:
                  steer_peer: str | None = None,
                  steer_reads: bool = False,
                  steer_refresh: float = 2.0,
-                 filer_shards: int = 0):
+                 filer_shards: int = 0,
+                 repair_enabled: bool = False,
+                 repair_delay: float | None = None,
+                 repair_concurrent: int = 2):
         # Write-path JWT (security/jwt.go): when configured, Assign
         # responses carry an `auth` token volume servers require on
         # needle writes/deletes.
@@ -219,6 +222,12 @@ class MasterServer:
                 self._cluster_filer_shards)
         s.route("POST", "/cluster/filer/shards/move",
                 self._filer_shard_move)
+        s.route("GET", "/cluster/repair", self._cluster_repair)
+        s.route("POST", "/cluster/repair/run", self._cluster_repair_run)
+        s.route("POST", "/cluster/repair/pause",
+                lambda q, b: self._cluster_repair_switch(q, b, True))
+        s.route("POST", "/cluster/repair/resume",
+                lambda q, b: self._cluster_repair_switch(q, b, False))
         reg = s.enable_metrics("master")
         # Device roofline instruments (process-global singletons): the
         # master runs no EC kernels itself in the deployed topology,
@@ -257,6 +266,17 @@ class MasterServer:
         reg.gauge("SeaweedFS_node_health",
                   "per data node: 1 = heartbeat fresh, 0 = stale",
                   ("node",), callback=self._node_health_values)
+        # Durability autopilot instruments (process-global singletons
+        # in repair_daemon; register_once keeps multi-master-in-process
+        # scrapes single-family).
+        from . import repair_daemon as _repair_mod
+        reg.register_once(_repair_mod.repairs_total)
+        reg.register_once(_repair_mod.repair_seconds)
+        reg.gauge("SeaweedFS_repair_queue_depth",
+                  "queued automatic repairs by surviving-redundancy "
+                  "risk (0 = last replica / decode minimum)",
+                  ("risk",),
+                  callback=lambda: self.repair.queue_depth_by_risk())
         reg.gauge("SeaweedFS_master_tenant_bytes",
                   "cluster-wide stored bytes by tenant (heartbeat "
                   "rollup, replicas counted per copy)", ("tenant",),
@@ -314,6 +334,15 @@ class MasterServer:
         self.lifecycle = LifecycleDaemon(self, policy,
                                          interval=lifecycle_interval,
                                          mbps=lifecycle_mbps)
+        # Durability autopilot (-repair): leader-only daemon that
+        # converges the cluster back to declared redundancy after node
+        # loss.  Always constructed (the /cluster/repair surfaces and
+        # the shell's run-once path report/work on a disarmed plane);
+        # only an armed daemon enqueues from the sweep tick.
+        from .repair_daemon import RepairDaemon
+        self.repair = RepairDaemon(self, enabled=repair_enabled,
+                                   delay=repair_delay,
+                                   concurrent=repair_concurrent)
         # Multi-master HA: a raft node rides on this HTTP server; the
         # leader owns id issuance, followers proxy mutating requests
         # (server/raft_server.go, master_server.go:155).
@@ -582,6 +611,10 @@ class MasterServer:
                 emit_event("heartbeat.recovered", node=node_key,
                            data_center=hb.get("data_center", ""),
                            rack=hb.get("rack", ""))
+                # Resurrection fencing: a returning node lifts its
+                # drain fence and schedules the dedupe pass that
+                # resolves any repair that landed while it was away.
+                self.repair.node_returned(node_key)
         with node_lock:
             # Re-check under node_lock: a beat that read the guard
             # before a goodbye landed (and was then preempted) must
@@ -735,6 +768,10 @@ class MasterServer:
             self._hb_known.discard(node_key)
         emit_event("node.drained", node=node_key,
                    volumes=len(held_volumes), ec_shards=len(held_ec))
+        # Planned maintenance never repairs: fence every vid this node
+        # held until a new generation of the node registers.
+        self.repair.node_goodbyed(
+            node_key, set(held_volumes) | set(held_ec))
         vids = sorted(set(held_volumes) | set(held_ec))
         if vids:
             self._broadcast_locations({
@@ -1478,6 +1515,14 @@ class MasterServer:
                     lease_doc["held_local"] += 1
                 if lrow.get("moving"):
                     lease_doc["moving"] += 1
+        # Failure-domain audit: replicas that all landed in one
+        # rack/DC despite a placement that demands spread, and EC
+        # stripes with more shards on one node than same_rack_count+1
+        # allows.  Always a WARNING, never 503 — the data is fully
+        # readable; the risk is correlated loss.  This is the
+        # placement-violation input the autopilot's dedupe /
+        # re-placement pass consumes.
+        placement_warnings = self._placement_audit()
         # Filer fleet (metadata-HA plane): registered filers appear
         # beside volume nodes; a dead filer or a primary-less shard is
         # a PROBLEM — namespace writes for that shard fail closed.
@@ -1501,8 +1546,63 @@ class MasterServer:
                "flows": {"budgets": flow_budget_rows,
                          "warnings": flows_warnings},
                "device": {"occupancy": device_rows,
-                          "warnings": device_warnings}}
+                          "warnings": device_warnings},
+               "placement": {"warnings": placement_warnings},
+               "repair": {"enabled": self.repair.enabled,
+                          "paused": self.repair.paused,
+                          "queue": len(self.repair._queue),
+                          "inflight": len(self.repair._inflight)}}
         return not problems, doc
+
+    def _placement_audit(self) -> list[str]:
+        """Failure-domain audit rows for health_report (warning-only):
+        replicated volumes whose copies all share one rack/DC when the
+        placement demands spread, and EC stripes concentrating more
+        than same_rack_count+1 shards on a single node."""
+        warnings = []
+        with self.topo._lock:
+            for cname, coll in self.topo.collections.items():
+                label = cname or "(default)"
+                for layout in coll.layouts.values():
+                    rp = layout.rp
+                    for vid, locs in sorted(
+                            layout.vid2location.items()):
+                        if len(locs) < 2:
+                            continue
+                        dcs = {dn.get_data_center().id for dn in locs}
+                        racks = {(dn.get_data_center().id,
+                                  dn.get_rack().id) for dn in locs}
+                        if rp.diff_data_center_count and len(dcs) == 1:
+                            warnings.append(
+                                f"volume {vid} ({label}, rp={rp}): all "
+                                f"{len(locs)} replicas in data center "
+                                f"{next(iter(dcs))}")
+                        elif rp.diff_rack_count and len(racks) == 1:
+                            warnings.append(
+                                f"volume {vid} ({label}, rp={rp}): all "
+                                f"{len(locs)} replicas in rack "
+                                f"{next(iter(racks))[1]}")
+            for vid, loc in sorted(self.topo.ec_shard_map.items()):
+                rp = None
+                coll = self.topo.collections.get(loc.collection)
+                if coll is not None and coll.layouts:
+                    rp = next(iter(coll.layouts.values())).rp
+                if rp is None:
+                    rp = ReplicaPlacement.parse(self.default_replication)
+                limit = rp.same_rack_count + 1
+                per_node: dict[str, int] = {}
+                for sid, dns in loc.locations.items():
+                    for dn in dns:
+                        url = dn.url()
+                        per_node[url] = per_node.get(url, 0) + 1
+                for url, n in sorted(per_node.items()):
+                    if n > limit:
+                        warnings.append(
+                            f"ec volume {vid} "
+                            f"({loc.collection or '(default)'}): "
+                            f"{n} shards on {url} "
+                            f"(placement allows {limit})")
+        return warnings
 
     def _cluster_mirror(self, query: dict, body: bytes) -> dict:
         """GET /cluster/mirror — the pairing status rollup: which
@@ -1790,6 +1890,38 @@ class MasterServer:
             return self._proxy_to_leader("/cluster/lifecycle/run",
                                          query, body, "POST")
         return self.lifecycle.scan_once()
+
+    def _cluster_repair(self, query: dict, body: bytes) -> dict:
+        """GET /cluster/repair — durability autopilot status: queue,
+        in-flight repairs with per-repair phase, fresh scan (dry-run
+        plan with hysteresis/suppression annotations), history tail,
+        MTTR histogram."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/cluster/repair", query,
+                                         b"", "GET")
+        return self.repair.status()
+
+    def _cluster_repair_run(self, query: dict, body: bytes) -> dict:
+        """POST /cluster/repair/run — one synchronous repair drain
+        (the shell's `cluster.repair run` / `volume.fix.replication`;
+        tests drive the daemon through this instead of waiting out
+        hysteresis).  Body may carry {"kinds": ["replicate"|"ec"]}."""
+        if not self.is_leader():
+            return self._proxy_to_leader("/cluster/repair/run",
+                                         query, body, "POST")
+        kinds = None
+        if body:
+            kinds = json.loads(body).get("kinds")
+        return self.repair.run_now(kinds=kinds)
+
+    def _cluster_repair_switch(self, query: dict, body: bytes,
+                               pause: bool) -> dict:
+        """POST /cluster/repair/pause|resume — runtime governor (pause
+        before risky maintenance the drain fence can't see)."""
+        path = "/cluster/repair/" + ("pause" if pause else "resume")
+        if not self.is_leader():
+            return self._proxy_to_leader(path, query, body, "POST")
+        return self.repair.pause() if pause else self.repair.resume()
 
     def _healthz(self, query: dict, body: bytes):
         """GET /cluster/healthz — 200/503 for load balancers, JSON
@@ -2276,6 +2408,10 @@ class MasterServer:
                 continue
             self._sweep_dead_nodes()
             self._sweep_dead_filers()
+            # Durability autopilot rides the sweep cadence: scan for
+            # redundancy deficits the sweep just created (or healed)
+            # and drive the repair queue.  tick() never raises.
+            self.repair.tick()
 
     def _sweep_dead_nodes(self) -> None:
         """One dead-node collection round — the sweep loop's body,

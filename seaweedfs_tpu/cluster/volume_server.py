@@ -173,6 +173,7 @@ class VolumeServer:
         self._vol_loc_cache: dict[int, tuple[float, dict]] = {}
         self._ec_read_pool: concurrent.futures.ThreadPoolExecutor | None = None
         self._ec_pool_lock = threading.Lock()
+        self._reap_partial_files()
         self._load_ec_volumes()
         # -fsync: force per-write durability (every POST behaves like
         # ?fsync=true — zero-loss acks for users who want them).
@@ -298,6 +299,8 @@ class VolumeServer:
         s.route("GET", "/debug/device", self._debug_device)
         s.route("GET", "/admin/volume_file", self._volume_file)
         s.route("POST", "/admin/copy_volume", self._copy_volume)
+        s.route("GET", "/admin/volume/checksums", self._volume_checksums)
+        s.route("POST", "/admin/volume/receive", self._volume_receive)
         s.route("POST", "/admin/mount", self._admin_mount)
         s.route("POST", "/admin/unmount", self._admin_unmount)
         s.prefix_route("GET", "/", self._get_needle)
@@ -2897,6 +2900,73 @@ class VolumeServer:
         self._send_heartbeat()
         return {"volume": vid, "size": v.dat_size()}
 
+    def _volume_checksums(self, query: dict, body: bytes) -> dict:
+        """GET /admin/volume/checksums?volume=N — the fsck-style
+        needle -> CRC map for one local volume (live needles only,
+        CRC-verified while scanning).  The durability autopilot's
+        receive path compares the source's map against the copied
+        files before registering the new replica."""
+        vid = int(query["volume"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            raise rpc.RpcError(404, f"volume {vid} not here")
+        v.sync()
+        base = v.file_name()
+        return {"volume": vid,
+                "checksums": _needle_checksum_map(base + ".dat",
+                                                  base + ".idx")}
+
+    def _volume_receive(self, query: dict, body: bytes) -> dict:
+        """POST /admin/volume/receive — crash-safe, verified volume
+        copy for automatic re-replication.  Like /admin/copy_volume
+        but: files land as .part tmps and are os.replace()d only after
+        the rebuilt needle->CRC map matches the source's fsck map
+        byte-for-byte, so an executor dying mid-copy leaves only tmp
+        files the startup reaper removes, and a corrupt wire transfer
+        can never register as a replica."""
+        req = json.loads(body)
+        vid, source = req["volume"], req["source"]
+        if self.store.has_volume(vid):
+            raise rpc.RpcError(409, f"volume {vid} already here")
+        loc = self.store.free_location()
+        if loc is None:
+            raise rpc.RpcError(507, "no free disk location on this server")
+        collection = req.get("collection", "")
+        name = f"{collection}_{vid}" if collection else str(vid)
+        base = os.path.join(loc.directory, name)
+        tmps = {ext: base + ext + ".part" for ext in (".idx", ".dat")}
+        try:
+            # .idx before .dat: the copied index never references
+            # bytes beyond the copied data snapshot.  Repair traffic
+            # rides the low-priority lane, wire-accounted repair.fetch.
+            for ext in (".idx", ".dat"):
+                rpc.call_to_file(f"http://{source}/admin/volume_file?"
+                                 f"volume={vid}&ext={ext}", tmps[ext],
+                                 headers={**rpc.PRIORITY_LOW,
+                                          **_flows.tag("repair.fetch")})
+            want = rpc.call(
+                f"http://{source}/admin/volume/checksums?volume={vid}",
+                timeout=120.0)["checksums"]
+            got = _needle_checksum_map(tmps[".dat"], tmps[".idx"])
+            if got != want:
+                raise rpc.RpcError(
+                    422, f"volume {vid}: copied needle checksums "
+                    f"diverge from source ({len(got)} local vs "
+                    f"{len(want)} source live needles)")
+        except Exception:
+            for tmp in tmps.values():
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            raise
+        for ext in (".idx", ".dat"):
+            os.replace(tmps[ext], base + ext)
+        v = self.store.mount_volume(vid)
+        self._send_heartbeat()
+        return {"volume": vid, "size": v.dat_size(),
+                "needles": len(want)}
+
     def _admin_mount(self, query: dict, body: bytes) -> dict:
         req = json.loads(body)
         self.store.mount_volume(req["volume"])
@@ -2908,6 +2978,22 @@ class VolumeServer:
         self.store.unmount_volume(req["volume"])
         self._send_heartbeat(full=True)
         return {}
+
+    def _reap_partial_files(self) -> None:
+        """Crash-safety sweep at startup: remove interrupted transfer
+        tmps (.part from /admin/volume/receive, .dl.tmp from streaming
+        downloads).  A repair executor dying mid-copy leaves ONLY
+        these — never a half-registered volume — so reaping them is
+        the whole recovery story on the receiver side."""
+        import glob as _glob
+        for loc in self.store.locations:
+            for pat in ("*.part", "*.dl.tmp"):
+                for path in _glob.glob(os.path.join(loc.directory,
+                                                    pat)):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
 
     def _load_ec_volumes(self) -> None:
         """Discover local EC shards at startup (disk_location_ec.go)."""
@@ -2926,3 +3012,23 @@ class VolumeServer:
                         self.ec_volumes[vid] = EcVolume(base, vid=vid)
                     except Exception:  # noqa: BLE001 — incomplete shard set
                         continue
+
+
+def _needle_checksum_map(dat_path: str, idx_path: str) -> dict:
+    """fsck-style content map for one volume file pair: live needle id
+    (hex) -> stored CRC (hex, CRC-verified against the data while
+    scanning).  Keyed by needle and node-address-free, so two holders
+    of the same volume converged exactly when their maps are equal —
+    the registration gate for /admin/volume/receive."""
+    from ..storage.needle_map import MemoryNeedleMap
+    from ..storage.volume_scanner import scan_volume_file
+    live = MemoryNeedleMap.load(idx_path)
+    out: dict[str, str] = {}
+    for needle, _offset, _total in scan_volume_file(dat_path,
+                                                    check_crc=True):
+        key = f"{needle.id:x}"
+        if needle.size == 0:  # tombstone: the needle is deleted
+            out.pop(key, None)
+        elif needle.id in live:
+            out[key] = f"{needle.checksum & 0xFFFFFFFF:08x}"
+    return out

@@ -221,6 +221,14 @@ def run_master(flags: Flags, args: list[str]) -> int:
         # welded to the 5s default.
         filer_shards=flags.get_int("filer.shards", 0),
         pulse_seconds=flags.get_float("pulseSeconds", 5.0),
+        # Durability autopilot: -repair arms the leader-side daemon
+        # that automatically re-replicates and EC-rebuilds after node
+        # loss; -repair.delay is the hysteresis window before a
+        # deficit is acted on (default 2x the dead-sweep threshold),
+        # -repair.concurrent bounds parallel repairs.
+        repair_enabled=flags.get_bool("repair", False),
+        repair_delay=flags.get_float("repair.delay", 0.0) or None,
+        repair_concurrent=flags.get_int("repair.concurrent", 2),
         **_slo_flags(flags))
     m.start()
     glog.infof("master serving at %s", m.server.url())
@@ -433,6 +441,12 @@ def run_server(flags: Flags, args: list[str]) -> int:
                                                   60.0),
                lifecycle_mbps=flags.get_float("lifecycle.mbps", 32.0),
                tenant_rules=flags.get("tenant.rules", ""),
+               # Durability autopilot flags mirror the standalone
+               # master command.
+               repair_enabled=flags.get_bool("repair", False),
+               repair_delay=flags.get_float("repair.delay", 0.0)
+               or None,
+               repair_concurrent=flags.get_int("repair.concurrent", 2),
                # -transport applies to EVERY embedded role, like -slo.*.
                transport=_transport_flag(flags),
                # -slo.* applies to EVERY embedded role, same as the

@@ -170,6 +170,22 @@ TYPES: dict[str, str] = {
                    "before any record at that epoch is accepted) — "
                    "pushes from the deposed primary's stale epoch now "
                    "refuse with 409",
+    "repair.plan": "the durability autopilot enqueued a repair: a "
+                   "redundancy deficit survived hysteresis and is not "
+                   "fenced by a drain (attrs carry kind, volume, risk "
+                   "= surviving redundancy, have/want)",
+    "repair.start": "a queued repair began executing (re-replication "
+                    "copy or codec-aware EC rebuild) on the "
+                    "low-priority lane",
+    "repair.finish": "a repair converged: the volume is back at "
+                     "declared redundancy (attrs carry wall seconds "
+                     "and MTTR from degradation detection; "
+                     "kind=dedupe records a surplus-copy trim after "
+                     "a resurrection)",
+    "repair.cancel": "a repair was abandoned: the deficit healed "
+                     "(node returned), the leader was deposed, or "
+                     "the executor failed (reason attr; failures "
+                     "re-enter through hysteresis)",
 }
 
 SEVERITIES = ("info", "warn", "error")

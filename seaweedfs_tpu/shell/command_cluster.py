@@ -564,6 +564,20 @@ class ClusterCheck(Command):
             lines.append("")
             lines.append("readonly volumes: " + ", ".join(
                 f"{v['id']}@{v['node']}" for v in ro))
+        placement = (doc.get("placement") or {}).get("warnings", [])
+        if placement:
+            lines.append("")
+            for w in placement:
+                lines.append(f"  ~ placement: {w}")
+        rep = doc.get("repair") or {}
+        if rep:
+            state = "armed" if rep.get("enabled") else "disarmed"
+            if rep.get("paused"):
+                state += ", paused"
+            lines.append("")
+            lines.append(f"repair autopilot: {state}  "
+                         f"queue={rep.get('queue', 0)}  "
+                         f"inflight={rep.get('inflight', 0)}")
         filers = (doc.get("filers") or {}).get("nodes", [])
         if filers:
             lines.append("")
@@ -574,6 +588,97 @@ class ClusterCheck(Command):
                     f"{f['url']:29}  {f['age_seconds']:7.1f}  "
                     f"{f['shards_primary']:10d}{mark}")
         return "\n".join(lines)
+
+
+@register
+class ClusterRepair(Command):
+    name = "cluster.repair"
+    help = ("cluster.repair [status|run|pause|resume] [-kind="
+            "replicate|ec] — the durability autopilot: `status` "
+            "renders the risk-ranked repair queue, in-flight repairs "
+            "with phase, the dry-run plan (with hysteresis/suppression "
+            "annotations) and the MTTR histogram; `run` drains one "
+            "synchronous repair pass (works while the daemon is "
+            "disarmed); `pause`/`resume` gate the armed daemon's "
+            "executors at runtime")
+
+    @staticmethod
+    def _render_rows(title: str, rows: list[dict]) -> list[str]:
+        lines = ["", f"{title} ({len(rows)}):"]
+        for r in rows:
+            extra = f" missing={len(r.get('missing', []))}" \
+                if r.get("kind") == "ec" else \
+                f" rp={r.get('replication', '?')}"
+            note = ""
+            if r.get("suppressed"):
+                note = "  (drain-fenced)"
+            elif "degraded_for" in r:
+                note = f"  degraded {r['degraded_for']:.1f}s"
+            lines.append(
+                f"  risk={r['risk']}  {r['kind']:9}  "
+                f"volume {r['volume']:6d}  {r.get('have', '?')}/"
+                f"{r.get('want', '?')}  phase={r['phase']}"
+                f"{extra}{note}")
+        return lines
+
+    def do(self, args: list[str], env: CommandEnv) -> str:
+        flags, rest = self.parse_flags(args)
+        sub = rest[0] if rest else "status"
+        base = env.master_url
+        if sub == "status":
+            doc = rpc.call(f"{base}/cluster/repair", timeout=30.0)
+            state = "armed" if doc.get("enabled") else "disarmed"
+            if doc.get("paused"):
+                state += ", PAUSED"
+            lines = [f"durability autopilot: {state}  "
+                     f"delay={doc.get('delay_seconds', 0):.0f}s  "
+                     f"concurrent={doc.get('concurrent', 0)}"]
+            if doc.get("queue"):
+                lines += self._render_rows("queued", doc["queue"])
+            if doc.get("inflight"):
+                lines += self._render_rows("in flight",
+                                           doc["inflight"])
+            if doc.get("plan"):
+                lines += self._render_rows("plan (live scan)",
+                                           doc["plan"])
+            m = doc.get("mttr") or {}
+            if m.get("count"):
+                lines.append("")
+                lines.append(
+                    f"MTTR over last {m['count']} repairs: "
+                    f"mean {m['mean_seconds']}s, "
+                    f"max {m['max_seconds']}s")
+                hist = m.get("histogram") or {}
+                lines.append("  " + "  ".join(
+                    f"{k.removeprefix('le_')}s:{v}"
+                    for k, v in hist.items() if v))
+            if len(lines) == 1:
+                lines.append("nothing degraded — queue empty")
+            return "\n".join(lines)
+        if sub == "run":
+            env.confirm_is_locked()
+            kinds = [flags["kind"]] if flags.get("kind") else None
+            doc = rpc.call_json(f"{base}/cluster/repair/run",
+                                payload={"kinds": kinds},
+                                timeout=600.0)
+            lines = [f"ran {doc.get('ran', 0)} repairs"]
+            for r in doc.get("results", []):
+                lines.append(
+                    f"  {r['kind']:9}  volume {r['volume']:6d}  "
+                    f"{r.get('outcome', '?')}"
+                    + (f"  ({r['error']})" if r.get("error") else ""))
+            for r in doc.get("trimmed", []):
+                lines.append(f"  dedupe     volume {r['volume']:6d}  "
+                             f"trimmed surplus copy on {r['node']}")
+            return "\n".join(lines)
+        if sub in ("pause", "resume"):
+            env.confirm_is_locked()
+            doc = rpc.call_json(f"{base}/cluster/repair/{sub}",
+                                payload={}, timeout=30.0)
+            return ("autopilot paused" if doc.get("paused")
+                    else "autopilot resumed")
+        raise ShellError(f"unknown subcommand {sub!r} "
+                         "(status|run|pause|resume)")
 
 
 @register

@@ -533,41 +533,51 @@ class VolumeBalance(Command):
 @register
 class VolumeFixReplication(Command):
     name = "volume.fix.replication"
-    help = ("volume.fix.replication [-n] — re-copy under-replicated "
-            "volumes to spare nodes")
+    help = ("volume.fix.replication [-n] — one manual pass of the "
+            "durability autopilot's re-replication planner "
+            "(cluster/repair_daemon.py): -n renders the risk-ranked "
+            "plan the daemon would execute (see it before arming "
+            "-repair); without -n the master runs the plan "
+            "synchronously — same risk ordering, placement-aware "
+            "target choice, verified crash-safe copies, and surplus "
+            "dedupe as the armed daemon")
 
     def do(self, args: list[str], env: CommandEnv) -> str:
-        env.confirm_is_locked()
         flags, _ = self.parse_flags(args)
         dry = "n" in flags
+        if dry:
+            doc = rpc.call(f"{env.master_url}/cluster/repair",
+                           timeout=30.0)
+            rows = [r for r in doc.get("plan", [])
+                    if r["kind"] == "replicate"]
+            out = []
+            for r in rows:
+                note = " (drain-fenced: would NOT auto-repair)" \
+                    if r.get("suppressed") else ""
+                out.append(
+                    f"volume {r['volume']}: would re-replicate "
+                    f"{r['have']}/{r['want']} "
+                    f"(risk={r['risk']}, rp={r['replication']})"
+                    f"{note}")
+            return "\n".join(out) or \
+                "all volumes sufficiently replicated"
+        env.confirm_is_locked()
+        doc = rpc.call_json(f"{env.master_url}/cluster/repair/run",
+                            payload={"kinds": ["replicate"]},
+                            timeout=600.0)
         out = []
-        for vid, holders in sorted(_volumes_by_id(env).items()):
-            rp = ReplicaPlacement.from_byte(
-                holders[0][1].get("replica_placement", 0))
-            want = rp.copy_count()
-            have = len(holders)
-            if have >= want:
-                continue
-            holder_urls = {n["url"] for n, _v in holders}
-            spares = [n for n in env.data_nodes()
-                      if n["url"] not in holder_urls
-                      and len(n["volumes"]) < n["max_volume_count"]]
-            # Prefer placement matching the rp: different rack first when
-            # diff_rack_count is set, etc. (simplified pickBestNode).
-            src_rack = holders[0][0]["rack"]
-            src_dc = holders[0][0]["dc"]
-            if rp.diff_data_center_count:
-                spares.sort(key=lambda n: n["dc"] == src_dc)
-            elif rp.diff_rack_count:
-                spares.sort(key=lambda n: n["rack"] == src_rack)
-            for spare in spares[:want - have]:
-                if dry:
-                    out.append(f"volume {vid}: would copy to "
-                               f"{spare['url']}")
-                    continue
-                copy_volume(env, vid, holders[0][0]["url"], spare["url"])
-                out.append(f"volume {vid}: copied to {spare['url']} "
-                           f"({have}/{want} -> {have + 1}/{want})")
+        for r in doc.get("results", []):
+            if r.get("outcome") == "ok":
+                out.append(f"volume {r['volume']}: copied — restored "
+                           f"{r['want']}/{r['want']}")
+            else:
+                out.append(f"volume {r['volume']}: "
+                           f"{r.get('outcome', '?')}"
+                           + (f" ({r['error']})"
+                              if r.get("error") else ""))
+        for r in doc.get("trimmed", []):
+            out.append(f"volume {r['volume']}: trimmed surplus copy "
+                       f"on {r['node']}")
         return "\n".join(out) or "all volumes sufficiently replicated"
 
 

@@ -260,16 +260,17 @@ def cluster(tmp_path_factory):
 _COLLECTION_N = [0]
 
 
-def _new_volume(cl, prefix: str):
+def _new_volume(cl, prefix: str, replication: str = ""):
     """One fresh volume with a needle in it; returns (vid, holder_url,
     fid).  Uses /vol/grow?count=1 so each driver costs one volume, not
     a 7-volume layout growth."""
     master, _servers, _stub, client, _tmp = cl
     _COLLECTION_N[0] += 1
     col = f"{prefix}{_COLLECTION_N[0]}"
-    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}",
+    rep = f"&replication={replication}" if replication else ""
+    rpc.call(f"{master.url()}/vol/grow?count=1&collection={col}{rep}",
              "POST")
-    a = rpc.call(f"{master.url()}/dir/assign?collection={col}")
+    a = rpc.call(f"{master.url()}/dir/assign?collection={col}{rep}")
     rpc.call(f"http://{a['url']}/{a['fid']}", "POST",
              b"event journal payload " * 64)
     return int(a["fid"].split(",")[0]), a["url"], a["fid"]
@@ -907,6 +908,64 @@ def _drive_shard_fence(cl, tmp_path=None):
         assert plane._fence(0, 1)
 
 
+def _drive_repair_converge(cl):
+    """Real autopilot convergence: a 001 volume loses one of its two
+    holders to the dead-node sweep, and run_now() re-replicates it to
+    a freshly started third server through /admin/volume/receive —
+    emitting repair.plan, repair.start and repair.finish."""
+    master, servers, _st, _c, tmp = cl
+    _vid, _url, _fid = _new_volume(cl, "repcol", replication="001")
+    vs3 = None
+    dead = None
+    try:
+        d = tmp / f"vs-repair-{int(time.time() * 1000)}"
+        d.mkdir()
+        vs3 = VolumeServer(master.url(), [str(d)],
+                           max_volume_counts=[200], pulse_seconds=60)
+        vs3.start()
+        deadline = time.time() + 10
+        while vs3.url() not in {n.url()
+                                for n in master.topo.leaves()}:
+            if time.time() > deadline:
+                raise TimeoutError("third server never registered")
+            time.sleep(0.05)
+        dead = servers[1]
+        dn = next(n for n in master.topo.leaves()
+                  if n.url() == dead.url())
+        dn.last_seen = 0.0
+        master._sweep_dead_nodes()
+        out = master.repair.run_now(kinds=["replicate"])
+        assert any(r["outcome"] == "ok" for r in out["results"]), out
+    finally:
+        if dead is not None:
+            dead._send_heartbeat(full=True)  # restore for later drivers
+        if vs3 is not None:
+            vs3.stop()
+            gone = next((n for n in master.topo.leaves()
+                         if n.url() == vs3.url()), None)
+            if gone is not None:
+                master.topo.unregister_data_node(gone)
+                master._hb_known.discard(vs3.url())
+
+
+def _drive_repair_cancel(cl):
+    """A queued repair whose deficit heals (the holder returns before
+    the executor picks it up) is canceled by the reconcile pass."""
+    m = MasterServer(port=0)
+    vol = {"id": 7001, "collection": "rc", "size": 0, "file_count": 0,
+           "replica_placement": 1}
+    m._heartbeat({}, json.dumps(
+        {"ip": "127.0.0.1", "port": 7101, "volumes": [vol]}).encode())
+    m.repair._degraded_since[("replicate", 7001)] = 0.0
+    m.repair.reconcile()
+    assert any(t.vid == 7001 for t in m.repair._queue)
+    m._heartbeat({}, json.dumps(
+        {"ip": "127.0.0.1", "port": 7102, "volumes": [vol]}).encode())
+    with root_span("drive.repair_cancel", "test"):
+        m.repair.reconcile()
+    assert not m.repair._queue
+
+
 DRIVERS = {
     "volume.assign": _drive_volume_assign,
     "volume.grow": _drive_volume_grow,
@@ -956,6 +1015,10 @@ DRIVERS = {
     "shard.promote": _drive_shard_promote,
     "shard.move": _drive_shard_move,
     "shard.fence": _drive_shard_fence,
+    "repair.plan": _drive_repair_converge,
+    "repair.start": _drive_repair_converge,
+    "repair.finish": _drive_repair_converge,
+    "repair.cancel": _drive_repair_cancel,
 }
 
 
@@ -973,8 +1036,9 @@ def test_driver_catalog_matches_registry():
     # tenant.throttled + 1 wire-flow type: flows.budget + 3 geo lease
     # types: lease.acquire/move/fence + 1 device roofline type:
     # device.slow + 3 filer metadata-HA types: shard.promote/move/
-    # fence).
-    assert len(TYPES) == 48
+    # fence + 4 durability-autopilot types: repair.plan/start/finish/
+    # cancel).
+    assert len(TYPES) == 52
 
 
 @pytest.mark.parametrize("etype", sorted(TYPES))
